@@ -1,0 +1,35 @@
+"""neuronx_distributed_tpu — a TPU-native distributed training & inference
+framework with the capabilities of aws-neuron/neuronx-distributed, built on
+JAX/XLA/Pallas.
+
+Public API mirrors the reference's top-level exports
+(``src/neuronx_distributed/__init__.py:1-19``).
+"""
+
+from .config import (
+    NxDConfig,
+    ParallelConfig,
+    OptimizerConfig,
+    MixedPrecisionConfig,
+    ActivationCheckpointConfig,
+    PipelineConfig,
+    CheckpointConfig,
+    neuronx_distributed_config,
+    configure_model,
+)
+from . import parallel
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "NxDConfig",
+    "ParallelConfig",
+    "OptimizerConfig",
+    "MixedPrecisionConfig",
+    "ActivationCheckpointConfig",
+    "PipelineConfig",
+    "CheckpointConfig",
+    "neuronx_distributed_config",
+    "configure_model",
+    "parallel",
+]

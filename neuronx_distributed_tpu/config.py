@@ -1,0 +1,198 @@
+"""Typed configuration for the framework.
+
+TPU-native analogue of the reference's ``neuronx_distributed_config`` nested
+dict factory (reference: ``trainer/trainer.py:32-144``).  Instead of a loosely
+validated dict we use frozen dataclasses with explicit defaults; environment
+variable overrides are honoured at construction time where the reference
+sprinkled ``os.environ`` reads at use sites (SURVEY §5 "Config / flag system").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+
+def configure_model(cfg: "NxDConfig", model_cfg: Any) -> Any:
+    """Propagate framework-level knobs into a model config dataclass.
+
+    The analogue of the reference's ``initialize_parallel_model`` applying
+    nxd_config to the wrapped model (sequence_parallel, activation
+    checkpointing, precision — ``trainer/trainer.py:147-236``). Any of
+    ``sequence_parallel`` / ``remat`` / ``dtype`` / ``tp_size`` present on
+    the model config dataclass is overridden from ``cfg``.
+    """
+    import jax.numpy as jnp
+
+    updates = {}
+    fields = {f.name for f in dataclasses.fields(model_cfg)}
+    if "sequence_parallel" in fields:
+        updates["sequence_parallel"] = cfg.sequence_parallel
+    if "remat" in fields:
+        updates["remat"] = cfg.activation_checkpoint.mode != "none"
+    if "dtype" in fields:
+        updates["dtype"] = jnp.dtype(cfg.mixed_precision.compute_dtype)
+    if "tp_size" in fields:
+        updates["tp_size"] = cfg.parallel.tensor_parallel_size
+    return dataclasses.replace(model_cfg, **updates)
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Parallel dimensions of the device mesh.
+
+    Mirrors the arguments of the reference's ``initialize_model_parallel``
+    (``parallel_layers/parallel_state.py:391``): tensor/pipeline/context/expert
+    parallel degrees; data parallel is inferred from the device count unless
+    given explicitly.
+    """
+
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    context_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    # Inferred from jax.device_count() when None.
+    data_parallel_size: Optional[int] = None
+    # Virtual pipeline (interleaved 1F1B) model chunks per pp rank.
+    virtual_pipeline_size: int = 1
+
+    def __post_init__(self) -> None:
+        for f in ("tensor_parallel_size", "pipeline_parallel_size",
+                  "context_parallel_size", "expert_parallel_size",
+                  "virtual_pipeline_size"):
+            v = getattr(self, f)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"{f} must be a positive int, got {v!r}")
+
+    @property
+    def model_parallel_size(self) -> int:
+        return (self.tensor_parallel_size * self.pipeline_parallel_size
+                * self.context_parallel_size)
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    """Reference: ``optimizer_config`` in ``trainer/trainer.py:52-60``."""
+
+    zero_one_enabled: bool = False
+    grad_clipping: bool = True
+    max_grad_norm: float = 1.0
+
+
+@dataclass(frozen=True)
+class MixedPrecisionConfig:
+    """Reference: ``mixed_precision_config`` in ``trainer/trainer.py:66-76``."""
+
+    use_master_weights: bool = True
+    use_fp32_grad_acc: bool = True
+    use_master_weights_in_ckpt: bool = False
+    # Compute dtype for matmuls/activations; params kept in fp32 masters.
+    compute_dtype: str = "bfloat16"
+
+
+@dataclass(frozen=True)
+class ActivationCheckpointConfig:
+    """Remat policy selection (reference: ``activation_checkpoint_config``
+    argument of ``initialize_parallel_model``, ``trainer/trainer.py:147``)."""
+
+    # one of: "none", "full", "attention", "custom"
+    mode: str = "none"
+    # jax.checkpoint policy name from jax.checkpoint_policies when mode=custom
+    policy: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class PipelineConfig:
+    """Reference: ``pipeline_config`` dict (``trainer/trainer.py:44-51``) and
+    ``NxDPPModel`` kwargs (``pipeline/model.py:74``)."""
+
+    num_microbatches: int = 1
+    # one of: "gpipe", "1f1b", "interleaved", "inference"
+    schedule: str = "1f1b"
+    # Names of layers (pytree path prefixes) at which to cut stages; empty =
+    # even auto-partition (reference: ``partition.py:280``).
+    manual_cut_points: Sequence[str] = ()
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    """Reference: ``trainer/checkpoint.py`` save/load options."""
+
+    output_dir: str = "checkpoints"
+    save_interval: int = 0  # 0 = disabled
+    keep_last: int = -1  # -1 = keep all (reference: num_kept arg)
+    async_save: bool = True
+    use_master_weights_in_ckpt: bool = False
+
+
+@dataclass(frozen=True)
+class NxDConfig:
+    """Top-level framework config.
+
+    The analogue of the dict returned by the reference's
+    ``neuronx_distributed_config`` (``trainer/trainer.py:32``).
+    """
+
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    mixed_precision: MixedPrecisionConfig = field(default_factory=MixedPrecisionConfig)
+    activation_checkpoint: ActivationCheckpointConfig = field(
+        default_factory=ActivationCheckpointConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    sequence_parallel: bool = False
+    seed: int = 0
+
+    def replace(self, **kw: Any) -> "NxDConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def neuronx_distributed_config(
+    tensor_parallel_size: int = 1,
+    pipeline_parallel_size: int = 1,
+    context_parallel_size: int = 1,
+    expert_parallel_size: int = 1,
+    pipeline_config: Optional[PipelineConfig] = None,
+    optimizer_config: Optional[OptimizerConfig] = None,
+    activation_checkpoint_config: Optional[ActivationCheckpointConfig] = None,
+    mixed_precision_config: Optional[MixedPrecisionConfig] = None,
+    checkpoint_config: Optional[CheckpointConfig] = None,
+    sequence_parallel: bool = False,
+    seed: int = 0,
+    init_mesh: bool = True,
+    devices: Optional[Sequence[Any]] = None,
+) -> NxDConfig:
+    """Build an :class:`NxDConfig` and (by default) initialise the global mesh.
+
+    Mirrors the reference's ``neuronx_distributed_config``
+    (``trainer/trainer.py:32``) which both validates config and calls
+    ``initialize_model_parallel``.
+    """
+    cfg = NxDConfig(
+        parallel=ParallelConfig(
+            tensor_parallel_size=tensor_parallel_size,
+            pipeline_parallel_size=pipeline_parallel_size,
+            context_parallel_size=context_parallel_size,
+            expert_parallel_size=expert_parallel_size,
+        ),
+        optimizer=optimizer_config or OptimizerConfig(),
+        mixed_precision=mixed_precision_config or MixedPrecisionConfig(),
+        activation_checkpoint=(activation_checkpoint_config
+                               or ActivationCheckpointConfig()),
+        pipeline=pipeline_config or PipelineConfig(),
+        checkpoint=checkpoint_config or CheckpointConfig(),
+        sequence_parallel=sequence_parallel,
+        seed=seed,
+    )
+    if init_mesh:
+        from .parallel import mesh as _mesh
+
+        _mesh.initialize_model_parallel(
+            tensor_model_parallel_size=tensor_parallel_size,
+            pipeline_model_parallel_size=pipeline_parallel_size,
+            context_parallel_size=context_parallel_size,
+            expert_model_parallel_size=expert_parallel_size,
+            devices=devices,
+        )
+    return cfg

@@ -1,0 +1,7 @@
+"""Model building blocks (reference: ``modules/``)."""
+
+from . import attention
+from . import norms
+from .norms import LayerNorm, RMSNorm
+
+__all__ = ["attention", "norms", "LayerNorm", "RMSNorm"]

@@ -1,0 +1,103 @@
+"""Attention building blocks: rotary embeddings and multi-head attention.
+
+Rotary utilities mirror the reference's ``modules/attention/utils.py``
+(``precompute_freqs_cis:42``, llama3 frequency scaling ``apply_scaling:20``).
+The attention core defaults to a pure-XLA softmax attention (which XLA fuses
+well on TPU); the Pallas flash-attention kernel in :mod:`..ops.flash_attention`
+is used automatically for longer sequences (reference:
+``kernels/flash_attn.py:162``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..parallel import layers as pl
+from ..parallel import mesh as ps
+
+
+def apply_rope_scaling(freqs: jax.Array,
+                       scale_factor: float = 8.0,
+                       low_freq_factor: float = 1.0,
+                       high_freq_factor: float = 4.0,
+                       original_max_position: int = 8192) -> jax.Array:
+    """Llama-3 style rope frequency scaling (reference
+    ``modules/attention/utils.py:20``)."""
+    low_freq_wavelen = original_max_position / low_freq_factor
+    high_freq_wavelen = original_max_position / high_freq_factor
+    wavelen = 2 * math.pi / freqs
+    scaled = jnp.where(wavelen > low_freq_wavelen, freqs / scale_factor, freqs)
+    smooth = (original_max_position / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor)
+    mid = (1 - smooth) * freqs / scale_factor + smooth * freqs
+    is_mid = (wavelen <= low_freq_wavelen) & (wavelen >= high_freq_wavelen)
+    return jnp.where(is_mid, mid, scaled)
+
+
+def precompute_rope(head_dim: int, max_len: int, theta: float = 10000.0,
+                    use_scaled: bool = False,
+                    dtype: Any = jnp.float32) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables ``[max_len, head_dim//2]`` (reference
+    ``precompute_freqs_cis:42``)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    if use_scaled:
+        inv_freq = apply_rope_scaling(inv_freq)
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary(x: jax.Array, cos: jax.Array, sin: jax.Array,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
+    """Apply rotary embedding. ``x: [B, S, N, D]``; cos/sin ``[L, D/2]``;
+    ``positions: [B, S]`` (defaults to arange)."""
+    b, s, n, d = x.shape
+    if positions is None:
+        cos_p = cos[:s][None, :, None, :]
+        sin_p = sin[:s][None, :, None, :]
+    else:
+        cos_p = cos[positions][:, :, None, :]
+        sin_p = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * cos_p - x2 * sin_p,
+                           x2 * cos_p + x1 * sin_p], axis=-1)
+    return out.astype(x.dtype)
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, K, D] -> [B, S, K*n_rep, D] (GQA head expansion)."""
+    if n_rep == 1:
+        return x
+    b, s, k, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :],
+                            (b, s, k, n_rep, d)).reshape(b, s, k * n_rep, d)
+
+
+def sdpa_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = True,
+                   segment_positions: Optional[jax.Array] = None,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Plain softmax attention, fp32 accumulation. ``q: [B, S, N, D]``,
+    ``k/v: [B, S, N, D]`` (already GQA-expanded)."""
+    b, sq, n, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        kpos = jnp.arange(k.shape[1])
+        if segment_positions is None:
+            mask = (jnp.arange(sq)[:, None] >= kpos[None, :])[None, None]
+        else:
+            # [B, S] query positions -> [B, 1, Q, K]
+            mask = (segment_positions[:, :, None] >= kpos[None, None, :]
+                    )[:, None]
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bnqk,bknd->bqnd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

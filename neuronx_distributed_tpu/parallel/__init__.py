@@ -1,0 +1,45 @@
+"""Tensor/sequence/expert parallel core (reference: ``parallel_layers/``)."""
+
+from . import mesh
+from . import comm
+from . import mappings
+from . import layers
+from . import loss_functions
+from . import random
+from .layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    ParallelEmbedding,
+    GQAQKVColumnParallelLinear,
+)
+from .loss_functions import parallel_cross_entropy
+from .mesh import (
+    initialize_model_parallel,
+    model_parallel_is_initialized,
+    destroy_model_parallel,
+    get_mesh,
+    get_expert_mesh,
+    TP_AXIS,
+    PP_AXIS,
+    DP_AXIS,
+    CP_AXIS,
+    EP_AXIS,
+    EXP_DP_AXIS,
+)
+
+__all__ = [
+    "mesh",
+    "comm",
+    "mappings",
+    "initialize_model_parallel",
+    "model_parallel_is_initialized",
+    "destroy_model_parallel",
+    "get_mesh",
+    "get_expert_mesh",
+    "TP_AXIS",
+    "PP_AXIS",
+    "DP_AXIS",
+    "CP_AXIS",
+    "EP_AXIS",
+    "EXP_DP_AXIS",
+]

@@ -1,0 +1,94 @@
+"""Low-level collective wrappers over named mesh axes.
+
+Analogue of the reference's ``parallel_layers/comm.py`` (xm.all_reduce /
+all_gather / reduce_scatter with replica-group lists, ``comm.py:124-220``).
+On TPU the replica-group plumbing disappears: collectives are expressed over
+*named mesh axes* inside ``shard_map`` and XLA lowers them to ICI/DCN
+collectives. Every wrapper is a no-op when the axis has size 1, and raises a
+clear error when called outside a context binding the axis (the reference's
+CPU/gloo fallback is unnecessary — the same code runs on a virtual CPU mesh).
+
+.. warning:: These wrappers are for *non-differentiated* code (or code whose
+   VJP you define yourself). On a differentiated path under
+   ``shard_map(check_vma=False)``, a raw ``psum`` transposes to another psum
+   and inflates gradients by the axis size — use the ``custom_vjp`` pairs in
+   :mod:`.mappings` instead (that is exactly the role of the reference's
+   autograd Functions in ``mappings.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import mesh as ps
+
+
+def _axis_size(axis: str) -> Optional[int]:
+    """Size of a bound axis, or None if the axis is not bound (GSPMD path).
+
+    Uses the module-validated private accessor from :mod:`.mesh` — API drift
+    raises at import, never a silent 'unbound' (see mesh.py)."""
+    env = ps._get_axis_env()
+    if env.axis_exists(axis):
+        return int(env.axis_size(axis))
+    return None
+
+
+def all_reduce(x: jax.Array, axis: str = ps.TP_AXIS) -> jax.Array:
+    n = _axis_size(axis)
+    if n is None or n == 1:
+        return x
+    return lax.psum(x, axis)
+
+
+def all_gather(x: jax.Array, axis: str = ps.TP_AXIS, dim: int = -1) -> jax.Array:
+    n = _axis_size(axis)
+    if n is None or n == 1:
+        return x
+    dim = dim % x.ndim
+    return lax.all_gather(x, axis, axis=dim, tiled=True)
+
+
+def reduce_scatter(x: jax.Array, axis: str = ps.TP_AXIS, dim: int = -1) -> jax.Array:
+    n = _axis_size(axis)
+    if n is None or n == 1:
+        return x
+    dim = dim % x.ndim
+    return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
+
+
+def all_to_all(x: jax.Array, axis: str, split_dim: int, concat_dim: int) -> jax.Array:
+    n = _axis_size(axis)
+    if n is None or n == 1:
+        return x
+    return lax.all_to_all(x, axis, split_axis=split_dim % x.ndim,
+                          concat_axis=concat_dim % x.ndim, tiled=True)
+
+
+def ppermute(x: jax.Array, axis: str, perm: Sequence[Tuple[int, int]]) -> jax.Array:
+    n = _axis_size(axis)
+    if n is None or n == 1:
+        return x
+    return lax.ppermute(x, axis, perm)
+
+
+def split_along_dim(x: jax.Array, axis: str = ps.TP_AXIS, dim: int = -1) -> jax.Array:
+    """Keep this shard's chunk of ``x`` along ``dim`` (the reference's
+    ``split_tensor_along_last_dim`` + own-rank select, ``mappings.py:214``).
+    Under shard_map a "replicated" value is the full array on every shard, so
+    scatter == slice at ``axis_index``."""
+    n = _axis_size(axis)
+    if n is None or n == 1:
+        return x
+    dim = dim % x.ndim
+    if x.shape[dim] % n != 0:
+        raise ValueError(
+            f"dim {dim} size {x.shape[dim]} not divisible by axis "
+            f"{axis!r} size {n}")
+    chunk = x.shape[dim] // n
+    idx = lax.axis_index(axis)
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=dim)

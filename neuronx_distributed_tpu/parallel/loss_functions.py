@@ -1,0 +1,105 @@
+"""Vocab-parallel loss functions.
+
+Analogue of the reference's ``parallel_layers/loss_functions.py``
+(``_ParallelCrossEntropy:10``, ``parallel_cross_entropy:217``,
+``DistributedLogprob:152``): cross-entropy over logits whose vocab dim is
+sharded across the tp axis, computed without ever materialising the full
+logits — local max → pmax, masked local label logit → psum, local exp-sum →
+psum. The backward (softmax − one-hot) falls out of JAX autodiff over the
+same collectives, so no hand-written VJP is needed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import comm, mappings
+from . import mesh as ps
+
+# NOTE: every reduction on a differentiated path below goes through
+# ``mappings.reduce_from_tensor_parallel_region`` (custom_vjp: fwd psum, bwd
+# identity). A raw ``lax.psum`` under ``shard_map(check_vma=False)`` would
+# transpose to another psum and inflate gradients by the axis size.
+
+
+def _rank_or_zero(axis: str):
+    if comm._axis_size(axis) is None:
+        return 0
+    return lax.axis_index(axis)
+
+
+def parallel_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    axis: str = ps.TP_AXIS,
+    label_smoothing: float = 0.0,
+    ignore_index: Optional[int] = None,
+) -> jax.Array:
+    """Per-token cross-entropy loss over vocab-sharded logits.
+
+    Args:
+      logits: ``[..., V_local]`` (local shard under shard_map, full vocab
+        otherwise).
+      labels: integer ``[...]`` global vocab ids.
+      ignore_index: label value whose loss contribution is zeroed.
+
+    Returns per-token losses ``[...]`` (reference returns unreduced loss too,
+    ``loss_functions.py:217``).
+    """
+    n = comm._axis_size(axis)
+    vocab_local = logits.shape[-1]
+    rank = _rank_or_zero(axis)
+    start = rank * vocab_local
+
+    logits = logits.astype(jnp.float32)
+    # numerically stable global max; the shift carries no gradient
+    local_max = jnp.max(logits, axis=-1)
+    if n is not None and n > 1:
+        global_max = lax.pmax(lax.stop_gradient(local_max), axis)
+    else:
+        global_max = lax.stop_gradient(local_max)
+    shifted = logits - global_max[..., None]
+
+    # global sum of exp
+    sum_exp = jnp.sum(jnp.exp(shifted), axis=-1)
+    sum_exp = mappings.reduce_from_tensor_parallel_region(sum_exp, axis)
+
+    # label logit: mask ids outside this shard's vocab range
+    local_labels = labels - start
+    valid = (local_labels >= 0) & (local_labels < vocab_local)
+    safe = jnp.where(valid, local_labels, 0)
+    label_logit = jnp.take_along_axis(shifted, safe[..., None], axis=-1)[..., 0]
+    label_logit = jnp.where(valid, label_logit, 0.0)
+    label_logit = mappings.reduce_from_tensor_parallel_region(label_logit, axis)
+
+    loss = jnp.log(sum_exp) - label_logit
+
+    if label_smoothing > 0.0:
+        vocab = vocab_local * (n or 1)
+        # smoothed loss adds eps * (logsumexp - mean(logits))
+        mean_logit = mappings.reduce_from_tensor_parallel_region(
+            jnp.sum(shifted, axis=-1), axis) / vocab
+        loss = (1.0 - label_smoothing) * loss + label_smoothing * (
+            jnp.log(sum_exp) - mean_logit)
+
+    if ignore_index is not None:
+        loss = jnp.where(labels == ignore_index, 0.0, loss)
+    return loss
+
+
+def distributed_log_softmax(logits: jax.Array,
+                            axis: str = ps.TP_AXIS) -> jax.Array:
+    """Log-softmax over the sharded vocab dim (reference
+    ``DistributedLogprob:152``); returns the local shard of log-probs."""
+    logits = logits.astype(jnp.float32)
+    local_max = lax.stop_gradient(jnp.max(logits, axis=-1))
+    n = comm._axis_size(axis)
+    global_max = lax.pmax(local_max, axis) if (n and n > 1) else local_max
+    shifted = logits - global_max[..., None]
+    sum_exp = mappings.reduce_from_tensor_parallel_region(
+        jnp.sum(jnp.exp(shifted), axis=-1), axis)
+    return shifted - jnp.log(sum_exp)[..., None]

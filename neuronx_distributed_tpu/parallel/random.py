@@ -1,0 +1,45 @@
+"""Parallel RNG state management.
+
+Analogue of the reference's ``parallel_layers/random.py``
+(``XLARNGStatesTracker:20``, ``model_parallel_xla_manual_seed:100``): TP ranks
+need *different* streams for tp-sharded weight init / dropout inside the TP
+region, and the *same* stream for replicated init. In JAX this is
+``jax.random.fold_in`` of the axis index — functional, no mutable tracker.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax import lax
+
+from . import comm
+from . import mesh as ps
+
+# The reference offsets the tp stream by 2718 (random.py:100); we keep the
+# constant for checkpoint-reproducibility documentation, not bit-parity.
+TENSOR_PARALLEL_SEED_OFFSET = 2718
+
+
+def fold_in_bound_axes(key: jax.Array,
+                       axes: Sequence[str] = (ps.TP_AXIS,)) -> jax.Array:
+    """Fold the index of each *bound* axis into ``key`` — shards along those
+    axes get decorrelated streams; unbound axes (GSPMD path) leave the key
+    unchanged (GSPMD random ops are sharded by XLA itself)."""
+    for ax in axes:
+        if comm._axis_size(ax):
+            key = jax.random.fold_in(key, lax.axis_index(ax))
+    return key
+
+
+def model_parallel_rng(key: jax.Array) -> jax.Array:
+    """Stream for tp-region randomness (dropout inside attention/MLP shards):
+    differs per tp rank (reference ``get_xla_rng_tracker().fork()``)."""
+    key = jax.random.fold_in(key, TENSOR_PARALLEL_SEED_OFFSET)
+    return fold_in_bound_axes(key, (ps.TP_AXIS,))
+
+
+def data_parallel_rng(key: jax.Array) -> jax.Array:
+    """Stream differing per dp (and cp) shard — e.g. for data augmentation."""
+    return fold_in_bound_axes(key, (ps.DP_AXIS, ps.CP_AXIS))

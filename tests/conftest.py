@@ -1,0 +1,31 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+This replaces both the reference's ``NXD_CPU_MODE`` gloo fallback and its
+``mock_distributed`` single-process tracing (SURVEY §4): in JAX the same SPMD
+code runs unchanged on ``--xla_force_host_platform_device_count=8`` CPU
+devices.
+"""
+
+import os
+
+# Must be set before the CPU backend initialises.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize pins jax_platforms to the TPU plugin; tests always
+# run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from neuronx_distributed_tpu.parallel import mesh as ps  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_parallel_state():
+    yield
+    ps.destroy_model_parallel()

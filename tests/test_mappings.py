@@ -1,0 +1,159 @@
+"""Collective-mapping forward/backward pair tests (reference test model:
+``test/unit_test/parallel_layers`` mappings coverage — here we can run real
+collectives on the virtual CPU mesh instead of mocking them)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mappings, mesh as ps
+
+
+def _tp_mesh(tp=4):
+    return ps.initialize_model_parallel(tensor_model_parallel_size=tp)
+
+
+def _run_shard_map(f, mesh, in_specs, out_specs, *args):
+    return jax.jit(ps.shard_map(f, mesh, in_specs=in_specs,
+                                 out_specs=out_specs))(*args)
+
+
+def test_copy_forward_identity_backward_allreduce():
+    mesh = _tp_mesh()
+    x = jnp.arange(8.0)
+
+    def f(x):
+        # grad wrt x of sum(copy(x)) should be psum(ones) = tp (each shard's
+        # cotangent summed across the axis)
+        y = mappings.copy_to_tensor_parallel_region(x)
+        val, grad = jax.value_and_grad(lambda t: jnp.sum(
+            mappings.copy_to_tensor_parallel_region(t)))(x)
+        return y, grad
+
+    y, grad = _run_shard_map(f, mesh, P(None), P(None), x)
+    np.testing.assert_allclose(y, x)
+    np.testing.assert_allclose(grad, np.full(8, 4.0))
+
+
+def test_reduce_forward_allreduce_backward_identity():
+    mesh = _tp_mesh()
+    x = jnp.ones((4, 8))
+
+    def f(x):
+        y = mappings.reduce_from_tensor_parallel_region(x)
+        grad = jax.grad(lambda t: jnp.sum(
+            mappings.reduce_from_tensor_parallel_region(t)))(x)
+        return y, grad
+
+    # x sharded on dim 1: each shard holds ones of width 2; psum of the
+    # replicated-output f means... keep x replicated instead for clarity
+    y, grad = _run_shard_map(f, mesh, P(None, None), (P(None, None), P(None, None)), x)
+    np.testing.assert_allclose(y, np.full((4, 8), 4.0))
+    np.testing.assert_allclose(grad, np.ones((4, 8)))
+
+
+def test_scatter_gather_roundtrip():
+    mesh = _tp_mesh()
+    x = jnp.arange(16.0).reshape(2, 8)
+
+    def f(x):
+        local = mappings.scatter_to_tensor_parallel_region(x, dim=-1)
+        full = mappings.gather_from_tensor_parallel_region(local, dim=-1)
+        return local.shape[-1] * jnp.ones(()), full
+
+    width, full = _run_shard_map(f, mesh, P(None, None),
+                                 (P(), P(None, None)), x)
+    assert int(width) == 2
+    np.testing.assert_allclose(full, x)
+
+
+def test_gather_backward_is_split():
+    mesh = _tp_mesh()
+    x = jnp.ones((2, 2))  # local shard, full = (2, 8)
+
+    def f(x):
+        # d/dx sum(gather(x) * w) where w varies along gathered dim: grad
+        # should be the local slice of w summed over nothing
+        w = jnp.arange(8.0).reshape(1, 8)
+        grad = jax.grad(lambda t: jnp.sum(
+            mappings.gather_from_tensor_parallel_region(t, dim=-1) * w))(x)
+        return grad
+
+    grad = _run_shard_map(f, mesh, P(None, "tp"), P(None, "tp"),
+                          jnp.ones((2, 8)))
+    # shard i gets w slice [2i, 2i+1] broadcast over rows
+    expect = np.tile(np.arange(8.0), (2, 1))
+    np.testing.assert_allclose(grad, expect)
+
+
+def test_sequence_parallel_gather_reduce_scatter_pair():
+    mesh = _tp_mesh()
+    # local seq chunk: [B=1, S_local=2, H=2]; full S = 8
+    def f(x):
+        full = mappings.gather_from_sequence_parallel_region(
+            x, seq_dim=1, to_model_parallel=True)
+        # backward of gather(to_mp=True) = reduce-scatter: grads from each
+        # rank summed. loss = sum(full * rank_weight)
+        r = jax.lax.axis_index(ps.TP_AXIS).astype(jnp.float32)
+        grad = jax.grad(lambda t: jnp.sum(
+            mappings.gather_from_sequence_parallel_region(
+                t, seq_dim=1, to_model_parallel=True) * (r + 1.0)))(x)
+        return full, grad
+
+    x = jnp.ones((1, 8, 2))
+    full, grad = _run_shard_map(f, mesh, P(None, "tp", None),
+                                (P(None, None, None), P(None, "tp", None)), x)
+    assert full.shape == (1, 8, 2)
+    # each rank contributes (r+1) ones; reduce-scatter sums over ranks -> 10
+    np.testing.assert_allclose(grad, np.full((1, 8, 2), 10.0))
+
+
+def test_reduce_scatter_to_sequence_parallel():
+    mesh = _tp_mesh()
+
+    def f(x):
+        out = mappings.reduce_scatter_to_sequence_parallel_region(x, seq_dim=1)
+        return out
+
+    x = jnp.ones((1, 8, 2))
+    out = _run_shard_map(f, mesh, P(None, None, None), P(None, "tp", None), x)
+    assert out.shape == (1, 8, 2)
+    np.testing.assert_allclose(out, np.full((1, 8, 2), 4.0))
+
+
+def test_expert_parallel_all_to_all_roundtrip():
+    ps.initialize_model_parallel(tensor_model_parallel_size=1,
+                                 expert_model_parallel_size=4)
+    em = ps.get_expert_mesh()
+    # global [E=4, T=8, H=2]; each ep shard holds its token slice [4, 2, 2]
+    x = jnp.arange(4 * 8 * 2.0).reshape(4, 8, 2)
+
+    def f(x):
+        d = mappings.enter_expert_parallel_region(x, split_dim=0, concat_dim=1)
+        back = mappings.exit_expert_parallel_region(d, split_dim=1,
+                                                    concat_dim=0)
+        return d, back
+
+    d, back = jax.jit(ps.shard_map(
+        f, em, in_specs=P(None, "ep", None),
+        out_specs=(P("ep", None, None), P(None, "ep", None))))(x)
+    np.testing.assert_allclose(back, x)
+    # dispatch: expert dim sharded, every expert sees all 8 tokens
+    assert d.shape == (4, 8, 2)
+    np.testing.assert_allclose(np.asarray(d)[0], np.asarray(x)[0])
+
+
+def test_mappings_identity_when_axis_unbound():
+    # GSPMD path: outside shard_map every mapping is identity
+    _tp_mesh()
+    x = jnp.arange(8.0)
+    np.testing.assert_allclose(mappings.copy_to_tensor_parallel_region(x), x)
+    np.testing.assert_allclose(
+        mappings.reduce_from_tensor_parallel_region(x), x)
+    np.testing.assert_allclose(
+        mappings.gather_from_tensor_parallel_region(x, dim=0), x)
+    np.testing.assert_allclose(
+        mappings.scatter_to_tensor_parallel_region(x, dim=0), x)
