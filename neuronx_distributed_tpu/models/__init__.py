@@ -1,0 +1,6 @@
+"""Reference model families (reference: ``examples/training``/``inference``)."""
+
+from . import llama
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel
+
+__all__ = ["llama", "LlamaConfig", "LlamaForCausalLM", "LlamaModel"]

@@ -1,0 +1,11 @@
+"""Hot-path ops (reference: ``kernels/`` + ``operators/`` NKI wrappers).
+
+TPU equivalents are Pallas kernels with XLA fallbacks; every op keeps a
+reference implementation for CPU/interpret-mode testing, mirroring the
+reference's torch golden fallbacks (``moe/blockwise.py:326``).
+"""
+
+from . import flash_attention
+from .flash_attention import flash_attention as flash_attention_fn
+
+__all__ = ["flash_attention", "flash_attention_fn"]

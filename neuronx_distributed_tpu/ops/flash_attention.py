@@ -1,0 +1,89 @@
+"""Memory-efficient (flash) attention.
+
+Analogue of the reference's NKI flash attention wrapper
+(``kernels/flash_attn.py:162`` → ``nki.kernels.attention.flash_fwd/bwd``).
+
+Current implementation: blockwise online-softmax attention expressed with
+``lax.scan`` over KV blocks — O(S) memory instead of O(S²), fp32 accumulation,
+differentiable through JAX autodiff (the scan's VJP recomputes per-block,
+which is exactly the flash-backward memory profile). XLA fuses each block's
+QK^T → rescale → PV chain onto the MXU.
+
+A hand-tiled Pallas (Mosaic) kernel can be slotted in behind the same
+signature; this scan formulation is the golden reference for it (the
+reference keeps torch fallbacks for its NKI kernels the same way).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attention(q, k_blk, v_blk, q_pos, k_pos_start, block_k, causal,
+                     scale):
+    """Scores and partial PV for one KV block. q: [B,N,Sq,D],
+    k_blk/v_blk: [B,N,Bk,D]."""
+    s = jnp.einsum("bnqd,bnkd->bnqk", q, k_blk,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        k_pos = k_pos_start + jnp.arange(block_k)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    return s
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_k"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_k: int = 512,
+                    scale: Optional[float] = None) -> jax.Array:
+    """Blockwise attention. ``q/k/v: [B, S, N, D]`` (kv already GQA-expanded);
+    returns ``[B, S, N, D]``."""
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    block_k = min(block_k, sk)
+    if sk % block_k != 0:
+        # fall back to one block covering everything (static shapes only)
+        block_k = sk
+    nblocks = sk // block_k
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B,N,Sq,D]
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    q_pos = jnp.arange(sq)
+
+    kb = kt.reshape(b, n, nblocks, block_k, d)
+    vb = vt.reshape(b, n, nblocks, block_k, d)
+
+    def body(carry, blk):
+        m_prev, l_prev, acc = carry
+        k_blk, v_blk, idx = blk
+        s = _block_attention(qt, k_blk, v_blk, q_pos, idx * block_k, block_k,
+                             causal, scale)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # guard fully-masked rows (m_new = -inf) against NaNs
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        correction = jnp.where(jnp.isfinite(m_prev),
+                               jnp.exp(m_prev - m_safe), 0.0)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bnqk,bnkd->bnqd", p, v_blk, preferred_element_type=jnp.float32)
+        return (m_new, l_new, acc), None
+
+    m0 = jnp.full((b, n, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, n, sq), jnp.float32)
+    acc0 = jnp.zeros((b, n, sq, d), jnp.float32)
+    blks = (jnp.moveaxis(kb, 2, 0), jnp.moveaxis(vb, 2, 0),
+            jnp.arange(nblocks))
+    (m, l, acc), _ = lax.scan(body, (m0, l0, acc0), blks)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)

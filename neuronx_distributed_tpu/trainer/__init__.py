@@ -1,0 +1,21 @@
+"""Training orchestration (reference: ``trainer/`` + ``optimizer/``)."""
+
+from . import optimizer
+from . import trainer
+from .trainer import (
+    TrainState,
+    ParallelModel,
+    initialize_parallel_model,
+    initialize_parallel_optimizer,
+    make_train_step,
+)
+
+__all__ = [
+    "optimizer",
+    "trainer",
+    "TrainState",
+    "ParallelModel",
+    "initialize_parallel_model",
+    "initialize_parallel_optimizer",
+    "make_train_step",
+]

@@ -1,0 +1,119 @@
+"""End-to-end training slice: tiny Llama, TP×DP GSPMD, loss goes down.
+
+This is the reference's minimum integration test
+(``test/integration/parallel_layers/test_layers.py`` convergence style) on
+the virtual CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_config
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.trainer import (
+    initialize_parallel_model,
+    initialize_parallel_optimizer,
+    make_train_step,
+)
+
+
+def _make_batch(rng, batch=8, seq=32, vocab=256):
+    ids = jax.random.randint(rng, (batch, seq + 1), 0, vocab)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+
+@pytest.mark.parametrize("zero1", [False, True])
+def test_tiny_llama_loss_decreases(zero1):
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=2,
+        optimizer_config=nxd.OptimizerConfig(zero_one_enabled=zero1),
+    )
+    mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32)
+    model = LlamaForCausalLM(mcfg)
+    sample = _make_batch(jax.random.key(0))
+
+    pm, params = initialize_parallel_model(
+        cfg, model, jax.random.key(1), sample["input_ids"])
+    tx, state, state_shardings = initialize_parallel_optimizer(
+        pm, params, learning_rate=1e-3)
+    step = make_train_step(pm, tx, state_shardings)
+
+    # overfit a fixed batch
+    batch = _make_batch(jax.random.key(2))
+    losses = []
+    for _ in range(10):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.9, losses
+    assert np.isfinite(losses).all()
+    assert int(state.step) == 10
+
+
+def test_zero1_opt_state_sharded_over_dp():
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=2,
+        optimizer_config=nxd.OptimizerConfig(zero_one_enabled=True),
+    )
+    mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32)
+    model = LlamaForCausalLM(mcfg)
+    sample = _make_batch(jax.random.key(0))
+    pm, params = initialize_parallel_model(
+        cfg, model, jax.random.key(1), sample["input_ids"])
+    tx, state, state_shardings = initialize_parallel_optimizer(pm, params)
+
+    # find the mu tree sharding of a big kernel: must mention dp
+    leaves = jax.tree_util.tree_leaves(
+        state_shardings.opt_state,
+        is_leaf=lambda s: hasattr(s, "spec"))
+    dp_sharded = [s for s in leaves
+                  if hasattr(s, "spec") and any(
+                      ax in ("dp", ("dp", "cp")) for ax in s.spec if ax)]
+    assert dp_sharded, "no optimizer-state leaf sharded over dp"
+
+
+def test_sequence_parallel_shard_map_matches_gspmd():
+    """Full tiny-llama loss under explicit shard_map TP+SP equals the
+    single-device computation."""
+    from jax.sharding import PartitionSpec as P
+
+    nxd.neuronx_distributed_config(tensor_parallel_size=4)
+    mesh = ps.get_mesh()
+    mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                       sequence_parallel=True, scan_layers=False, tp_size=4)
+    model = LlamaForCausalLM(mcfg)
+    batch = _make_batch(jax.random.key(2), batch=2, seq=16)
+
+    from flax.core import meta
+    boxed = model.init(jax.random.key(1), batch["input_ids"])
+    from flax import linen as nn
+    specs = nn.get_partition_spec(boxed)
+    params = meta.unbox(boxed)
+
+    def loss_of(p, ids, labels):
+        return model.apply(p, ids, labels, method="loss")
+
+    # single-device reference (mappings unbound -> identity)
+    ref, ref_grads = jax.value_and_grad(loss_of)(
+        params, batch["input_ids"], batch["labels"])
+
+    def val_and_grad(p, ids, labels):
+        return jax.value_and_grad(loss_of)(p, ids, labels)
+
+    sharded, grads = jax.jit(ps.shard_map(
+        val_and_grad, mesh,
+        in_specs=(specs, P(None, None), P(None, None)),
+        out_specs=(P(), specs)))(params, batch["input_ids"], batch["labels"])
+    np.testing.assert_allclose(float(sharded), float(ref), rtol=2e-4)
+    # gradient parity — catches double-reduction bugs in the SP collective
+    # pairing (each grad must match the dense computation, not a tp multiple)
+    flat_ref = jax.tree_util.tree_leaves_with_path(ref_grads)
+    flat = dict(jax.tree_util.tree_leaves_with_path(grads))
+    for path, rg in flat_ref:
+        g = flat[path]
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(rg), rtol=5e-3, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path))
