@@ -1,0 +1,109 @@
+"""Benchmark entry point.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Run on real TPU hardware by the driver. Measures training throughput
+(tokens/sec/chip) of the flagship Llama model on the available chips; the
+model is scaled to fit the chip count (1 chip -> a ~300M-param llama slice;
+8 chips -> Llama-2-7B TP=8, the reference's canonical config,
+``examples/training/llama/tp_zero1_llama_hf_pretrain``).
+
+The reference repo publishes no in-tree numbers (BASELINE.md), so
+``vs_baseline`` is reported against the recorded value in BENCH_BASELINE.json
+(created on first run) — i.e. it tracks our own progression.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models import llama
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+        make_train_step,
+    )
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+
+    if n_dev >= 8:
+        # Llama-2-7B TP=8 + ZeRO-1 + remat: the reference's canonical config
+        mcfg = llama.LLAMA2_7B
+        tp = 8
+        batch, seq = 4, 2048
+        mcfg = llama.LlamaConfig(
+            **{**mcfg.__dict__, "max_seq_len": seq, "remat": True})
+    else:
+        # single-chip slice: ~350M params, bf16 compute
+        mcfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=16, num_heads=16, num_kv_heads=16, max_seq_len=2048,
+            remat=True)
+        tp = 1
+        batch, seq = 8, 2048
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=tp,
+        optimizer_config=nxd.OptimizerConfig(zero_one_enabled=True),
+        sequence_parallel=False,
+    )
+
+    model = llama.LlamaForCausalLM(mcfg)
+    rng = jax.random.key(0)
+    ids = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0,
+                             mcfg.vocab_size)
+    batch_data = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+    pm, params = initialize_parallel_model(cfg, model, rng,
+                                           batch_data["input_ids"])
+    tx, state, state_shardings = initialize_parallel_optimizer(
+        pm, params, learning_rate=1e-4)
+    step = make_train_step(pm, tx, state_shardings)
+
+    # warmup/compile
+    state, m = step(state, batch_data)
+    jax.block_until_ready(m["loss"])
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, batch_data)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * iters
+    tok_per_sec_per_chip = tokens / dt / n_dev
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_BASELINE.json")
+    vs_baseline = 1.0
+    try:
+        if os.path.exists(baseline_path):
+            base = json.load(open(baseline_path))
+            if base.get("value"):
+                vs_baseline = tok_per_sec_per_chip / base["value"]
+        else:
+            json.dump({"value": tok_per_sec_per_chip,
+                       "platform": platform, "n_dev": n_dev},
+                      open(baseline_path, "w"))
+    except Exception:
+        pass
+
+    print(json.dumps({
+        "metric": f"llama_train_tokens_per_sec_per_chip_{platform}{n_dev}",
+        "value": round(tok_per_sec_per_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
