@@ -1,0 +1,171 @@
+"""Pipeline-parallel Llama training path.
+
+The analogue of the reference's llama + ``NxDPPModel`` composition
+(``examples/training/llama/tp_pp_llama_hf_pretrain/run_llama_nxd.py``,
+``pipeline/model.py:74``): the decoder stack is partitioned over the ``pp``
+mesh axis (layer-stacked params sharded on their leading scan dim — the
+partition is a *sharding*, not an fx graph split), the embedding runs on
+stage 0 and the norm+LM-head+loss on the last stage, and the microbatch
+schedule executes as one scanned SPMD program (:mod:`..pipeline.spmd_engine`).
+
+Params are byte-compatible with :class:`..models.llama.LlamaForCausalLM`
+(``scan_layers=True``) — the same checkpoint trains with or without pp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..modules import attention as attn_mod
+from ..modules.norms import RMSNorm
+from ..parallel import layers as pl
+from ..parallel import loss_functions as lf
+from ..parallel import mappings
+from ..parallel import mesh as ps
+from ..pipeline import spmd_engine as eng
+from .llama import LlamaConfig, _ScanBody
+
+PIPELINE_LOGICAL_RULES = {"layers": ps.PP_AXIS}
+
+
+def pipelined_loss_fn(cfg: LlamaConfig, num_microbatches: int,
+                      ignore_index: int = -100):
+    """Build ``pp_loss(params, ids, labels) -> scalar`` to run inside
+    shard_map over the full (pp, dp, cp, tp) mesh.
+
+    ``params`` is the LlamaForCausalLM variables dict whose scanned-layer
+    leaves arrive pp-sharded (leading dim L/S locally).
+    """
+    if not cfg.scan_layers:
+        raise ValueError("pipeline path requires scan_layers=True")
+
+    embed_mod = pl.ParallelEmbedding(
+        num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+    norm_mod = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
+                       sequence_parallel=cfg.sequence_parallel)
+    head_mod = pl.ColumnParallelLinear(
+        features=cfg.vocab_size, use_bias=False, gather_output=False,
+        sequence_parallel=cfg.sequence_parallel,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+
+    def pp_loss(params, ids, labels):
+        p = params["params"]
+        S = ps.get_pipeline_model_parallel_size()
+        M = num_microbatches
+        if cfg.num_layers % S != 0:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by pp {S}")
+        l_local = cfg.num_layers // S
+
+        cos, sin = attn_mod.precompute_rope(
+            cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta,
+            use_scaled=cfg.rope_scaling)
+
+        # ---- stage 0: embedding (pp-replicated params; grads assembled
+        # from stage 0 via copy_to's backward psum)
+        embed_p = jax.tree_util.tree_map(eng.stage_replicated_param,
+                                         p["model"]["embed"])
+        x = embed_mod.apply({"params": embed_p}, ids)
+        if cfg.sequence_parallel:
+            x = mappings.scatter_to_sequence_parallel_region(x, seq_dim=1)
+        x_mb = eng.microbatch(x, M)
+
+        # ---- pipelined decoder stack over local layers
+        body = nn.scan(
+            _ScanBody,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+            length=l_local,
+        )(cfg)
+
+        def stage_fn(act):
+            out, _ = body.apply({"params": p["model"]["layers"]}, act, cos,
+                                sin, None)
+            return out
+
+        if cfg.remat:
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+        outs = eng.pipeline_spmd(stage_fn, x_mb, S, M)
+
+        # ---- last stage: final norm + LM head + vocab-parallel CE,
+        # accumulated per microbatch
+        norm_p = jax.tree_util.tree_map(eng.stage_replicated_param,
+                                        p["model"]["norm"])
+        head_p = jax.tree_util.tree_map(eng.stage_replicated_param,
+                                        p["lm_head"])
+        labels_mb = eng.microbatch(labels, M)
+
+        def mb_loss(carry, om):
+            o, lb = om
+            h = norm_mod.apply({"params": norm_p}, o)
+            logits = head_mod.apply({"params": head_p}, h)
+            per_tok = lf.parallel_cross_entropy(logits, lb,
+                                                ignore_index=ignore_index)
+            n_valid = jnp.sum((lb != ignore_index).astype(jnp.float32))
+            return (carry[0] + jnp.sum(per_tok), carry[1] + n_valid), None
+
+        (loss_sum, denom), _ = jax.lax.scan(
+            mb_loss, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (outs, labels_mb))
+        local = loss_sum / jnp.maximum(denom, 1.0)
+        loss = eng.last_stage_value(local)
+        return eng.data_parallel_mean(loss)
+
+    return pp_loss
+
+
+def make_pipeline_grad_fn(cfg: LlamaConfig, num_microbatches: int,
+                          param_specs: Any,
+                          ignore_index: int = -100):
+    """Build ``grad_fn(params, batch) -> (loss, grads)`` for
+    :func:`..trainer.make_train_step`.
+
+    Gradients are computed *inside* shard_map and synchronised over the data
+    axes with raw psum before crossing the boundary as primal outputs
+    (see :mod:`..parallel.grads` — cotangents must not cross the shard_map
+    boundary). ``param_specs``: the ParallelModel's spec tree (built with
+    ``logical_axis_rules=PIPELINE_LOGICAL_RULES``).
+    """
+    from ..parallel import grads as grads_mod
+
+    pp_loss = pipelined_loss_fn(cfg, num_microbatches, ignore_index)
+
+    def inner(params, ids, labels):
+        loss, g = jax.value_and_grad(pp_loss)(params, ids, labels)
+        g = grads_mod.allreduce_gradients(g, specs=param_specs)
+        return loss, g
+
+    def grad_fn(params, batch):
+        mesh = ps.get_mesh()
+        return ps.shard_map(
+            inner, mesh,
+            in_specs=(param_specs, P(ps.DP_AXIS, None), P(ps.DP_AXIS, None)),
+            out_specs=(P(), param_specs))(
+                params, batch["input_ids"], batch["labels"])
+
+    return grad_fn
+
+
+def make_pipeline_eval_fn(cfg: LlamaConfig, num_microbatches: int,
+                          param_specs: Any, ignore_index: int = -100):
+    """Forward-only pipelined loss (reference ``NxDPPModel.run_eval``)."""
+    pp_loss = pipelined_loss_fn(cfg, num_microbatches, ignore_index)
+
+    def eval_fn(params, batch):
+        mesh = ps.get_mesh()
+        return ps.shard_map(
+            pp_loss, mesh,
+            in_specs=(param_specs, P(ps.DP_AXIS, None), P(ps.DP_AXIS, None)),
+            out_specs=P())(params, batch["input_ids"], batch["labels"])
+
+    return eval_fn

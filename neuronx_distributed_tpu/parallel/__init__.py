@@ -3,6 +3,7 @@
 from . import mesh
 from . import comm
 from . import mappings
+from . import grads
 from . import layers
 from . import loss_functions
 from . import random

@@ -1,0 +1,111 @@
+"""Gradient synchronisation and norms for the explicit (shard_map) path.
+
+Analogue of the reference's ``parallel_layers/grads.py``
+(``bucket_allreduce_gradients:259`` over DP, SP-grad all-reduce ``:330``,
+CP-grad all-reduce ``:348``, ``get_grad_norm:41`` / ``clip_grad_norm:192``
+with TP dedup).
+
+Design rule (pinned by tests/test_pipeline.py): gradients are computed
+*inside* ``shard_map`` with ``jax.value_and_grad`` and synchronised there
+with **raw collectives** before crossing the boundary as primal outputs.
+Cotangents must never cross the shard_map boundary: with ``check_vma=False``
+the boundary transpose rescales them (claimed-replicated outputs seed
+``ct/N``), which silently mis-scales parameter gradients. No bucketing is
+needed — XLA fuses and schedules the gradient all-reduces during the
+backward (the role of the reference's reverse-order buckets +
+``ALLREDUCE_BUCKET_CAP_MB``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from . import comm
+from . import mesh as ps
+
+
+def _spec_axes(spec) -> set:
+    axes = set()
+    if isinstance(spec, PartitionSpec):
+        for p in spec:
+            if p is None:
+                continue
+            if isinstance(p, tuple):
+                axes.update(p)
+            else:
+                axes.add(p)
+    return axes
+
+
+def allreduce_gradients(
+    grads: Any,
+    specs: Optional[Any] = None,
+    axes: Sequence[str] = (ps.DP_AXIS, ps.CP_AXIS),
+) -> Any:
+    """Average gradients over the bound data axes (reference
+    ``bucket_allreduce_gradients:259`` + CP reduce ``:348``).
+
+    Convention (pinned by tests/test_pipeline.py): the loss is the *global
+    mean* over tokens, expressed per-shard as the local mean then
+    ``lax.pmean`` over data axes. Inside shard_map the pmean's psum-transpose
+    hands each shard the *full* cotangent of its local-mean loss, so the
+    per-shard grads are ``d(local_mean_loss)/dw`` and the correct global
+    combination is their **mean** over the data axes (the reference
+    equivalently pre-scales by 1/world before its all-reduce).
+
+    ``specs``: optional PartitionSpec tree; a leaf already sharded over one
+    of ``axes`` (e.g. FSDP-style params) is not reduced over that axis.
+    """
+    bound = [ax for ax in axes if comm._axis_size(ax) not in (None, 1)]
+    if not bound:
+        return grads
+
+    def reduce_leaf(g, spec=None):
+        mentioned = _spec_axes(spec) if spec is not None else set()
+        for ax in bound:
+            if ax not in mentioned:
+                g = lax.pmean(g, ax)
+        return g
+
+    if specs is None:
+        return jax.tree_util.tree_map(reduce_leaf, grads)
+    return jax.tree_util.tree_map(
+        reduce_leaf, grads, specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def global_grad_norm(grads: Any, specs: Optional[Any] = None) -> jax.Array:
+    """Global L2 norm across every shard (reference ``get_grad_norm:41``):
+    each leaf contributes its local sum-of-squares, psum'd over the axes the
+    leaf is sharded on (mentioned axes), then summed. Replicated leaves
+    contribute once — the analogue of the reference's duplicate-param dedup.
+    """
+    def leaf_sq(g, spec=None):
+        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        for ax in _spec_axes(spec) if spec is not None else set():
+            if comm._axis_size(ax) not in (None, 1):
+                sq = lax.psum(sq, ax)
+        return sq
+
+    if specs is None:
+        leaves = [leaf_sq(g) for g in jax.tree_util.tree_leaves(grads)]
+    else:
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_s = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+        leaves = [leaf_sq(g, s) for g, s in zip(flat_g, flat_s)]
+    return jnp.sqrt(sum(leaves))
+
+
+def clip_grad_norm(grads: Any, max_norm: float,
+                   specs: Optional[Any] = None) -> Tuple[Any, jax.Array]:
+    """Clip by global norm (reference ``clip_grad_norm:192``); returns
+    ``(clipped_grads, norm)``."""
+    norm = global_grad_norm(grads, specs)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads), norm

@@ -1,0 +1,9 @@
+"""Pipeline parallelism (reference: ``pipeline/``)."""
+
+from . import schedules
+from . import spmd_engine
+from .schedules import make_schedule
+from .spmd_engine import microbatch, pipeline_spmd
+
+__all__ = ["schedules", "spmd_engine", "make_schedule", "microbatch",
+           "pipeline_spmd"]

@@ -1,0 +1,132 @@
+"""SPMD pipeline-parallel execution engine.
+
+TPU-native replacement for the reference's ``NxDPPModel`` executor
+(``pipeline/model.py:74``, exec loop ``_exec_schedule:1728``) and its
+send/recv layer (``pipeline/comm.py`` — all-gather over 2-rank groups because
+Neuron lacks p2p). Here the *entire* pipeline — all stages, all microbatches
+— is ONE jitted SPMD program:
+
+* stages = shards of the ``pp`` mesh axis (layer-stacked params sharded on
+  their leading dim);
+* stage IO = ``lax.ppermute`` (true collective-permute — strictly better
+  than the reference's all-gather emulation, SURVEY §5);
+* the microbatch clock = ``lax.scan`` over ``M + S - 1`` ticks (the GPipe
+  task list of :mod:`.schedules` flattened into a scanned steady state);
+* the backward pipeline is *derived by autodiff*: the transpose of
+  ``ppermute`` is the reverse-edge ppermute, so ``jax.grad`` of this program
+  is itself a reverse-order pipeline with the same bubble structure —
+  replacing the reference's hand-written ``_bwd_*`` task bodies and
+  ``custom_backward`` send-tensor bookkeeping (``pipeline/model.py:1183``).
+
+Gradient-correctness invariants (empirically pinned by
+``tests/test_pipeline.py``; see also mappings.py):
+
+* under ``shard_map(check_vma=False)`` the boundary transpose applies
+  **pmean over every mesh axis a param's in_spec does not mention**;
+* therefore: loss reductions over data axes use raw ``lax.pmean`` inside;
+  the final loss is taken off the last stage via
+  ``reduce_from_tensor_parallel_region`` over ``pp`` (bwd identity), and
+  pp-replicated params consumed on a single stage (embedding on stage 0, head
+  on stage S-1) are wrapped in ``copy_to_tensor_parallel_region`` over ``pp``
+  (bwd psum) so the boundary pmean sees identical values on every rank.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel import comm, mappings
+from ..parallel import mesh as ps
+
+
+def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...] (reference: microbatch slicing in
+    ``NxDPPModel.run_train``)."""
+    if x.shape[0] % num_microbatches != 0:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by num_microbatches "
+            f"{num_microbatches}")
+    return x.reshape(num_microbatches, x.shape[0] // num_microbatches,
+                     *x.shape[1:])
+
+
+def pipeline_spmd(
+    stage_fn: Callable[[jax.Array], jax.Array],
+    x_mb: jax.Array,
+    num_stages: int,
+    num_microbatches: int,
+    axis: str = ps.PP_AXIS,
+) -> jax.Array:
+    """Run the scanned GPipe pipeline. Must be called with ``axis`` bound
+    (inside shard_map).
+
+    Args:
+      stage_fn: this stage's computation, applied to one microbatch of
+        activations (closing over this stage's local params).
+      x_mb: ``[M, mb, ...]`` stage-0 input microbatches (replicated over pp).
+
+    Returns ``[M, mb, ...]`` outputs, **valid on the last pp rank only**
+    (other ranks carry bubble garbage; mask before use).
+    """
+    S, M = num_stages, num_microbatches
+    bound = comm._axis_size(axis)
+    if bound is None and S > 1:
+        raise ValueError(
+            f"pipeline_spmd with num_stages={S} requires the {axis!r} axis "
+            "to be bound (call inside shard_map over the mesh); unbound it "
+            "would silently run only 1/S of the layers")
+    if bound is not None and bound != S:
+        raise ValueError(f"pp axis size {bound} != num_stages {S}")
+    my = lax.axis_index(axis) if bound else 0
+    ticks = M + S - 1
+    perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(act, t):
+        inp = lax.dynamic_index_in_dim(x_mb, jnp.minimum(t, M - 1), 0,
+                                       keepdims=False)
+        act_in = jnp.where(my == 0, inp, act)
+        out = stage_fn(act_in)
+        act_next = comm.ppermute(out, axis, perm)
+        return act_next, out
+
+    act0 = jnp.zeros_like(x_mb[0])
+    _, ys = lax.scan(tick, act0, jnp.arange(ticks))
+    # microbatch m finishes on the last stage at tick m + S - 1
+    return ys[S - 1:]
+
+
+def last_stage_value(x: jax.Array, axis: str = ps.PP_AXIS) -> jax.Array:
+    """Select ``x`` from the last pp rank and replicate it (fwd psum of the
+    masked value; bwd identity so cotangents reach only the last stage)."""
+    n = comm._axis_size(axis)
+    if n is None or n == 1:
+        return x
+    my = lax.axis_index(axis)
+    masked = jnp.where(my == n - 1, x, jnp.zeros_like(x))
+    return mappings.reduce_from_tensor_parallel_region(masked, axis)
+
+
+def stage_replicated_param(p: jax.Array, axis: str = ps.PP_AXIS) -> jax.Array:
+    """Mark a pp-replicated param consumed by a subset of stages: forward
+    identity, backward psum over pp — composed with the shard_map boundary
+    pmean this yields exactly the true gradient on every rank."""
+    if comm._axis_size(axis) is None:
+        return p
+    return mappings.copy_to_tensor_parallel_region(p, axis)
+
+
+def data_parallel_mean(loss: jax.Array,
+                       axes: Tuple[str, ...] = (ps.DP_AXIS, ps.CP_AXIS)
+                       ) -> jax.Array:
+    """Average a per-shard loss over the data axes with raw ``pmean`` (its
+    psum-transpose composes with the boundary pmean to give exact grads —
+    see module docstring)."""
+    for ax in axes:
+        if comm._axis_size(ax):
+            loss = lax.pmean(loss, ax)
+    return loss

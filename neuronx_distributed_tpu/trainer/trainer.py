@@ -56,11 +56,18 @@ class ParallelModel:
             is_leaf=lambda s: isinstance(s, PartitionSpec))
 
 
-def _spec_tree(boxed_variables) -> Any:
+def _spec_tree(boxed_variables, logical_axis_rules=None) -> Any:
     """PartitionSpec tree from flax Partitioned metadata. Logical axis names
-    that are not mesh axes (e.g. the ``layers`` scan dim) are replicated."""
+    that are not mesh axes are mapped through ``logical_axis_rules`` (e.g.
+    ``{"layers": "pp"}`` for pipeline parallelism) and otherwise replicated."""
     specs = nn.get_partition_spec(boxed_variables)
     mesh_axes = set(ps.get_mesh().axis_names)
+    rules = logical_axis_rules or {}
+
+    def map_axis(a):
+        if a in mesh_axes:
+            return a
+        return rules.get(a)
 
     def clean(spec):
         if not isinstance(spec, PartitionSpec):
@@ -70,10 +77,11 @@ def _spec_tree(boxed_variables) -> Any:
             if p is None:
                 out.append(None)
             elif isinstance(p, tuple):
-                kept = tuple(a for a in p if a in mesh_axes)
+                kept = tuple(m for m in (map_axis(a) for a in p)
+                             if m is not None)
                 out.append(kept if kept else None)
             else:
-                out.append(p if p in mesh_axes else None)
+                out.append(map_axis(p))
         return PartitionSpec(*out)
 
     return jax.tree_util.tree_map(
@@ -86,6 +94,7 @@ def initialize_parallel_model(
     rng: jax.Array,
     *sample_args,
     method: Optional[Any] = None,
+    logical_axis_rules: Optional[dict] = None,
 ) -> Tuple[ParallelModel, Any]:
     """Shape-evaluate the model, derive param shardings from the layer
     partitioning metadata, and initialise params *already sharded* (XLA
@@ -98,7 +107,7 @@ def initialize_parallel_model(
 
     init_fn = functools.partial(module.init, method=method)
     boxed_shapes = jax.eval_shape(init_fn, rng, *sample_args)
-    specs = _spec_tree(boxed_shapes)
+    specs = _spec_tree(boxed_shapes, logical_axis_rules)
     shapes = jax.tree_util.tree_map(
         lambda x: tuple(x.shape), meta.unbox(boxed_shapes))
     shardings = jax.tree_util.tree_map(
@@ -154,27 +163,36 @@ def make_train_step(
     tx: optax.GradientTransformation,
     state_shardings: TrainState,
     loss_fn: Optional[Callable] = None,
+    grad_fn: Optional[Callable] = None,
     batch_spec: PartitionSpec = PartitionSpec(ps.DP_AXIS),
     donate: bool = True,
 ):
     """Build the jitted SPMD train step.
 
-    ``loss_fn(module, params, batch) -> scalar``; defaults to calling
-    ``module.apply(..., method="loss")`` with ``batch = (input_ids, labels)``.
-    The batch is sharded over dp (× cp along sequence when configured).
+    Either ``loss_fn(module, params, batch) -> scalar`` (differentiated here
+    under GSPMD; default calls ``module.apply(..., method="loss")``) or
+    ``grad_fn(params, batch) -> (loss, grads)`` for paths that must compute
+    gradients themselves (e.g. the shard_map pipeline engine, whose gradients
+    may not cross the shard_map boundary as cotangents — see
+    ``parallel/grads.py``).
     """
     mesh = ps.get_mesh()
 
-    if loss_fn is None:
+    if loss_fn is not None and grad_fn is not None:
+        raise ValueError(
+            "pass either loss_fn (differentiated here) or grad_fn "
+            "(self-differentiating, e.g. the pipeline engine), not both")
+    if loss_fn is None and grad_fn is None:
         def loss_fn(module, params, batch):
             input_ids, labels = batch["input_ids"], batch["labels"]
             return module.apply(params, input_ids, labels, method="loss")
 
     def step_fn(state: TrainState, batch) -> Tuple[TrainState, dict]:
-        def compute_loss(p):
-            return loss_fn(pm.module, p, batch)
-
-        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        if grad_fn is not None:
+            loss, grads = grad_fn(state.params, batch)
+        else:
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(pm.module, p, batch))(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
