@@ -103,7 +103,17 @@ class LlamaAttention(nn.Module):
         k = attn_mod.apply_rotary(k, cos, sin, positions)
         k = attn_mod.repeat_kv(k, n_q_local // n_kv_local)
         v = attn_mod.repeat_kv(v, n_q_local // n_kv_local)
-        if cfg.use_flash_attention:
+        from ..parallel import comm
+
+        cp = comm._axis_size(ps.CP_AXIS)
+        if cp is not None and cp > 1:
+            # context parallel: sequence sliced over cp; ring attention
+            # rotates KV around the cp ring (reference:
+            # kernels/ring_attention_kernel.py)
+            from ..ops.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, causal=True)
+        elif cfg.use_flash_attention:
             from ..ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)
@@ -167,6 +177,24 @@ class LlamaDecoderLayer(nn.Module):
         return x
 
 
+def context_parallel_positions(input_ids: jax.Array,
+                               positions: Optional[jax.Array]):
+    """Global rope positions when the sequence is sliced over cp: this
+    shard's tokens start at ``cp_rank * s_local`` (reference:
+    ``utils/batch_utils.py:19`` slices the batch; the ring kernel gets global
+    offsets). No-op when positions are given or cp is absent/1."""
+    if positions is not None:
+        return positions
+    from ..parallel import comm
+
+    cp = comm._axis_size(ps.CP_AXIS)
+    if cp is None or cp <= 1:
+        return None
+    b, s_local = input_ids.shape
+    start = jax.lax.axis_index(ps.CP_AXIS) * s_local
+    return jnp.broadcast_to(start + jnp.arange(s_local), (b, s_local))
+
+
 class _ScanBody(nn.Module):
     """nn.scan body: carries the hidden states, emits nothing."""
 
@@ -191,6 +219,7 @@ class LlamaModel(nn.Module):
             num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed")(
                 input_ids)
+        positions = context_parallel_positions(input_ids, positions)
         if cfg.sequence_parallel:
             x = mappings.scatter_to_sequence_parallel_region(x, seq_dim=1)
         cos, sin = attn_mod.precompute_rope(

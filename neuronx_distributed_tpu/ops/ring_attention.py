@@ -1,0 +1,98 @@
+"""Ring attention for context parallelism.
+
+Analogue of the reference's NKI ring attention wrapper
+(``kernels/ring_attention_kernel.py:118`` → ``nki_ring_attn_func``): each cp
+rank holds one sequence slice of Q/K/V; KV blocks rotate around the cp ring
+while each rank accumulates flash-style online-softmax partials for its local
+queries. The reference drives the ring with precomputed device ``src_tgt_pairs``
+(``parallel_state.py:737-742``); here the ring is ``lax.ppermute`` over the
+``cp`` mesh axis — the ring edges ARE the mesh axis ordering, which
+``initialize_model_parallel`` lays out along the ICI torus.
+
+Causal masking across ring steps: the kv block currently held at step ``i``
+originated at rank ``(r - i) mod cp``; queries attend with position masks
+computed from the *global* positions of both blocks, so causality holds
+exactly across the ring (SURVEY §7.3 flags this as the hard part the
+reference hides inside its NKI kernel).
+
+Differentiable through JAX autodiff (the scan+ppermute transpose is the
+reverse ring — same structure the pipeline engine relies on).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel import comm
+from ..parallel import mesh as ps
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis: str = ps.CP_AXIS,
+                   causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Ring attention over the cp axis.
+
+    ``q/k/v: [B, S_local, N, D]`` — this rank's sequence slice, kv already
+    GQA-expanded. Must be called with ``axis`` bound (inside shard_map);
+    falls back to plain attention when cp is absent/1.
+
+    Returns ``[B, S_local, N, D]``.
+    """
+    cp = comm._axis_size(axis)
+    if cp is None or cp == 1:
+        from ..modules.attention import sdpa_reference
+
+        return sdpa_reference(q, k, v, causal=causal, scale=scale)
+
+    b, s_local, n, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    r = lax.axis_index(axis)
+    qpos = r * s_local + jnp.arange(s_local)  # global query positions
+
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B,N,Sq,D]
+    ring_perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def accumulate(carry, k_cur, v_cur, i):
+        m_prev, l_prev, acc = carry
+        src = (r - i) % cp  # rank where this kv block originated
+        kt = jnp.swapaxes(k_cur, 1, 2).astype(jnp.float32)
+        vt = jnp.swapaxes(v_cur, 1, 2).astype(jnp.float32)
+        s = jnp.einsum("bnqd,bnkd->bnqk", qt, kt,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = src * s_local + jnp.arange(s_local)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe[..., None]), 0.0)
+        corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bnqk,bnkd->bnqd", p, vt, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    def step(carry, i):
+        m_prev, l_prev, acc, k_cur, v_cur = carry
+        m_new, l_new, acc = accumulate((m_prev, l_prev, acc), k_cur, v_cur, i)
+        k_next = comm.ppermute(k_cur, axis, ring_perm)
+        v_next = comm.ppermute(v_cur, axis, ring_perm)
+        return (m_new, l_new, acc, k_next, v_next), None
+
+    m0 = jnp.full((b, n, s_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, n, s_local), jnp.float32)
+    acc0 = jnp.zeros((b, n, s_local, d), jnp.float32)
+    # cp-1 rotating steps, then a final permute-free accumulate (uniform
+    # across ranks; saves two collectives per call)
+    (m, l, acc, k_last, v_last), _ = lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(cp - 1))
+    m, l, acc = accumulate((m, l, acc), k_last, v_last, cp - 1)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)

@@ -1,0 +1,5 @@
+"""Utilities (reference: ``utils/``)."""
+
+from . import batch_utils
+
+__all__ = ["batch_utils"]

@@ -1,0 +1,47 @@
+"""Context-parallel batch utilities.
+
+Analogue of the reference's ``utils/batch_utils.py`` (``shift_labels:4``,
+``get_batch_on_this_context_parallel_rank:19``): labels are shifted BEFORE
+the sequence is sliced across cp ranks, so token ``t``'s label (token
+``t+1``) stays on the same shard even at slice boundaries.
+
+Host-side slicing is only needed when feeding pre-sharded per-rank data; in
+the SPMD path the same slicing happens declaratively via a
+``PartitionSpec(dp, cp)`` on the batch's sequence dim.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def shift_labels(labels, ignore_index: int = -100):
+    """Shift left by one for next-token prediction (reference
+    ``shift_labels:4``)."""
+    shifted = np.roll(np.asarray(labels), -1, axis=1).copy()
+    shifted[:, -1] = ignore_index
+    return shifted
+
+
+def get_batch_on_this_context_parallel_rank(batch: Dict, cp_rank: int,
+                                            cp_size: int) -> Dict:
+    """Slice every [B, S, ...] tensor's sequence dim for one cp rank
+    (reference ``get_batch_on_this_context_parallel_rank:19``)."""
+    if cp_size == 1:
+        return batch
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        if v.ndim >= 2:
+            if v.shape[1] % cp_size != 0:
+                raise ValueError(
+                    f"batch[{k!r}] sequence length {v.shape[1]} not "
+                    f"divisible by cp_size {cp_size}")
+            chunk = v.shape[1] // cp_size
+            out[k] = v[:, cp_rank * chunk:(cp_rank + 1) * chunk]
+        else:
+            out[k] = v
+    return out
