@@ -1,6 +1,11 @@
 """Reference model families (reference: ``examples/training``/``inference``)."""
 
 from . import llama
+from . import llama_pipeline
+from . import mixtral
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel
+from .mixtral import MixtralConfig, MixtralForCausalLM
 
-__all__ = ["llama", "LlamaConfig", "LlamaForCausalLM", "LlamaModel"]
+__all__ = ["llama", "llama_pipeline", "mixtral", "LlamaConfig",
+           "LlamaForCausalLM", "LlamaModel", "MixtralConfig",
+           "MixtralForCausalLM"]

@@ -1,0 +1,155 @@
+"""Mixtral (MoE llama) model family.
+
+Parity target: the reference's mixtral training example
+(``examples/training/mixtral``) built from its ``MoE`` module — here the
+dense llama decoder with the MLP swapped for :class:`..modules.moe.MoE`,
+plus router auxiliary losses accumulated through the scanned layer stack.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..modules import attention as attn_mod
+from ..modules.moe import MoE
+from ..modules.norms import RMSNorm
+from ..parallel import layers as pl
+from ..parallel import loss_functions as lf
+from ..parallel import mappings
+from ..parallel import mesh as ps
+from .llama import LlamaAttention, LlamaConfig, context_parallel_positions
+
+
+@dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    router_type: str = "top_k"
+    shared_expert_intermediate: int = 0
+    router_aux_coef: float = 0.02
+    router_z_coef: float = 0.001
+
+
+MIXTRAL_8X7B = MixtralConfig(
+    vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+    num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=1e6,
+    num_experts=8, top_k=2)
+
+
+def tiny_moe_config(**kw) -> MixtralConfig:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+                num_experts=4, top_k=2)
+    base.update(kw)
+    return MixtralConfig(**base)
+
+
+class MixtralDecoderLayer(nn.Module):
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions=None):
+        cfg = self.cfg
+        h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
+                    sequence_parallel=cfg.sequence_parallel,
+                    name="input_norm")(x)
+        x = x + LlamaAttention(cfg, name="attn")(h, cos, sin, positions)
+        h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
+                    sequence_parallel=cfg.sequence_parallel,
+                    name="post_norm")(x)
+        moe_out, aux = MoE(
+            num_experts=cfg.num_experts, hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size, top_k=cfg.top_k,
+            capacity_factor=cfg.capacity_factor,
+            router_type=cfg.router_type,
+            shared_expert_intermediate=cfg.shared_expert_intermediate,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="moe")(h)
+        x = x + moe_out
+        aux_vec = jnp.stack([aux["load_balance_loss"], aux["z_loss"]])
+        return x, aux_vec
+
+
+class _MoEScanBody(nn.Module):
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions):
+        x, aux = MixtralDecoderLayer(self.cfg, name="layer")(
+            x, cos, sin, positions)
+        return x, aux
+
+
+class MixtralModel(nn.Module):
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        x = pl.ParallelEmbedding(
+            num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed")(
+                input_ids)
+        positions = context_parallel_positions(input_ids, positions)
+        if cfg.sequence_parallel:
+            x = mappings.scatter_to_sequence_parallel_region(x, seq_dim=1)
+        cos, sin = attn_mod.precompute_rope(
+            cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta,
+            use_scaled=cfg.rope_scaling)
+
+        if cfg.scan_layers:
+            body_cls = _MoEScanBody
+            if cfg.remat:
+                body_cls = nn.remat(
+                    body_cls, prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            scanned = nn.scan(
+                body_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")
+            x, aux = scanned(x, cos, sin, positions)
+            aux = jnp.sum(aux, axis=0)
+        else:
+            auxes = []
+            layer_cls = MixtralDecoderLayer
+            for i in range(cfg.num_layers):
+                x, a = layer_cls(cfg, name=f"layer_{i}")(x, cos, sin,
+                                                         positions)
+                auxes.append(a)
+            aux = jnp.sum(jnp.stack(auxes), axis=0)
+        x = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
+                    sequence_parallel=cfg.sequence_parallel, name="norm")(x)
+        return x, aux
+
+
+class MixtralForCausalLM(nn.Module):
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        x, aux = MixtralModel(cfg, name="model")(input_ids, positions)
+        logits = pl.ColumnParallelLinear(
+            features=cfg.vocab_size, use_bias=False, gather_output=False,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head")(x)
+        return logits, aux
+
+    def loss(self, input_ids, labels, ignore_index: int = -100):
+        cfg = self.cfg
+        logits, aux = self(input_ids)
+        per_tok = lf.parallel_cross_entropy(logits, labels,
+                                            ignore_index=ignore_index)
+        denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
+        ce = jnp.sum(per_tok) / denom
+        return (ce + cfg.router_aux_coef * aux[0]
+                + cfg.router_z_coef * aux[1])

@@ -1,6 +1,7 @@
 """Model building blocks (reference: ``modules/``)."""
 
 from . import attention
+from . import moe
 from . import norms
 from .norms import LayerNorm, RMSNorm
 

@@ -1,0 +1,22 @@
+"""Mixture-of-experts (reference: ``modules/moe/``)."""
+
+from . import expert_mlps
+from . import model
+from . import routing
+from .expert_mlps import ExpertMLPs, build_dispatch_combine, compute_capacity
+from .model import MoE, SharedExperts
+from .routing import GroupLimitedRouter, RouterSinkhorn, RouterTopK
+
+__all__ = [
+    "expert_mlps",
+    "model",
+    "routing",
+    "ExpertMLPs",
+    "build_dispatch_combine",
+    "compute_capacity",
+    "MoE",
+    "SharedExperts",
+    "GroupLimitedRouter",
+    "RouterSinkhorn",
+    "RouterTopK",
+]

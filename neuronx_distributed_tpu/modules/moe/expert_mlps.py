@@ -1,0 +1,131 @@
+"""Expert MLP banks.
+
+Analogue of the reference's ``modules/moe/expert_mlps_v2.py``
+(``ExpertMLPsV2:46``: ``forward_all_experts:366``, ``forward_all_experts_EP
+:394``, ``forward_capacity_factor:484``) and the expert-fused TP layers
+(``moe/moe_parallel_layers.py``: 3-D ``[E, in, out]`` column/row parallel).
+
+TPU-native design: expert weights are stacked ``[E, H, 2, I]`` / ``[E, I, H]``
+tensors whose expert dim shards over ``ep`` and whose intermediate dim shards
+over ``tp`` (the expert-fused column/row layers are these einsums + the same
+collective mappings as the 2-D layers). Dispatch is the capacity-factor
+mask-einsum formulation — dense, static-shaped, MXU-friendly (the reference's
+dropless/blockwise NKI path maps to a future Pallas block-sparse kernel; the
+capacity path is its golden fallback, as in ``moe/blockwise.py:326``).
+
+Expert parallelism: ``enter/exit_expert_parallel_region`` all-to-alls move
+capacity slots from token shards to expert shards and back
+(reference ``mappings.py:355-556``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...parallel import comm, mappings
+from ...parallel import layers as pl
+from ...parallel import mesh as ps
+
+
+def compute_capacity(num_tokens: int, num_experts: int, top_k: int,
+                     capacity_factor: float) -> int:
+    """Per-expert capacity slots (reference capacity computation in
+    ``forward_capacity_factor``)."""
+    cap = int(capacity_factor * num_tokens * top_k / num_experts)
+    return max(cap, top_k)
+
+
+def build_dispatch_combine(
+    gates: jax.Array, idx: jax.Array, num_experts: int, capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Capacity-limited dispatch/combine masks.
+
+    gates/idx: ``[T, K]``. Returns ``(dispatch [T, E, C], combine [T, E, C],
+    dropped_fraction scalar)``. Priority is choice-rank-major then token
+    order (tokens beyond an expert's capacity are dropped, matching the
+    reference's capacity-factor semantics).
+    """
+    t, k = idx.shape
+    choice = jax.nn.one_hot(idx, num_experts, dtype=jnp.float32)  # [T,K,E]
+    flat = jnp.transpose(choice, (1, 0, 2)).reshape(k * t, num_experts)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat
+    pos = jnp.transpose(pos_flat.reshape(k, t, num_experts), (1, 0, 2))
+    keep = choice * (pos < capacity)  # [T,K,E]
+    pos_clipped = jnp.minimum(pos, capacity - 1).astype(jnp.int32)
+    slot = jax.nn.one_hot(pos_clipped, capacity, dtype=jnp.float32)  # [T,K,E,C]
+    dispatch = jnp.einsum("tke,tkec->tec", keep, slot)
+    combine = jnp.einsum("tk,tke,tkec->tec", gates, keep, slot)
+    dropped = 1.0 - jnp.sum(keep) / jnp.maximum(float(t * k), 1.0)
+    return dispatch, combine, dropped
+
+
+class ExpertMLPs(nn.Module):
+    """Stacked GLU expert MLPs with capacity-factor dispatch, TP- and
+    EP-sharded."""
+
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    tp_axis: str = ps.TP_AXIS
+    ep_axis: str = ps.EP_AXIS
+
+    @nn.compact
+    def __call__(self, x: jax.Array, gates: jax.Array,
+                 idx: jax.Array) -> Tuple[jax.Array, Dict]:
+        """x: [T, H] flat tokens; gates/idx: [T, K]. Returns ([T, H], aux)."""
+        t = x.shape[0]
+        e_local = pl._maybe_local(self.num_experts, self.ep_axis)
+        i_local = pl._maybe_local(self.intermediate_size, self.tp_axis)
+        ep = comm._axis_size(self.ep_axis)
+
+        gate_up = self.param(
+            "gate_up",
+            nn.with_partitioning(pl.default_kernel_init,
+                                 (self.ep_axis, None, None, self.tp_axis)),
+            (e_local, self.hidden_size, 2, i_local), self.param_dtype)
+        down = self.param(
+            "down",
+            nn.with_partitioning(pl.default_kernel_init,
+                                 (self.ep_axis, self.tp_axis, None)),
+            (e_local, i_local, self.hidden_size), self.param_dtype)
+
+        capacity = compute_capacity(t, self.num_experts, self.top_k,
+                                    self.capacity_factor)
+        dispatch, combine, dropped = build_dispatch_combine(
+            gates, idx, self.num_experts, capacity)
+
+        xin = jnp.einsum("tec,th->ech", dispatch.astype(self.dtype),
+                         x.astype(self.dtype))  # [E, C, H]
+        if ep is not None and ep > 1:
+            # all-to-all: expert dim E -> E/ep local, capacity gathers the
+            # slots from every token shard (reference
+            # enter_expert_parallel_region)
+            xin = mappings.enter_expert_parallel_region(
+                xin, self.ep_axis, split_dim=0, concat_dim=1)
+
+        # expert-fused column parallel (3-D einsum; reference
+        # ExpertFusedColumnParallelLinear moe_parallel_layers.py:175)
+        xin = mappings.copy_to_tensor_parallel_region(xin, self.tp_axis)
+        h = jnp.einsum("ech,ehki->ecki", xin, gate_up.astype(self.dtype))
+        h = nn.silu(h[..., 0, :]) * h[..., 1, :]
+        out = jnp.einsum("eci,eih->ech", h, down.astype(self.dtype))
+        # expert-fused row parallel exit (reference
+        # ExpertFusedRowParallelLinear moe_parallel_layers.py:303)
+        out = mappings.reduce_from_tensor_parallel_region(out, self.tp_axis)
+
+        if ep is not None and ep > 1:
+            out = mappings.exit_expert_parallel_region(
+                out, self.ep_axis, split_dim=1, concat_dim=0)
+
+        y = jnp.einsum("tec,ech->th", combine.astype(self.dtype),
+                       out)
+        aux = {"dropped_fraction": dropped}
+        return y.astype(self.dtype), aux

@@ -1,0 +1,95 @@
+"""MoE layer: router + expert bank + optional shared experts.
+
+Analogue of the reference's ``modules/moe/model.py`` (``MoE:14``) and
+``modules/moe/shared_experts.py`` (``SharedExperts:73``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...parallel import layers as pl
+from ...parallel import mesh as ps
+from .expert_mlps import ExpertMLPs
+from .routing import GroupLimitedRouter, RouterSinkhorn, RouterTopK
+
+ROUTERS = {
+    "top_k": RouterTopK,
+    "sinkhorn": RouterSinkhorn,
+    "group_limited": GroupLimitedRouter,
+}
+
+
+class SharedExperts(nn.Module):
+    """Always-on dense GLU MLP added to the routed output (reference
+    ``shared_experts.py:73``)."""
+
+    hidden_size: int
+    intermediate_size: int
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        i_local = pl._maybe_local(self.intermediate_size, ps.TP_AXIS)
+        kernel = self.param(
+            "gate_up_kernel",
+            nn.with_partitioning(pl.default_kernel_init,
+                                 (None, None, ps.TP_AXIS)),
+            (self.hidden_size, 2, i_local), self.param_dtype)
+        from ...parallel import mappings
+
+        h = mappings.copy_to_tensor_parallel_region(x).astype(self.dtype)
+        g = jnp.einsum("th,hki->tki", h, kernel.astype(self.dtype))
+        g = nn.silu(g[..., 0, :]) * g[..., 1, :]
+        return pl.RowParallelLinear(
+            features=self.hidden_size, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="down")(g)
+
+
+class MoE(nn.Module):
+    """Mixture-of-experts block over flat or [B, S, H] inputs (reference
+    ``MoE:14``). Returns ``(y, aux_losses)``."""
+
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    router_type: str = "top_k"
+    shared_expert_intermediate: int = 0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, Dict]:
+        orig_shape = x.shape
+        h = self.hidden_size
+        flat = x.reshape(-1, h)
+
+        router_cls = ROUTERS[self.router_type]
+        router_kw = dict(num_experts=self.num_experts, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="router")
+        if self.router_type != "sinkhorn":
+            router_kw["top_k"] = self.top_k
+        gates, idx, aux = router_cls(**router_kw)(flat)
+
+        experts = ExpertMLPs(
+            num_experts=self.num_experts, hidden_size=h,
+            intermediate_size=self.intermediate_size,
+            top_k=gates.shape[-1], capacity_factor=self.capacity_factor,
+            dtype=self.dtype, param_dtype=self.param_dtype, name="experts")
+        y, eaux = experts(flat, gates, idx)
+        aux.update(eaux)
+
+        if self.shared_expert_intermediate > 0:
+            y = y + SharedExperts(
+                hidden_size=h,
+                intermediate_size=self.shared_expert_intermediate,
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                name="shared")(flat)
+        return y.reshape(orig_shape), aux

@@ -1,0 +1,143 @@
+"""MoE routers.
+
+Analogue of the reference's ``modules/moe/routing.py`` (``RouterBase:12``,
+``RouterTopK:155``, ``RouterSinkhorn:213``, ``GroupLimitedRouter:316``).
+Router math runs in fp32 regardless of compute dtype (reference RouterBase
+casts to fp32), and every router returns auxiliary losses (load-balance +
+router z-loss) for the training objective.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _load_balance_loss(probs: jax.Array, expert_mask: jax.Array) -> jax.Array:
+    """Switch/Mixtral-style load-balancing loss: E * Σ_e f_e · p_e where
+    ``f_e`` is the fraction of tokens dispatched to expert e and ``p_e`` the
+    mean router probability of e. probs: [T, E]; expert_mask: [T, E] (0/1
+    over selected experts)."""
+    e = probs.shape[-1]
+    f = jnp.mean(expert_mask, axis=0)
+    p = jnp.mean(probs, axis=0)
+    return e * jnp.sum(f * p)
+
+
+def _z_loss(logits: jax.Array) -> jax.Array:
+    """Router z-loss (St-MoE): mean(logsumexp(logits)^2)."""
+    return jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+
+
+class RouterBase(nn.Module):
+    """fp32 linear router (reference ``RouterBase:12``)."""
+
+    num_experts: int
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def logits(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(nn.initializers.lecun_normal(),
+                                 (None, None)),
+            (x.shape[-1], self.num_experts), self.param_dtype)
+        # router always computes in fp32 (reference RouterBase)
+        return jnp.dot(x.astype(jnp.float32), kernel.astype(jnp.float32))
+
+
+class RouterTopK(RouterBase):
+    """Top-k softmax router (reference ``RouterTopK:155``).
+
+    Returns ``(gates [T, k], indices [T, k], aux)`` where gates are the
+    renormalised top-k probabilities.
+    """
+
+    top_k: int = 2
+    norm_topk: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array, Dict]:
+        logits = self.logits(x)  # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        gates, idx = jax.lax.top_k(probs, self.top_k)
+        if self.norm_topk:
+            gates = gates / jnp.maximum(
+                jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+        mask = jnp.sum(jax.nn.one_hot(idx, self.num_experts,
+                                      dtype=jnp.float32), axis=1)
+        aux = {"load_balance_loss": _load_balance_loss(probs, mask),
+               "z_loss": _z_loss(logits)}
+        return gates.astype(jnp.float32), idx, aux
+
+
+class RouterSinkhorn(RouterBase):
+    """Sinkhorn-balanced top-1 router (reference ``RouterSinkhorn:213``):
+    iteratively normalise the token×expert matrix toward doubly-stochastic
+    before the argmax, equalising expert load; gates come from the raw
+    softmax (straight-through style)."""
+
+    num_iters: int = 4
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array, Dict]:
+        logits = self.logits(x)
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        pi = jnp.exp(logits - jax.nn.logsumexp(logits))
+
+        def sinkhorn_iter(pi, _):
+            pi = pi / jnp.maximum(jnp.sum(pi, axis=0, keepdims=True), 1e-9)
+            pi = pi / jnp.maximum(jnp.sum(pi, axis=1, keepdims=True), 1e-9)
+            return pi, None
+
+        pi, _ = jax.lax.scan(sinkhorn_iter, pi, None, length=self.num_iters)
+        idx = jnp.argmax(pi, axis=-1)[:, None]  # [T, 1]
+        gates = jnp.take_along_axis(probs, idx, axis=-1)
+        mask = jax.nn.one_hot(idx[:, 0], self.num_experts, dtype=jnp.float32)
+        aux = {"load_balance_loss": _load_balance_loss(probs, mask),
+               "z_loss": _z_loss(logits)}
+        return gates.astype(jnp.float32), idx, aux
+
+
+class GroupLimitedRouter(RouterBase):
+    """DeepSeek-style node-limited routing (reference
+    ``GroupLimitedRouter:316``): experts are partitioned into groups (nodes);
+    each token first picks its best ``topk_groups`` groups by group score,
+    then top-k experts within the allowed groups — bounding cross-node
+    dispatch fan-out."""
+
+    top_k: int = 2
+    num_groups: int = 2
+    topk_groups: int = 1
+    norm_topk: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array, Dict]:
+        if self.num_experts % self.num_groups != 0:
+            raise ValueError("num_experts must divide into num_groups")
+        logits = self.logits(x)  # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        t = logits.shape[0]
+        per_group = self.num_experts // self.num_groups
+        grouped = probs.reshape(t, self.num_groups, per_group)
+        group_score = jnp.max(grouped, axis=-1)  # [T, G]
+        _, top_groups = jax.lax.top_k(group_score, self.topk_groups)
+        group_allowed = jnp.sum(
+            jax.nn.one_hot(top_groups, self.num_groups, dtype=jnp.float32),
+            axis=1)  # [T, G]
+        expert_allowed = jnp.repeat(group_allowed, per_group, axis=-1)
+        masked = jnp.where(expert_allowed > 0, probs, -jnp.inf)
+        gates, idx = jax.lax.top_k(masked, self.top_k)
+        gates = jnp.where(jnp.isfinite(gates), gates, 0.0)
+        if self.norm_topk:
+            gates = gates / jnp.maximum(
+                jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+        mask = jnp.sum(jax.nn.one_hot(idx, self.num_experts,
+                                      dtype=jnp.float32), axis=1)
+        aux = {"load_balance_loss": _load_balance_loss(probs, mask),
+               "z_loss": _z_loss(logits)}
+        return gates.astype(jnp.float32), idx, aux

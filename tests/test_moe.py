@@ -1,0 +1,173 @@
+"""MoE tests: routing, dispatch math, TP/EP parity, mixtral training."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+from jax.sharding import PartitionSpec as P
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.modules.moe import (
+    ExpertMLPs, MoE, RouterSinkhorn, RouterTopK, GroupLimitedRouter,
+    build_dispatch_combine, compute_capacity)
+from neuronx_distributed_tpu.parallel import mesh as ps
+
+
+def test_dispatch_combine_basic():
+    gates = jnp.array([[0.7, 0.3], [0.6, 0.4], [1.0, 0.0]])
+    idx = jnp.array([[0, 1], [0, 2], [1, 3]])
+    d, c, dropped = build_dispatch_combine(gates, idx, num_experts=4,
+                                           capacity=2)
+    assert d.shape == (3, 4, 2)
+    # expert 0 receives tokens 0 (slot 0) and 1 (slot 1)
+    assert float(d[0, 0, 0]) == 1.0 and float(d[1, 0, 1]) == 1.0
+    # combine carries the gate values
+    assert float(c[0, 0, 0]) == pytest.approx(0.7)
+    assert float(c[2, 1, 0]) == pytest.approx(1.0)
+    assert float(dropped) == 0.0
+
+
+def test_dispatch_capacity_drops():
+    # 4 tokens all pick expert 0 first; capacity 2 -> 2 dropped first-choices
+    gates = jnp.ones((4, 1))
+    idx = jnp.zeros((4, 1), jnp.int32)
+    d, c, dropped = build_dispatch_combine(gates, idx, num_experts=2,
+                                           capacity=2)
+    assert float(jnp.sum(d)) == 2.0
+    assert float(dropped) == pytest.approx(0.5)
+
+
+@pytest.mark.parametrize("router_cls,kw", [
+    (RouterTopK, dict(top_k=2)),
+    (RouterSinkhorn, dict()),
+    (GroupLimitedRouter, dict(top_k=2, num_groups=2, topk_groups=1)),
+])
+def test_routers(router_cls, kw):
+    ps.initialize_model_parallel()
+    r = router_cls(num_experts=4, dtype=jnp.float32, **kw)
+    x = jax.random.normal(jax.random.key(0), (16, 8))
+    params = meta.unbox(r.init(jax.random.key(1), x))
+    gates, idx, aux = r.apply(params, x)
+    assert idx.shape[0] == 16
+    assert np.all(np.asarray(idx) >= 0) and np.all(np.asarray(idx) < 4)
+    if router_cls is RouterSinkhorn:
+        # top-1 gate is the raw softmax prob of the chosen expert
+        g = np.asarray(gates)
+        assert ((g > 0) & (g <= 1)).all()
+    else:
+        np.testing.assert_allclose(np.sum(np.asarray(gates), -1), 1.0,
+                                   rtol=1e-5)
+    assert np.isfinite(float(aux["load_balance_loss"]))
+    assert np.isfinite(float(aux["z_loss"]))
+
+
+def test_group_limited_router_respects_groups():
+    ps.initialize_model_parallel()
+    r = GroupLimitedRouter(num_experts=8, top_k=2, num_groups=4,
+                           topk_groups=1, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (32, 8))
+    params = meta.unbox(r.init(jax.random.key(1), x))
+    gates, idx, aux = r.apply(params, x)
+    # both chosen experts of a token must come from one group of 2
+    groups = np.asarray(idx) // 2
+    assert (groups[:, 0] == groups[:, 1]).all()
+
+
+def test_expert_mlps_tp_parity():
+    """Experts with tp=4 sharding match the unsharded computation."""
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    m = ExpertMLPs(num_experts=4, hidden_size=16, intermediate_size=32,
+                   top_k=2, capacity_factor=4.0, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (24, 16))
+    gates = jnp.full((24, 2), 0.5)
+    idx = jax.random.randint(jax.random.key(1), (24, 2), 0, 4)
+    params = meta.unbox(m.init(jax.random.key(2), x, gates, idx))
+    dense, _ = m.apply(params, x, gates, idx)
+
+    pspec = {"params": {"gate_up": P(None, None, None, "tp"),
+                        "down": P(None, "tp", None)}}
+    y, _ = jax.jit(ps.shard_map(
+        lambda p, x, g, i: m.apply(p, x, g, i), mesh,
+        in_specs=(pspec, P(None, None), P(None, None), P(None, None)),
+        out_specs=(P(None, None), P())))(params, x, gates, idx)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_expert_mlps_ep_parity():
+    """ep=4 expert-parallel dispatch (all-to-all) matches unsharded."""
+    nxd.neuronx_distributed_config(expert_parallel_size=4)
+    em = ps.get_expert_mesh()
+    m = ExpertMLPs(num_experts=4, hidden_size=16, intermediate_size=32,
+                   top_k=2, capacity_factor=4.0, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (32, 16))
+    gates = jnp.full((32, 2), 0.5)
+    idx = jax.random.randint(jax.random.key(1), (32, 2), 0, 4)
+    params = meta.unbox(m.init(jax.random.key(2), x, gates, idx))
+    dense, _ = m.apply(params, x, gates, idx)
+
+    pspec = {"params": {"gate_up": P("ep", None, None, None),
+                        "down": P("ep", None, None)}}
+    # tokens sharded over the ep axis (each shard routes its own tokens)
+    y, _ = jax.jit(ps.shard_map(
+        lambda p, x, g, i: m.apply(p, x, g, i), em,
+        in_specs=(pspec, P("ep", None), P("ep", None), P("ep", None)),
+        out_specs=(P("ep", None), P())))(params, x, gates, idx)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_layer_and_mixtral_training():
+    from neuronx_distributed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                        tiny_moe_config)
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model, initialize_parallel_optimizer,
+        make_train_step)
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=2)
+    mcfg = tiny_moe_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                           capacity_factor=4.0)
+    model = MixtralForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (8, 33), 0, mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           batch["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 3e-3)
+    step = make_train_step(pm, tx, sh)
+    losses = []
+    for _ in range(10):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.9, losses
+    assert np.isfinite(losses).all()
+
+
+def test_mixtral_cp_positions_match_dense():
+    """Regression: Mixtral under cp must use global rope positions."""
+    from neuronx_distributed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                        tiny_moe_config)
+    from neuronx_distributed_tpu.pipeline import spmd_engine as eng
+    from neuronx_distributed_tpu.trainer import initialize_parallel_model
+
+    cfg = nxd.neuronx_distributed_config(context_parallel_size=2)
+    mesh = ps.get_mesh()
+    mcfg = tiny_moe_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                           num_layers=1, capacity_factor=4.0)
+    model = MixtralForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (4, 33), 0, mcfg.vocab_size)
+    batch_ids, labels = ids[:, :-1], ids[:, 1:]
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           batch_ids)
+    host = jax.tree_util.tree_map(np.asarray, params)
+    dense = model.apply(host, batch_ids, labels, method="loss")
+
+    def inner(p, i, l):
+        return eng.data_parallel_mean(model.apply(p, i, l, method="loss"))
+
+    sharded = jax.jit(ps.shard_map(
+        inner, mesh, in_specs=(pm.param_specs, P(None, "cp"), P(None, "cp")),
+        out_specs=P()))(params, batch_ids, labels)
+    np.testing.assert_allclose(float(sharded), float(dense), rtol=2e-4)
