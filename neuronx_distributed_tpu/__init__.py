@@ -18,6 +18,10 @@ from .config import (
     configure_model,
 )
 from . import parallel
+from . import inference
+from . import lora
+from . import quantization
+from . import utils
 
 __version__ = "0.1.0"
 
@@ -32,4 +36,8 @@ __all__ = [
     "neuronx_distributed_config",
     "configure_model",
     "parallel",
+    "inference",
+    "lora",
+    "quantization",
+    "utils",
 ]

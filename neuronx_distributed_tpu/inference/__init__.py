@@ -1,0 +1,26 @@
+"""Inference stack (reference: ``trace/`` + serving modules).
+
+* :mod:`.model_builder` — AOT multi-key/multi-bucket builder + runtime
+  container (reference ``ModelBuilder`` / ``NxDModel``).
+* :mod:`.kv_cache` — on-device KV cache state (reference
+  ``StateInitializer`` buffers).
+* :mod:`.generation` — prefill/decode loop (reference serving examples).
+* :mod:`.sampling` — greedy/top-k/top-p (reference ``utils/sampling.py``).
+"""
+
+from . import generation
+from . import kv_cache
+from . import model_builder
+from . import sampling
+from .generation import decode_step, generate, pick_bucket, prefill
+from .kv_cache import KVCache, init_kv_cache
+from .model_builder import ModelBuilder, NxDModel, shard_checkpoint
+from .sampling import SamplingConfig, sample
+
+__all__ = [
+    "generation", "kv_cache", "model_builder", "sampling",
+    "decode_step", "generate", "pick_bucket", "prefill",
+    "KVCache", "init_kv_cache",
+    "ModelBuilder", "NxDModel", "shard_checkpoint",
+    "SamplingConfig", "sample",
+]

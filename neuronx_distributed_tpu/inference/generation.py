@@ -1,0 +1,117 @@
+"""Autoregressive generation loop.
+
+Analogue of the reference's serving-side generation
+(``examples/inference/modules/model_base.py:414``
+``HuggingFaceGenerationAdapter`` + ``run.py`` loop): prefill ("context
+encoding") compiles separately from the single-token decode step ("token
+generation"), prompts are padded up to bucketed lengths, and the decode loop
+runs fully on device via ``lax.scan`` with donated cache buffers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.llama import LlamaConfig, llama_forward_with_cache
+from .kv_cache import KVCache, init_kv_cache
+from .sampling import SamplingConfig, sample
+
+
+def pick_bucket(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= length (reference: bucketed input shapes,
+    ``model_builder.py:495``)."""
+    for b in sorted(buckets):
+        if b >= length:
+            return b
+    raise ValueError(f"prompt length {length} exceeds largest bucket "
+                     f"{max(buckets)}")
+
+
+def prefill(cfg: LlamaConfig, params, input_ids: jax.Array,
+            prompt_len: jax.Array, cache: KVCache):
+    """Context encoding: run the (padded) prompt through the model, fill the
+    cache, return the logits at the last real token. ``input_ids`` is
+    right-padded to a bucket length; ``prompt_len [B]`` gives real lengths —
+    pad slots record the PAD_POSITION sentinel and are never attended."""
+    from .kv_cache import PAD_POSITION
+
+    b, s = input_ids.shape
+    ar = jnp.broadcast_to(jnp.arange(s), (b, s))
+    positions = jnp.where(ar < prompt_len[:, None], ar, PAD_POSITION)
+    logits, cache = llama_forward_with_cache(cfg, params, input_ids,
+                                             positions, cache)
+    last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None],
+                               axis=1)[:, 0]
+    return last, cache
+
+
+def decode_step(cfg: LlamaConfig, params, token: jax.Array,
+                position: jax.Array, cache: KVCache):
+    """Token generation: one step. token ``[B]``, position ``[B]``."""
+    logits, cache = llama_forward_with_cache(
+        cfg, params, token[:, None], position[:, None], cache)
+    return logits[:, 0], cache
+
+
+def generate(cfg: LlamaConfig, params, input_ids, prompt_len,
+             max_new_tokens: int,
+             sampling: SamplingConfig = SamplingConfig(greedy=True),
+             rng: Optional[jax.Array] = None,
+             buckets: Sequence[int] = (128, 512, 2048),
+             kv_dtype=None, eos_id: Optional[int] = None) -> jax.Array:
+    """Generate ``[B, max_new_tokens]`` continuations.
+
+    ``input_ids [B, S]`` right-padded prompts, ``prompt_len [B]`` real
+    lengths. The decode loop is one compiled ``lax.scan``.
+    """
+    import numpy as np
+
+    input_ids = jnp.asarray(input_ids)
+    prompt_len = jnp.asarray(prompt_len)
+    b, s = input_ids.shape
+    bucket = pick_bucket(s, buckets)
+    if bucket > s:
+        input_ids = jnp.pad(input_ids, ((0, 0), (0, bucket - s)))
+    rng = rng if rng is not None else jax.random.key(0)
+
+    n_kv = cfg.num_kv_heads
+    cache = init_kv_cache(cfg.num_layers, b, bucket + max_new_tokens,
+                          n_kv, cfg.head_dim_,
+                          dtype=kv_dtype or cfg.dtype)
+
+    last_logits, cache = _jit_prefill(cfg)(params, input_ids, prompt_len,
+                                           cache)
+
+    done0 = jnp.zeros((b,), bool)
+    (cache, _, _, _, _), tokens = _jit_decode_scan(cfg, max_new_tokens)(
+        cache, last_logits, prompt_len, rng, done0, params, sampling, eos_id)
+    return jnp.swapaxes(tokens, 0, 1)  # [B, T]
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_prefill(cfg: LlamaConfig):
+    return jax.jit(functools.partial(prefill, cfg))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_decode_scan(cfg: LlamaConfig, steps: int):
+    def run(cache, logits, pos, rng, done, params, sampling, eos_id):
+        def step(carry, _):
+            cache, logits, pos, rng, done = carry
+            rng, sub = jax.random.split(rng)
+            tok = sample(logits, sub, sampling)
+            if eos_id is not None:
+                tok = jnp.where(done, eos_id, tok)
+                done = done | (tok == eos_id)
+            new_logits, cache = decode_step(cfg, params, tok, pos, cache)
+            return (cache, new_logits, pos + 1, rng, done), tok
+
+        return jax.lax.scan(step, (cache, logits, pos, rng, done), None,
+                            length=steps)
+
+    return jax.jit(run, static_argnames=("sampling", "eos_id"),
+                   donate_argnums=(0,))

@@ -1,0 +1,53 @@
+"""KV cache management.
+
+Analogue of the reference's on-device state buffers for inference
+(``trace/nxd_model/base_nxd_model.py:11`` ``StateInitializer``; KV cache
+read/write ``nxd_model.py:354-418``). In JAX the cache is an explicit pytree
+threaded through the compiled step with buffer donation — the functional
+equivalent of the reference's persistent device buffers (donation gives
+in-place update on TPU).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+# Sentinel "position" for unwritten / padding slots: greater than any real
+# position, so the causal mask (qpos >= slot_pos) always excludes them.
+PAD_POSITION = jnp.iinfo(jnp.int32).max // 2
+
+
+class KVCache(struct.PyTreeNode):
+    """Stacked per-layer cache: k/v ``[L, B, S_max, KV, D]``, the true token
+    position stored in every slot (``pos [B, S_max]``, PAD_POSITION when
+    empty), and the scalar next-write slot ``index``.
+
+    Masking is by *stored position*, not slot index — right-padded prompt
+    slots carry PAD_POSITION and are never attended, so ragged batches need
+    no attention-mask plumbing.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    pos: jax.Array
+    index: jax.Array  # scalar int32: next write slot
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_kv_cache(num_layers: int, batch: int, max_len: int,
+                  num_kv_heads: int, head_dim: int,
+                  dtype: Any = jnp.bfloat16) -> KVCache:
+    """Allocate an empty cache (reference ``StateInitializer``)."""
+    shape = (num_layers, batch, max_len, num_kv_heads, head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   pos=jnp.full((batch, max_len), PAD_POSITION, jnp.int32),
+                   index=jnp.zeros((), jnp.int32))

@@ -1,0 +1,43 @@
+"""Token sampling.
+
+Analogue of the reference's ``utils/sampling.py`` (``Sampler:6``: greedy /
+top-k / top-p with temperature).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingConfig:
+    temperature: float = 1.0
+    top_k: int = 0       # 0 = disabled
+    top_p: float = 1.0   # 1.0 = disabled
+    greedy: bool = False
+
+
+def sample(logits: jax.Array, rng: jax.Array,
+           cfg: SamplingConfig = SamplingConfig()) -> jax.Array:
+    """Sample token ids from ``[B, V]`` logits."""
+    if cfg.greedy:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32)
+    if cfg.temperature != 1.0:
+        logits = logits / jnp.maximum(cfg.temperature, 1e-6)
+    if cfg.top_k > 0:
+        kth = jax.lax.top_k(logits, cfg.top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if cfg.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < cfg.top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)

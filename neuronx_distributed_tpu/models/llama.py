@@ -34,6 +34,17 @@ from ..parallel import loss_functions as lf
 from ..parallel import mappings
 from ..parallel import mesh as ps
 
+from ..lora import LoraConfig
+
+
+def _lora_kw(cfg: "LlamaConfig", name: str) -> dict:
+    """lora_rank/alpha kwargs for a target sublayer (reference LoraModel
+    walks the model matching target_modules; here targets select at
+    construction)."""
+    if cfg.lora is not None and name in cfg.lora.target_modules:
+        return {"lora_rank": cfg.lora.r, "lora_alpha": cfg.lora.alpha}
+    return {}
+
 
 @dataclass(frozen=True)
 class LlamaConfig:
@@ -56,6 +67,8 @@ class LlamaConfig:
     scan_layers: bool = True
     use_flash_attention: bool = False
     tp_size: Optional[int] = None
+    # LoRA adapters (see neuronx_distributed_tpu.lora); None = disabled
+    lora: Optional["LoraConfig"] = None
 
     @property
     def head_dim_(self) -> int:
@@ -81,18 +94,28 @@ def tiny_config(**kw) -> LlamaConfig:
 
 
 class LlamaAttention(nn.Module):
+    """Attention with optional KV cache for autoregressive decode.
+
+    Training path: ``__call__(x, cos, sin, positions)``.
+    Decode path (reference: KV-cache state buffers in
+    ``trace/nxd_model`` + ``examples/inference/modules``): pass
+    ``cache=(k_cache, v_cache)`` of shape ``[B, S_max, KV, D]`` and
+    ``cache_index`` (scalar write offset); returns ``(out, new_cache)``.
+    """
+
     cfg: LlamaConfig
 
     @nn.compact
     def __call__(self, x: jax.Array, cos: jax.Array, sin: jax.Array,
-                 positions: Optional[jax.Array] = None) -> jax.Array:
+                 positions: Optional[jax.Array] = None,
+                 cache=None, cache_index=None):
         cfg = self.cfg
         head_dim = cfg.head_dim_
         q, k, v = pl.GQAQKVColumnParallelLinear(
             num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
             head_dim=head_dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             sequence_parallel=cfg.sequence_parallel, tp_size=cfg.tp_size,
-            name="qkv")(x)
+            name="qkv", **_lora_kw(cfg, "qkv"))(x)
         b, s = q.shape[0], q.shape[1]
         n_q_local = q.shape[-1] // head_dim
         n_kv_local = k.shape[-1] // head_dim
@@ -101,29 +124,61 @@ class LlamaAttention(nn.Module):
         v = v.reshape(b, s, n_kv_local, head_dim)
         q = attn_mod.apply_rotary(q, cos, sin, positions)
         k = attn_mod.apply_rotary(k, cos, sin, positions)
-        k = attn_mod.repeat_kv(k, n_q_local // n_kv_local)
-        v = attn_mod.repeat_kv(v, n_q_local // n_kv_local)
-        from ..parallel import comm
+        new_cache = None
+        if cache is not None:
+            # cache = (k_cache, v_cache, slot_positions); slot_positions
+            # [B, S_max] holds each slot's true token position (PAD_POSITION
+            # sentinel for pads), updated once per step by the caller.
+            k_cache, v_cache, slot_pos = cache
+            k_cache = jax.lax.dynamic_update_slice_in_dim(
+                k_cache, k.astype(k_cache.dtype), cache_index, axis=1)
+            v_cache = jax.lax.dynamic_update_slice_in_dim(
+                v_cache, v.astype(v_cache.dtype), cache_index, axis=1)
+            new_cache = (k_cache, v_cache)
+            k_full = attn_mod.repeat_kv(k_cache.astype(cfg.dtype),
+                                        n_q_local // n_kv_local)
+            v_full = attn_mod.repeat_kv(v_cache.astype(cfg.dtype),
+                                        n_q_local // n_kv_local)
+            import math as _math
 
-        cp = comm._axis_size(ps.CP_AXIS)
-        if cp is not None and cp > 1:
-            # context parallel: sequence sliced over cp; ring attention
-            # rotates KV around the cp ring (reference:
-            # kernels/ring_attention_kernel.py)
-            from ..ops.ring_attention import ring_attention
-
-            out = ring_attention(q, k, v, causal=True)
-        elif cfg.use_flash_attention:
-            from ..ops.flash_attention import flash_attention
-
-            out = flash_attention(q, k, v, causal=True)
+            scale = 1.0 / _math.sqrt(head_dim)
+            scores = jnp.einsum(
+                "bqnd,bknd->bnqk", q.astype(jnp.float32),
+                k_full.astype(jnp.float32)) * scale
+            # causal mask by stored positions: pads carry PAD_POSITION and
+            # are never attended, so ragged batches need no extra mask
+            mask = positions[:, :, None] >= slot_pos[:, None, :]
+            scores = jnp.where(mask[:, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bnqk,bknd->bqnd", probs,
+                             v_full.astype(jnp.float32)).astype(cfg.dtype)
         else:
-            out = attn_mod.sdpa_reference(q, k, v, causal=True)
+            k = attn_mod.repeat_kv(k, n_q_local // n_kv_local)
+            v = attn_mod.repeat_kv(v, n_q_local // n_kv_local)
+            from ..parallel import comm
+
+            cp = comm._axis_size(ps.CP_AXIS)
+            if cp is not None and cp > 1:
+                # context parallel: sequence sliced over cp; ring attention
+                # rotates KV around the cp ring (reference:
+                # kernels/ring_attention_kernel.py)
+                from ..ops.ring_attention import ring_attention
+
+                out = ring_attention(q, k, v, causal=True)
+            elif cfg.use_flash_attention:
+                from ..ops.flash_attention import flash_attention
+
+                out = flash_attention(q, k, v, causal=True)
+            else:
+                out = attn_mod.sdpa_reference(q, k, v, causal=True)
         out = out.reshape(b, s, n_q_local * head_dim)
         out = pl.RowParallelLinear(
             features=cfg.num_heads * head_dim, use_bias=False,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            sequence_parallel=cfg.sequence_parallel, name="o_proj")(out)
+            sequence_parallel=cfg.sequence_parallel, name="o_proj",
+            **_lora_kw(cfg, "o_proj"))(out)
+        if cache is not None:
+            return out, new_cache
         return out
 
 
@@ -143,6 +198,17 @@ class LlamaMLP(nn.Module):
             nn.with_partitioning(pl.default_kernel_init,
                                  (None, None, ps.TP_AXIS)),
             (cfg.hidden_size, 2, i_local), cfg.param_dtype)
+        if cfg.lora is not None and "gate_up" in cfg.lora.target_modules:
+            lora_a = self.param(
+                "lora_a", nn.with_partitioning(pl.default_kernel_init,
+                                               (None, None)),
+                (cfg.hidden_size, cfg.lora.r), cfg.param_dtype)
+            lora_b = self.param(
+                "lora_b", nn.with_partitioning(
+                    nn.initializers.zeros_init(), (None, None, ps.TP_AXIS)),
+                (cfg.lora.r, 2, i_local), cfg.param_dtype)
+            kernel = kernel + cfg.lora.scale * jnp.einsum(
+                "hr,rki->hki", lora_a, lora_b)
         if cfg.sequence_parallel:
             x = mappings.gather_from_sequence_parallel_region(
                 x, seq_dim=1, to_model_parallel=True)
@@ -156,7 +222,8 @@ class LlamaMLP(nn.Module):
         return pl.RowParallelLinear(
             features=cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
-            sequence_parallel=cfg.sequence_parallel, name="down")(h)
+            sequence_parallel=cfg.sequence_parallel, name="down",
+            **_lora_kw(cfg, "down"))(h)
 
 
 class LlamaDecoderLayer(nn.Module):
@@ -164,16 +231,24 @@ class LlamaDecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, cos: jax.Array, sin: jax.Array,
-                 positions: Optional[jax.Array] = None) -> jax.Array:
+                 positions: Optional[jax.Array] = None,
+                 cache=None, cache_index=None):
         cfg = self.cfg
         h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                     sequence_parallel=cfg.sequence_parallel,
                     name="input_norm")(x)
-        x = x + LlamaAttention(cfg, name="attn")(h, cos, sin, positions)
+        attn_out = LlamaAttention(cfg, name="attn")(
+            h, cos, sin, positions, cache=cache, cache_index=cache_index)
+        new_cache = None
+        if cache is not None:
+            attn_out, new_cache = attn_out
+        x = x + attn_out
         h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                     sequence_parallel=cfg.sequence_parallel,
                     name="post_norm")(x)
         x = x + LlamaMLP(cfg, name="mlp")(h)
+        if cache is not None:
+            return x, new_cache
         return x
 
 
@@ -206,6 +281,22 @@ class _ScanBody(nn.Module):
         return x, None
 
 
+class _DecodeScanBody(nn.Module):
+    """nn.scan body for cached decode: carries hidden states, maps each
+    layer's cache slice (leading layer dim) through, emits the new cache."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cache_kv, slot_pos, cos, sin, positions,
+                 cache_index):
+        k_l, v_l = cache_kv
+        x, new_cache = LlamaDecoderLayer(self.cfg, name="layer")(
+            x, cos, sin, positions, cache=(k_l, v_l, slot_pos),
+            cache_index=cache_index)
+        return x, new_cache
+
+
 class LlamaModel(nn.Module):
     """Transformer body: embedding + decoder stack + final norm."""
 
@@ -217,8 +308,8 @@ class LlamaModel(nn.Module):
         cfg = self.cfg
         x = pl.ParallelEmbedding(
             num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed")(
-                input_ids)
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed",
+            **_lora_kw(cfg, "embed"))(input_ids)
         positions = context_parallel_positions(input_ids, positions)
         if cfg.sequence_parallel:
             x = mappings.scatter_to_sequence_parallel_region(x, seq_dim=1)
@@ -274,7 +365,8 @@ class LlamaForCausalLM(nn.Module):
         logits = pl.ColumnParallelLinear(
             features=cfg.vocab_size, use_bias=False, gather_output=False,
             sequence_parallel=cfg.sequence_parallel,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head")(x)
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
+            **_lora_kw(cfg, "lm_head"))(x)
         return logits
 
     def loss(self, input_ids: jax.Array, labels: jax.Array,
@@ -284,3 +376,64 @@ class LlamaForCausalLM(nn.Module):
                                             ignore_index=ignore_index)
         denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
         return jnp.sum(per_tok) / denom
+
+
+def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
+                             positions: jax.Array, kv_cache):
+    """KV-cached forward for prefill ("context_encoding") and decode
+    ("token_generation") — the two compiled graphs of the reference's
+    serving path (``trace/model_builder.py:495`` keys).
+
+    ``params``: LlamaForCausalLM variables (scan_layers=True layout).
+    ``kv_cache``: :class:`..inference.kv_cache.KVCache`. Writes this step's
+    K/V at ``kv_cache.index`` and returns ``(logits, new_cache)``.
+    """
+    from ..inference.kv_cache import KVCache
+
+    if not cfg.scan_layers:
+        raise ValueError("cached decode requires scan_layers=True")
+    p = params["params"]
+    b, s = input_ids.shape
+    positions = jnp.asarray(positions, jnp.int32)
+
+    embed = pl.ParallelEmbedding(
+        num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        **_lora_kw(cfg, "embed"))
+    x = embed.apply({"params": p["model"]["embed"]}, input_ids)
+    cos, sin = attn_mod.precompute_rope(
+        cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta,
+        use_scaled=cfg.rope_scaling)
+
+    # record this step's true positions in the slot-position table (pads
+    # carry the PAD_POSITION sentinel and are thereby never attended);
+    # shared by all layers, updated once here
+    slot_pos = jax.lax.dynamic_update_slice_in_dim(
+        kv_cache.pos, positions, kv_cache.index, axis=1)
+    # rope lookup needs in-table indices; sentinel pads clamp to the last
+    # entry (their K values are garbage but masked out)
+    rope_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
+
+    scanned = nn.scan(
+        _DecodeScanBody,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+        in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast,
+                 nn.broadcast),
+        out_axes=0,
+        length=cfg.num_layers,
+    )(cfg)
+    x, (new_k, new_v) = scanned.apply(
+        {"params": p["model"]["layers"]}, x, (kv_cache.k, kv_cache.v),
+        slot_pos, cos, sin, rope_pos, kv_cache.index)
+
+    norm = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype)
+    x = norm.apply({"params": p["model"]["norm"]}, x)
+    head = pl.ColumnParallelLinear(
+        features=cfg.vocab_size, use_bias=False, gather_output=True,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        **_lora_kw(cfg, "lm_head"))
+    logits = head.apply({"params": p["lm_head"]}, x)
+    new_cache = KVCache(k=new_k, v=new_v, pos=slot_pos,
+                        index=kv_cache.index + s)
+    return logits, new_cache

@@ -63,6 +63,14 @@ class MixtralDecoderLayer(nn.Module):
         h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                     sequence_parallel=cfg.sequence_parallel,
                     name="post_norm")(x)
+        if cfg.sequence_parallel:
+            # routing needs full sequences: gather with to_model_parallel=
+            # False (bwd = split) because ExpertMLPs' internal copy_to
+            # already psums grads over tp — a reduce-scatter pairing here
+            # would double-reduce (cf. the lm_head composition note in
+            # llama.py)
+            h = mappings.gather_from_sequence_parallel_region(
+                h, seq_dim=1, to_model_parallel=False)
         moe_out, aux = MoE(
             num_experts=cfg.num_experts, hidden_size=cfg.hidden_size,
             intermediate_size=cfg.intermediate_size, top_k=cfg.top_k,
@@ -70,6 +78,11 @@ class MixtralDecoderLayer(nn.Module):
             router_type=cfg.router_type,
             shared_expert_intermediate=cfg.shared_expert_intermediate,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="moe")(h)
+        if cfg.sequence_parallel:
+            # output is fully tp-reduced and replicated: re-shard the
+            # sequence with a plain split (bwd all-gather)
+            moe_out = mappings.scatter_to_sequence_parallel_region(
+                moe_out, seq_dim=1)
         x = x + moe_out
         aux_vec = jnp.stack([aux["load_balance_loss"], aux["z_loss"]])
         return x, aux_vec
@@ -121,6 +134,10 @@ class MixtralModel(nn.Module):
         else:
             auxes = []
             layer_cls = MixtralDecoderLayer
+            if cfg.remat:
+                layer_cls = nn.remat(
+                    layer_cls, prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable)
             for i in range(cfg.num_layers):
                 x, a = layer_cls(cfg, name=f"layer_{i}")(x, cos, sin,
                                                          positions)

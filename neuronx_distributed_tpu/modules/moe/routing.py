@@ -119,6 +119,12 @@ class GroupLimitedRouter(RouterBase):
     def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array, Dict]:
         if self.num_experts % self.num_groups != 0:
             raise ValueError("num_experts must divide into num_groups")
+        allowed = self.topk_groups * (self.num_experts // self.num_groups)
+        if self.top_k > allowed:
+            raise ValueError(
+                f"top_k {self.top_k} exceeds the {allowed} experts reachable "
+                f"through topk_groups={self.topk_groups} (zero-gated -inf "
+                "picks would waste expert capacity)")
         logits = self.logits(x)  # [T, E]
         probs = jax.nn.softmax(logits, axis=-1)
         t = logits.shape[0]

@@ -6,9 +6,10 @@ reference's torch golden fallbacks (``moe/blockwise.py:326``).
 """
 
 from . import flash_attention
+from . import operators
 from . import ring_attention
 from .flash_attention import flash_attention as flash_attention_fn
 from .ring_attention import ring_attention as ring_attention_fn
 
-__all__ = ["flash_attention", "ring_attention", "flash_attention_fn",
+__all__ = ["flash_attention", "operators", "ring_attention", "flash_attention_fn",
            "ring_attention_fn"]

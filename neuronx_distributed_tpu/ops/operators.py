@@ -1,0 +1,64 @@
+"""Distributed argmax / top-k over a tp-sharded dimension.
+
+Analogue of the reference's ``operators/argmax.py:55`` and
+``operators/topk.py:31``: each shard computes its local winners, indices are
+corrected by the shard's global offset, and an all-gather + final reduction
+picks the global result — the full (e.g. vocab) dim never materialises on one
+device. Used by the serving path for greedy/top-k sampling over tp-sharded
+lm-head logits.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel import comm
+from ..parallel import mesh as ps
+
+
+def distributed_argmax(x: jax.Array, axis: str = ps.TP_AXIS,
+                       dim: int = -1) -> jax.Array:
+    """Global argmax indices over the sharded ``dim`` (reference
+    ``argmax:55``)."""
+    n = comm._axis_size(axis)
+    if n is None or n == 1:
+        return jnp.argmax(x, axis=dim)
+    dim = dim % x.ndim
+    local_size = x.shape[dim]
+    local_idx = jnp.argmax(x, axis=dim)
+    local_max = jnp.max(x, axis=dim)
+    offset = lax.axis_index(axis) * local_size
+    global_idx = local_idx + offset
+    # gather each shard's (max, idx) pair and reduce on every shard
+    maxes = lax.all_gather(local_max, axis)          # [n, ...]
+    idxs = lax.all_gather(global_idx, axis)          # [n, ...]
+    winner = jnp.argmax(maxes, axis=0)               # [...]
+    return jnp.take_along_axis(idxs, winner[None], axis=0)[0]
+
+
+def distributed_topk(x: jax.Array, k: int, axis: str = ps.TP_AXIS,
+                     dim: int = -1) -> Tuple[jax.Array, jax.Array]:
+    """Global top-k ``(values, indices)`` over the sharded ``dim``
+    (reference ``topk:31``): local top-k per shard, gather the n*k
+    candidates, re-top-k."""
+    n = comm._axis_size(axis)
+    if n is None or n == 1:
+        return lax.top_k(jnp.moveaxis(x, dim, -1), k)
+    dim = dim % x.ndim
+    local_size = x.shape[dim]
+    if k > local_size:
+        raise ValueError(f"k={k} exceeds local shard size {local_size}")
+    xm = jnp.moveaxis(x, dim, -1)
+    lv, li = lax.top_k(xm, k)                        # [..., k]
+    offset = lax.axis_index(axis) * local_size
+    li = li + offset
+    # gather candidates along the k dim -> [..., n*k]
+    cv = comm.all_gather(lv, axis, dim=-1)
+    ci = comm.all_gather(li, axis, dim=-1)
+    gv, gpos = lax.top_k(cv, k)
+    gi = jnp.take_along_axis(ci, gpos, axis=-1)
+    return gv, gi

@@ -78,6 +78,10 @@ class ColumnParallelLinear(nn.Module):
     bias_init: Initializer = nn.initializers.zeros_init()
     axis: str = ps.TP_AXIS
     seq_dim: int = 1
+    # LoRA adapter (reference modules/lora/tp_layer.py LoraParallelLinear):
+    # 0 disables; A is replicated, B is output-sharded like the kernel.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -90,6 +94,15 @@ class ColumnParallelLinear(nn.Module):
         if self.use_bias:
             bias = self.param("bias", _partitioned(self.bias_init, (self.axis,)),
                               (out_local,), self.param_dtype)
+        lora_a = lora_b = None
+        if self.lora_rank > 0:
+            lora_a = self.param(
+                "lora_a", _partitioned(default_kernel_init, (None, None)),
+                (x.shape[-1], self.lora_rank), self.param_dtype)
+            lora_b = self.param(
+                "lora_b",
+                _partitioned(nn.initializers.zeros_init(), (None, self.axis)),
+                (self.lora_rank, out_local), self.param_dtype)
 
         if self.sequence_parallel:
             x = mappings.gather_from_sequence_parallel_region(
@@ -99,6 +112,11 @@ class ColumnParallelLinear(nn.Module):
 
         x = x.astype(self.dtype)
         y = jnp.dot(x, kernel.astype(self.dtype))
+        if lora_a is not None:
+            scale = self.lora_alpha / self.lora_rank
+            y = y + scale * jnp.dot(
+                jnp.dot(x, lora_a.astype(self.dtype)),
+                lora_b.astype(self.dtype))
         if bias is not None:
             y = y + bias.astype(self.dtype)
         if self.gather_output:
@@ -129,6 +147,10 @@ class RowParallelLinear(nn.Module):
     bias_init: Initializer = nn.initializers.zeros_init()
     axis: str = ps.TP_AXIS
     seq_dim: int = 1
+    # LoRA adapter: A is input-sharded like the kernel, B replicated; the
+    # lora partial sums ride the layer's existing all-reduce/reduce-scatter.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -141,6 +163,18 @@ class RowParallelLinear(nn.Module):
             (in_local, self.features), self.param_dtype)
         x = x.astype(self.dtype)
         y = jnp.dot(x, kernel.astype(self.dtype))
+        if self.lora_rank > 0:
+            lora_a = self.param(
+                "lora_a", _partitioned(default_kernel_init, (self.axis, None)),
+                (in_local, self.lora_rank), self.param_dtype)
+            lora_b = self.param(
+                "lora_b",
+                _partitioned(nn.initializers.zeros_init(), (None, None)),
+                (self.lora_rank, self.features), self.param_dtype)
+            scale = self.lora_alpha / self.lora_rank
+            y = y + scale * jnp.dot(
+                jnp.dot(x, lora_a.astype(self.dtype)),
+                lora_b.astype(self.dtype))
         if self.sequence_parallel:
             y = mappings.reduce_scatter_to_sequence_parallel_region(
                 y, self.axis, self.seq_dim)
@@ -170,6 +204,10 @@ class ParallelEmbedding(nn.Module):
     param_dtype: Dtype = jnp.float32
     embedding_init: Initializer = default_embed_init
     axis: str = ps.TP_AXIS
+    # LoRA adapter (reference modules/lora/layer.py LoraEmbedding): A is
+    # vocab-sharded like the table, B replicated.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, ids: jax.Array) -> jax.Array:
@@ -178,16 +216,36 @@ class ParallelEmbedding(nn.Module):
             "embedding",
             _partitioned(self.embedding_init, (self.axis, None)),
             (vocab_local, self.features), self.param_dtype)
+        lora_a = lora_b = None
+        if self.lora_rank > 0:
+            lora_a = self.param(
+                "lora_a",
+                _partitioned(nn.initializers.zeros_init(), (self.axis, None)),
+                (vocab_local, self.lora_rank), self.param_dtype)
+            lora_b = self.param(
+                "lora_b", _partitioned(default_kernel_init, (None, None)),
+                (self.lora_rank, self.features), self.param_dtype)
+
+        def lookup(tbl, idx):
+            return jnp.take(tbl.astype(self.dtype), idx, axis=0)
+
+        scale = (self.lora_alpha / self.lora_rank if self.lora_rank else 0.0)
         s = _bound_size(self.axis)
         if s is None or s == 1:
-            out = jnp.take(table.astype(self.dtype), ids, axis=0)
+            out = lookup(table, ids)
+            if lora_a is not None:
+                out = out + scale * jnp.dot(lookup(lora_a, ids),
+                                            lora_b.astype(self.dtype))
             return out
         rank = jax.lax.axis_index(self.axis)
         start = rank * vocab_local
         local_ids = ids - start
         valid = (local_ids >= 0) & (local_ids < vocab_local)
         local_ids = jnp.where(valid, local_ids, 0)
-        out = jnp.take(table.astype(self.dtype), local_ids, axis=0)
+        out = lookup(table, local_ids)
+        if lora_a is not None:
+            out = out + scale * jnp.dot(lookup(lora_a, local_ids),
+                                        lora_b.astype(self.dtype))
         out = jnp.where(valid[..., None], out, jnp.zeros_like(out))
         return mappings.reduce_from_tensor_parallel_region(out, self.axis)
 
@@ -218,6 +276,9 @@ class GQAQKVColumnParallelLinear(nn.Module):
     axis: str = ps.TP_AXIS
     seq_dim: int = 1
     tp_size: Optional[int] = None  # required to size KV replication
+    # LoRA adapters (weight-space; reference LoraGQAQKVParallelLinear)
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     def _tp(self) -> int:
         s = _bound_size(self.axis)
@@ -263,6 +324,32 @@ class GQAQKVColumnParallelLinear(nn.Module):
                         kv_shape, self.param_dtype)
         wv = self.param("v_kernel", _partitioned(self.kernel_init, kv_names),
                         kv_shape, self.param_dtype)
+        if self.lora_rank > 0:
+            # weight-space adapters (reference LoraGQAQKVParallelLinear,
+            # tp_layer.py:62): delta = scale * A @ B added to each kernel,
+            # so the GQA slice/copy paths below need no changes
+            scale = self.lora_alpha / self.lora_rank
+            qa = self.param("q_lora_a",
+                            _partitioned(default_kernel_init, (None, None)),
+                            (x.shape[-1], self.lora_rank), self.param_dtype)
+            qb = self.param("q_lora_b", _partitioned(
+                nn.initializers.zeros_init(), (None, self.axis)),
+                (self.lora_rank, q_local), self.param_dtype)
+            ka = self.param("k_lora_a",
+                            _partitioned(default_kernel_init, (None, None)),
+                            (x.shape[-1], self.lora_rank), self.param_dtype)
+            kb = self.param("k_lora_b", _partitioned(
+                nn.initializers.zeros_init(), kv_names),
+                (self.lora_rank, kv_shape[1]), self.param_dtype)
+            va = self.param("v_lora_a",
+                            _partitioned(default_kernel_init, (None, None)),
+                            (x.shape[-1], self.lora_rank), self.param_dtype)
+            vb = self.param("v_lora_b", _partitioned(
+                nn.initializers.zeros_init(), kv_names),
+                (self.lora_rank, kv_shape[1]), self.param_dtype)
+            wq = wq + scale * (qa @ qb)
+            wk = wk + scale * (ka @ kb)
+            wv = wv + scale * (va @ vb)
 
         bq = bk = bv = None
         if self.use_bias:

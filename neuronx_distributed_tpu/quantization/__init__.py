@@ -1,0 +1,23 @@
+"""Quantization (reference: ``quantization/``)."""
+
+from . import quantization_layers
+from . import quantization_utils
+from . import quantize as quantize_api
+from .quantization_layers import QuantizedColumnParallel, QuantizedRowParallel
+from .quantization_utils import (QuantizationType, QuantizedDtype,
+                                 dequantize, direct_cast_quantize, quantize)
+from .quantize import convert
+
+__all__ = [
+    "quantization_layers",
+    "quantization_utils",
+    "quantize_api",
+    "QuantizedColumnParallel",
+    "QuantizedRowParallel",
+    "QuantizationType",
+    "QuantizedDtype",
+    "dequantize",
+    "direct_cast_quantize",
+    "quantize",
+    "convert",
+]

@@ -1,0 +1,122 @@
+"""Quantized tensor-parallel linear layers.
+
+Analogue of the reference's ``quantization/quantization_layers.py``
+(``BaseQuantizeParallelLinear:73``, ``QuantizedColumnParallel:465``,
+``QuantizedRowParallel:744``): weight-quantized variants of the parallel
+linears with the same sharding and collective structure.
+
+Two execution modes:
+
+* ``w8a16`` (weight-only): dequantise the int8/fp8 kernel to the compute
+  dtype and run a bf16 MXU matmul — HBM-bandwidth-bound decode gets the
+  2-4x weight-size win.
+* ``w8a8``: dynamically quantise activations per-tensor and run the matmul
+  in the quantized dtype (int8 → int32 accumulate on the MXU; fp8 native),
+  rescaling by ``act_scale * weight_scale``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..parallel import layers as pl
+from ..parallel import mappings
+from ..parallel import mesh as ps
+from .quantization_utils import (QuantizationType, QuantizedDtype, dequantize,
+                                 quantize)
+
+
+class _QuantBase(nn.Module):
+    features: int
+    use_bias: bool = False
+    quantized_dtype: QuantizedDtype = QuantizedDtype.INT8
+    quantization_type: QuantizationType = (
+        QuantizationType.PER_CHANNEL_SYMMETRIC)
+    activation_quantization: bool = False  # w8a8 vs w8a16
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    axis: str = ps.TP_AXIS
+
+    def _qparams(self, name: str, shape, out_names):
+        """Quantized kernel + per-output-channel scale params."""
+        qkernel = self.param(
+            f"{name}_q",
+            nn.with_partitioning(
+                lambda key, s, d: jnp.zeros(s, d), out_names),
+            shape, self.quantized_dtype.jnp_dtype)
+        scale = self.param(
+            f"{name}_scale",
+            nn.with_partitioning(
+                nn.initializers.ones_init(),
+                (out_names[-1],) if self.quantization_type
+                == QuantizationType.PER_CHANNEL_SYMMETRIC else (None,)),
+            (shape[-1],) if self.quantization_type
+            == QuantizationType.PER_CHANNEL_SYMMETRIC else (1,),
+            jnp.float32)
+        return qkernel, scale
+
+    def _matmul(self, x: jax.Array, qkernel: jax.Array,
+                scale: jax.Array) -> jax.Array:
+        if not self.activation_quantization:
+            w = dequantize(qkernel, scale[None, :], self.dtype)
+            return jnp.dot(x.astype(self.dtype), w)
+        # dynamic per-tensor activation quant (w8a8)
+        qx, x_scale = quantize(x, self.quantized_dtype,
+                               QuantizationType.PER_TENSOR_SYMMETRIC)
+        if self.quantized_dtype == QuantizedDtype.INT8:
+            acc = jax.lax.dot_general(
+                qx, qkernel, (((qx.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+        else:
+            acc = jax.lax.dot_general(
+                qx, qkernel, (((qx.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        return (acc.astype(jnp.float32) * x_scale
+                * scale[None, :]).astype(self.dtype)
+
+
+class QuantizedColumnParallel(_QuantBase):
+    """Reference ``QuantizedColumnParallel:465``."""
+
+    gather_output: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        out_local = pl._maybe_local(self.features, self.axis)
+        qkernel, scale = self._qparams(
+            "kernel", (x.shape[-1], out_local), (None, self.axis))
+        x = mappings.copy_to_tensor_parallel_region(x, self.axis)
+        y = self._matmul(x, qkernel, scale)
+        if self.use_bias:
+            bias = self.param("bias", nn.with_partitioning(
+                nn.initializers.zeros_init(), (self.axis,)),
+                (out_local,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        if self.gather_output:
+            y = mappings.gather_from_tensor_parallel_region(y, self.axis, -1)
+        return y
+
+
+class QuantizedRowParallel(_QuantBase):
+    """Reference ``QuantizedRowParallel:744``."""
+
+    input_is_parallel: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if not self.input_is_parallel:
+            x = mappings.scatter_to_tensor_parallel_region(x, self.axis, -1)
+        qkernel, scale = self._qparams(
+            "kernel", (x.shape[-1], self.features), (self.axis, None))
+        y = self._matmul(x, qkernel, scale)
+        y = mappings.reduce_from_tensor_parallel_region(y, self.axis)
+        if self.use_bias:
+            bias = self.param("bias", nn.with_partitioning(
+                nn.initializers.zeros_init(), (None,)),
+                (self.features,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        return y

@@ -1,0 +1,80 @@
+"""Quantize/dequantize primitives.
+
+Analogue of the reference's ``quantization/quantization_utils.py`` (fp8/int8
+per-tensor/per-channel quantize ``:126,144``), ``dequantize.py`` and
+``observer.py`` (abs-max observer).
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantizationType(str, Enum):
+    """Reference ``quantization_config.py:65``."""
+
+    PER_TENSOR_SYMMETRIC = "per_tensor_symmetric"
+    PER_CHANNEL_SYMMETRIC = "per_channel_symmetric"
+
+
+class QuantizedDtype(str, Enum):
+    """Reference ``quantization_config.py:100``."""
+
+    INT8 = "int8"
+    FP8E4M3 = "f8e4m3"
+    FP8E5M2 = "f8e5m2"
+
+    @property
+    def jnp_dtype(self):
+        return {QuantizedDtype.INT8: jnp.int8,
+                QuantizedDtype.FP8E4M3: jnp.float8_e4m3fn,
+                QuantizedDtype.FP8E5M2: jnp.float8_e5m2}[self]
+
+    @property
+    def max_value(self) -> float:
+        return {QuantizedDtype.INT8: 127.0,
+                QuantizedDtype.FP8E4M3: 448.0,
+                QuantizedDtype.FP8E5M2: 57344.0}[self]
+
+
+def abs_max(x: jax.Array, axis=None, keepdims=False) -> jax.Array:
+    """Abs-max observer (reference ``observer.py``)."""
+    return jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
+                   keepdims=keepdims)
+
+
+def quantize(x: jax.Array, dtype: QuantizedDtype = QuantizedDtype.INT8,
+             qtype: QuantizationType = QuantizationType.PER_CHANNEL_SYMMETRIC,
+             channel_axis: int = -1) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric quantisation; returns ``(q, scale)`` with
+    ``x ≈ q * scale`` (reference ``quantization_utils.py:126,144``)."""
+    if qtype == QuantizationType.PER_TENSOR_SYMMETRIC:
+        amax = abs_max(x)
+    else:
+        reduce_axes = tuple(i for i in range(x.ndim)
+                            if i != channel_axis % x.ndim)
+        amax = abs_max(x, axis=reduce_axes, keepdims=True)
+    scale = amax / dtype.max_value
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = x.astype(jnp.float32) / scale
+    if dtype == QuantizedDtype.INT8:
+        q = jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8)
+    else:
+        q = jnp.clip(q, -dtype.max_value, dtype.max_value).astype(
+            dtype.jnp_dtype)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize(q: jax.Array, scale: jax.Array,
+               dtype=jnp.bfloat16) -> jax.Array:
+    """Reference ``dequantize.py:79``."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def direct_cast_quantize(x: jax.Array, dtype: QuantizedDtype) -> jax.Array:
+    """Scale-free cast (reference ``quantize.py:148``)."""
+    return x.astype(dtype.jnp_dtype)

@@ -1,0 +1,43 @@
+"""Float→quantized checkpoint conversion.
+
+Analogue of the reference's ``quantization/quantize.py`` (``convert:18``
+module-swap + state-dict adaptation): here the "module swap" is a param-tree
+transform — every targeted 2-D kernel becomes ``(kernel_q, kernel_scale)``
+consumable by the quantized layers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .quantization_utils import QuantizationType, QuantizedDtype, quantize
+
+
+def convert(params: Any,
+            dtype: QuantizedDtype = QuantizedDtype.INT8,
+            qtype: QuantizationType = QuantizationType.PER_CHANNEL_SYMMETRIC,
+            kernel_keys: Sequence[str] = ("kernel",)) -> Any:
+    """Quantise every ``kernel_keys`` leaf; other leaves pass through.
+
+    Returns a tree where each ``kernel`` is replaced by ``kernel_q`` +
+    ``kernel_scale`` (the quantized layers' param names).
+    """
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if k in kernel_keys and hasattr(v, "ndim") and v.ndim == 2:
+                q, scale = quantize(v, dtype, qtype, channel_axis=-1)
+                out[f"{k}_q"] = q
+                out[f"{k}_scale"] = scale.reshape(-1)
+            elif isinstance(v, dict):
+                out[k] = walk(v)
+            else:
+                out[k] = v
+        return out
+
+    return walk(params)

@@ -1,0 +1,5 @@
+"""Conversion and operator CLIs (reference: ``scripts/``)."""
+
+from . import checkpoint_converter
+
+__all__ = ["checkpoint_converter"]

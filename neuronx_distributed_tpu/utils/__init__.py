@@ -1,5 +1,10 @@
 """Utilities (reference: ``utils/``)."""
 
 from . import batch_utils
+from . import logger
+from . import tensor_capture
+from . import timeline
+from .logger import get_logger, rmsg
 
-__all__ = ["batch_utils"]
+__all__ = ["batch_utils", "logger", "tensor_capture", "timeline",
+           "get_logger", "rmsg"]

@@ -1,0 +1,64 @@
+"""Rank-aware logging.
+
+Analogue of the reference's ``utils/logger.py`` (``get_logger:52``,
+env-controlled level via ``NXD_LOG_LEVEL``) and the ``rmsg`` rank-prefix
+helper (``parallel_state.py:1648-1682``). In single-controller JAX there is
+one process per host (not per chip); "rank 0" gating maps to
+``jax.process_index() == 0``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+_LOGGERS = {}
+
+
+def get_log_level() -> int:
+    level = os.environ.get("NXD_LOG_LEVEL", "INFO").upper()
+    return getattr(logging, level, logging.INFO)
+
+
+def get_logger(name: str = "neuronx_distributed_tpu",
+               rank0_only: bool = True) -> logging.Logger:
+    """Reference ``get_logger:52``: on non-zero processes, rank0_only
+    loggers drop everything below WARNING."""
+    key = (name, rank0_only)
+    if key in _LOGGERS:
+        return _LOGGERS[key]
+    logger = logging.getLogger(name)
+    logger.setLevel(get_log_level())
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s [%(levelname)s] %(name)s: %(message)s"))
+        logger.addHandler(h)
+        logger.propagate = False
+    try:
+        import jax
+
+        if rank0_only and jax.process_index() != 0:
+            logger.setLevel(logging.WARNING)
+    except Exception:
+        pass
+    _LOGGERS[key] = logger
+    return logger
+
+
+def rmsg(msg: str) -> str:
+    """Prefix a message with the mesh position (reference ``rmsg``:
+    tp/pp/dp rank prefix). Host-side: reports process index and mesh shape;
+    per-shard ranks only exist inside shard_map."""
+    try:
+        import jax
+
+        from ..parallel import mesh as ps
+
+        if ps.model_parallel_is_initialized():
+            shape = dict(ps.get_mesh().shape)
+            return f"[proc {jax.process_index()} mesh {shape}] {msg}"
+        return f"[proc {jax.process_index()}] {msg}"
+    except Exception:
+        return msg

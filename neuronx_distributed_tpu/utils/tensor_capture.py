@@ -1,0 +1,76 @@
+"""Debug tensor capture and replacement.
+
+Analogue of the reference's ``utils/tensor_capture/`` (hook-based capture of
+intermediate tensors, ``api.py:16``) and ``utils/tensor_replacement/``
+(inject replacement tensors into the forward). Flax provides both natively:
+
+* capture: ``module.apply(..., capture_intermediates=...)`` records every
+  (or a filtered set of) submodule output into the ``intermediates``
+  collection;
+* replacement: :func:`apply_with_replacements` swaps chosen param leaves
+  before the forward (the functional analogue of hooking a module input).
+
+Plus :func:`max_diff`, the reference's capture-comparison helper for
+debugging parallel-vs-reference divergence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def capture_intermediates(module, variables, *args,
+                          filter_fn: Optional[Callable] = None,
+                          method=None, **kwargs) -> Tuple[Any, Dict]:
+    """Run a forward capturing intermediate outputs.
+
+    Returns ``(outputs, intermediates)`` where intermediates is a nested
+    dict of sown tensors keyed by module path (reference
+    ``enable_tensor_capture``).
+    """
+    flt = filter_fn if filter_fn is not None else (lambda mdl, m: True)
+    out, mods = module.apply(variables, *args, method=method,
+                             capture_intermediates=flt,
+                             mutable=["intermediates"], **kwargs)
+    return out, mods.get("intermediates", {})
+
+
+def apply_with_replacements(module, variables, replacements: Dict[str, Any],
+                            *args, method=None, **kwargs):
+    """Forward with selected param leaves replaced (reference
+    ``tensor_replacement``). ``replacements`` maps '/'-joined param paths
+    (e.g. ``"params/model/norm/scale"``) to arrays."""
+    flat = {"/".join(str(getattr(k, "key", k)) for k in path): leaf
+            for path, leaf in jax.tree_util.tree_leaves_with_path(variables)}
+    missing = set(replacements) - set(flat)
+    if missing:
+        raise KeyError(f"replacement paths not found: {sorted(missing)}; "
+                       f"available e.g. {sorted(flat)[:5]}")
+
+    def substitute(path, leaf):
+        key = "/".join(str(getattr(k, "key", k)) for k in path)
+        return replacements.get(key, leaf)
+
+    patched = jax.tree_util.tree_map_with_path(substitute, variables)
+    return module.apply(patched, *args, method=method, **kwargs)
+
+
+def max_diff(a: Any, b: Any) -> Dict[str, float]:
+    """Max abs difference per leaf between two pytrees (reference capture
+    comparison)."""
+    out = {}
+    fa = jax.tree_util.tree_leaves_with_path(a)
+    fb = dict(jax.tree_util.tree_leaves_with_path(b))
+    for path, leaf in fa:
+        other = fb.get(path)
+        key = jax.tree_util.keystr(path)
+        if other is None:
+            out[key] = float("nan")
+        else:
+            out[key] = float(jnp.max(jnp.abs(
+                jnp.asarray(leaf, jnp.float32)
+                - jnp.asarray(other, jnp.float32))))
+    return out

@@ -1,0 +1,75 @@
+"""Execution timelines and profiling glue.
+
+Analogue of the reference's chrome-trace ``Timeline`` (``utils/timeline.py:
+15-141``: mark_event_start/end, per-step JSON chrome events) and
+``PPTimeline`` (``pipeline/timeline.py:10``). On TPU the heavy lifting is
+``jax.profiler`` (XLA traces viewable in Perfetto/TensorBoard); this module
+keeps the reference's lightweight host-side event timeline for schedule
+debugging, and wraps the jax profiler for one-call step captures.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Timeline:
+    """Host-side chrome-trace event recorder (reference ``Timeline``)."""
+
+    def __init__(self, output_file: str = "timeline.json",
+                 enabled: bool = True):
+        self.output_file = output_file
+        self.enabled = enabled
+        self._events: List[Dict[str, Any]] = []
+        self._open: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def mark_event_start(self, name: str) -> None:
+        if self.enabled:
+            with self._lock:
+                self._open[name] = time.perf_counter_ns() / 1000.0
+
+    def mark_event_end(self, name: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            start = self._open.pop(name, None)
+            if start is None:
+                return
+            now = time.perf_counter_ns() / 1000.0
+            self._events.append({
+                "name": name, "ph": "X", "ts": start, "dur": now - start,
+                "pid": os.getpid(), "tid": threading.get_ident() % 10000,
+            })
+
+    @contextlib.contextmanager
+    def event(self, name: str):
+        self.mark_event_start(name)
+        try:
+            yield
+        finally:
+            self.mark_event_end(name)
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or self.output_file
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self._events}, f)
+        return path
+
+
+@contextlib.contextmanager
+def profile_step(logdir: str = "/tmp/nxd_profile"):
+    """Capture an XLA device trace for the enclosed step(s); view with
+    Perfetto / TensorBoard (SURVEY §5: 'jax.profiler traces + Perfetto')."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,194 @@
+"""Inference stack tests: KV-cache decode parity, generation, sampling,
+AOT builder routing and serialization."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.inference import (
+    KVCache, ModelBuilder, NxDModel, SamplingConfig, generate,
+    init_kv_cache, pick_bucket, sample)
+from neuronx_distributed_tpu.models.llama import (
+    LlamaForCausalLM, llama_forward_with_cache, tiny_config)
+from neuronx_distributed_tpu.parallel import mesh as ps
+
+
+@pytest.fixture
+def tiny_model():
+    ps.initialize_model_parallel()
+    cfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                      num_layers=2)
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    params = meta.unbox(model.init(jax.random.key(0), ids))
+    return cfg, model, params
+
+
+def test_cached_prefill_matches_uncached(tiny_model):
+    """Prefill logits through the KV cache == the plain forward."""
+    cfg, model, params = tiny_model
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    ref = model.apply(params, ids)
+
+    cache = init_kv_cache(cfg.num_layers, 2, 32, cfg.num_kv_heads,
+                          cfg.head_dim_, dtype=jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(16), (2, 16))
+    logits, cache = llama_forward_with_cache(cfg, params, ids, positions,
+                                             cache)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    assert int(cache.index) == 16
+
+
+def test_incremental_decode_matches_full_forward(tiny_model):
+    """Token-by-token decode reproduces the full-sequence logits."""
+    cfg, model, params = tiny_model
+    ids = jax.random.randint(jax.random.key(2), (1, 8), 0, cfg.vocab_size)
+    full = model.apply(params, ids)  # [1, 8, V]
+
+    cache = init_kv_cache(cfg.num_layers, 1, 16, cfg.num_kv_heads,
+                          cfg.head_dim_, dtype=jnp.float32)
+    outs = []
+    for t in range(8):
+        logits, cache = llama_forward_with_cache(
+            cfg, params, ids[:, t:t + 1],
+            jnp.full((1, 1), t, jnp.int32), cache)
+        outs.append(logits[:, 0])
+    dec = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(full),
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_ragged_prefill_pads_never_attended(tiny_model):
+    """Right-padded prompts give the same last-token logits as unpadded."""
+    cfg, model, params = tiny_model
+    ids = jax.random.randint(jax.random.key(3), (1, 6), 0, cfg.vocab_size)
+
+    from neuronx_distributed_tpu.inference.generation import prefill
+
+    # unpadded reference
+    cache1 = init_kv_cache(cfg.num_layers, 1, 16, cfg.num_kv_heads,
+                           cfg.head_dim_, dtype=jnp.float32)
+    last1, _ = prefill(cfg, params, ids, jnp.array([6]), cache1)
+    # padded to 12 with garbage tokens
+    padded = jnp.pad(ids, ((0, 0), (0, 6)), constant_values=7)
+    cache2 = init_kv_cache(cfg.num_layers, 1, 16, cfg.num_kv_heads,
+                           cfg.head_dim_, dtype=jnp.float32)
+    last2, _ = prefill(cfg, params, padded, jnp.array([6]), cache2)
+    np.testing.assert_allclose(np.asarray(last1), np.asarray(last2),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_generate_greedy_deterministic(tiny_model):
+    cfg, model, params = tiny_model
+    ids = jax.random.randint(jax.random.key(4), (2, 5), 0, cfg.vocab_size)
+    toks = generate(cfg, params, ids, jnp.array([5, 3]),
+                    max_new_tokens=6, buckets=(8, 16))
+    assert toks.shape == (2, 6)
+    toks2 = generate(cfg, params, ids, jnp.array([5, 3]),
+                     max_new_tokens=6, buckets=(8, 16))
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(toks2))
+
+
+def test_generate_matches_argmax_of_forward(tiny_model):
+    """First greedy token == argmax of the plain forward at the last
+    prompt position."""
+    cfg, model, params = tiny_model
+    ids = jax.random.randint(jax.random.key(5), (1, 7), 0, cfg.vocab_size)
+    toks = generate(cfg, params, ids, jnp.array([7]), max_new_tokens=1,
+                    buckets=(8,))
+    ref = jnp.argmax(model.apply(params, ids)[:, -1], axis=-1)
+    np.testing.assert_array_equal(np.asarray(toks[:, 0]), np.asarray(ref))
+
+
+def test_sampling_modes():
+    logits = jnp.array([[0.0, 5.0, 1.0, -2.0]])
+    assert int(sample(logits, jax.random.key(0),
+                      SamplingConfig(greedy=True))[0]) == 1
+    # top_k=1 == greedy
+    assert int(sample(logits, jax.random.key(1),
+                      SamplingConfig(top_k=1))[0]) == 1
+    # top_p tiny -> only the top token survives
+    assert int(sample(logits, jax.random.key(2),
+                      SamplingConfig(top_p=0.1))[0]) == 1
+    # temperature sampling stays in-range
+    t = sample(jnp.zeros((4, 8)), jax.random.key(3),
+               SamplingConfig(temperature=2.0))
+    assert t.shape == (4,) and (np.asarray(t) < 8).all()
+
+
+def test_pick_bucket():
+    assert pick_bucket(5, (8, 16)) == 8
+    assert pick_bucket(8, (8, 16)) == 8
+    assert pick_bucket(9, (8, 16)) == 16
+    with pytest.raises(ValueError):
+        pick_bucket(99, (8, 16))
+
+
+def test_model_builder_trace_compile_route(tiny_model):
+    cfg, model, params = tiny_model
+
+    def ce_fn(ids):
+        return model.apply(params, ids)
+
+    builder = ModelBuilder()
+    builder.add("context_encoding", ce_fn,
+                [(jnp.zeros((2, 8), jnp.int32),),
+                 (jnp.zeros((2, 16), jnp.int32),)],
+                priority_model=True)
+    nxd_model = builder.trace().compile()
+    assert nxd_model.keys() == ["context_encoding"]
+
+    ids = jax.random.randint(jax.random.key(6), (2, 8), 0, cfg.vocab_size)
+    out = nxd_model.forward("context_encoding", ids)
+    ref = ce_fn(ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-3,
+                               atol=1e-5)
+    with pytest.raises(KeyError):
+        nxd_model.forward("nope", ids)
+
+
+def test_model_builder_save_load_roundtrip(tiny_model, tmp_path):
+    cfg, model, params = tiny_model
+
+    def ce_fn(ids):
+        return model.apply(params, ids)
+
+    nxd_model = (ModelBuilder()
+                 .add("ce", ce_fn, [(jnp.zeros((1, 8), jnp.int32),)])
+                 .trace().compile())
+    path = str(tmp_path / "model.nxd")
+    nxd_model.save(path)
+
+    loaded = NxDModel.load(path)
+    ids = jax.random.randint(jax.random.key(7), (1, 8), 0, cfg.vocab_size)
+    out = loaded.forward("ce", ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ce_fn(ids)),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_distributed_argmax_topk():
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_tpu.ops.operators import (distributed_argmax,
+                                                       distributed_topk)
+
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    x = jax.random.normal(jax.random.key(0), (3, 32))
+    ref_arg = jnp.argmax(x, axis=-1)
+    ref_v, ref_i = jax.lax.top_k(x, 4)
+
+    arg = jax.jit(ps.shard_map(
+        lambda x: distributed_argmax(x), mesh,
+        in_specs=P(None, "tp"), out_specs=P(None)))(x)
+    np.testing.assert_array_equal(np.asarray(arg), np.asarray(ref_arg))
+
+    v, i = jax.jit(ps.shard_map(
+        lambda x: distributed_topk(x, 4), mesh,
+        in_specs=P(None, "tp"), out_specs=(P(None, None), P(None, None))))(x)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(ref_v), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i))

@@ -1,0 +1,139 @@
+"""LoRA adapter tests: zero-init identity, TP parity, adapter-only training,
+merge, adapter checkpoints."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+from jax.sharding import PartitionSpec as P
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu import lora as lora_mod
+from neuronx_distributed_tpu.lora import LoraConfig
+from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                  tiny_config)
+from neuronx_distributed_tpu.parallel import mesh as ps
+
+
+def _model(lora=None, **kw):
+    cfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                      num_layers=1, lora=lora, **kw)
+    return cfg, LlamaForCausalLM(cfg)
+
+
+def test_lora_init_is_identity():
+    """B zero-init: fresh adapters leave the forward unchanged."""
+    ps.initialize_model_parallel()
+    ids = jax.random.randint(jax.random.key(0), (2, 8), 0, 256)
+    cfg0, m0 = _model()
+    p0 = meta.unbox(m0.init(jax.random.key(1), ids))
+    base = m0.apply(p0, ids)
+
+    lcfg = LoraConfig(r=4, target_modules=("qkv", "o_proj", "gate_up",
+                                           "down", "embed", "lm_head"))
+    cfg1, m1 = _model(lora=lcfg)
+    p1 = meta.unbox(m1.init(jax.random.key(1), ids))
+    # adapters present
+    flat = lora_mod.extract_lora_state(p1)
+    assert flat, "no lora params created"
+    out = m1.apply(p1, ids)
+    # base params initialized with same rng order? compare via merged check:
+    merged = lora_mod.merge_lora_params(p1, lcfg)
+    out_merged = m1_base_apply = LlamaForCausalLM(
+        tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                    num_layers=1)).apply(merged, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_merged),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lora_only_training_updates_adapters():
+    ps.initialize_model_parallel(tensor_model_parallel_size=2)
+    import optax
+
+    lcfg = LoraConfig(r=4, target_modules=("qkv", "o_proj"))
+    cfg, model = _model(lora=lcfg)
+    ids = jax.random.randint(jax.random.key(0), (4, 17), 0, 256)
+    batch_ids, labels = ids[:, :-1], ids[:, 1:]
+
+    from neuronx_distributed_tpu.trainer import initialize_parallel_model
+
+    nxd_cfg = nxd.NxDConfig()
+    pm, params = initialize_parallel_model(nxd_cfg, model, jax.random.key(1),
+                                           batch_ids)
+    tx = lora_mod.make_lora_optimizer(optax.adam(1e-2), params)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, g = jax.value_and_grad(
+            lambda p: model.apply(p, batch_ids, labels, method="loss"))(
+                params)
+        updates, opt_state = tx.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    p0 = jax.tree_util.tree_map(np.asarray, params)
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    # base weights unchanged; adapters changed
+    flat0 = dict(jax.tree_util.tree_leaves_with_path(p0))
+    changed_lora = unchanged_base = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        same = np.allclose(np.asarray(leaf), flat0[path])
+        if lora_mod.is_lora_path(path):
+            if not same:
+                changed_lora += 1
+        else:
+            assert same, f"base param changed: {jax.tree_util.keystr(path)}"
+            unchanged_base += 1
+    assert changed_lora > 0 and unchanged_base > 0
+
+
+def test_lora_tp_parity():
+    """LoRA forward under tp=4 shard_map == unsharded."""
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    lcfg = LoraConfig(r=4, target_modules=("qkv", "o_proj", "down"))
+    cfg, model = _model(lora=lcfg, tp_size=4)
+    ids = jax.random.randint(jax.random.key(0), (2, 8), 0, 256)
+    boxed = model.init(jax.random.key(1), ids)
+    from flax import linen as nn
+
+    from neuronx_distributed_tpu.trainer.trainer import _spec_tree
+
+    params = meta.unbox(boxed)
+    # make adapters nonzero so the test is meaningful
+    params = jax.tree_util.tree_map_with_path(
+        lambda path, x: x + 0.01 if lora_mod.is_lora_path(path) else x,
+        params)
+    specs = _spec_tree(boxed)
+    ref = model.apply(params, ids)
+    out = jax.jit(ps.shard_map(
+        lambda p, i: model.apply(p, i), mesh,
+        in_specs=(specs, P(None, None)),
+        out_specs=P(None, None, "tp")))(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_adapter_checkpoint_roundtrip():
+    ps.initialize_model_parallel()
+    lcfg = LoraConfig(r=2, target_modules=("qkv",))
+    cfg, model = _model(lora=lcfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = meta.unbox(model.init(jax.random.key(1), ids))
+    adapters = lora_mod.extract_lora_state(params)
+    leaves = jax.tree_util.tree_leaves(adapters)
+    assert leaves and all(l.size for l in leaves)
+    # wipe adapters then restore
+    wiped = jax.tree_util.tree_map_with_path(
+        lambda path, x: jnp.full_like(x, 9.0)
+        if lora_mod.is_lora_path(path) else x, params)
+    restored = lora_mod.merge_lora_state(wiped, adapters)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(restored):
+        ref = dict(jax.tree_util.tree_leaves_with_path(params))[path]
+        np.testing.assert_array_equal(np.asarray(leaf), np.asarray(ref))

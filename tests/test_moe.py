@@ -171,3 +171,35 @@ def test_mixtral_cp_positions_match_dense():
         inner, mesh, in_specs=(pm.param_specs, P(None, "cp"), P(None, "cp")),
         out_specs=P()))(params, batch_ids, labels)
     np.testing.assert_allclose(float(sharded), float(dense), rtol=2e-4)
+
+
+def test_mixtral_sequence_parallel_matches_dense():
+    """Regression: Mixtral SP must gather sequences before routing."""
+    from neuronx_distributed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                        tiny_moe_config)
+    from neuronx_distributed_tpu.trainer import initialize_parallel_model
+    from neuronx_distributed_tpu.trainer.trainer import _spec_tree
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=4)
+    mesh = ps.get_mesh()
+    mcfg = tiny_moe_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                           num_layers=1, capacity_factor=4.0,
+                           sequence_parallel=True, tp_size=4)
+    model = MixtralForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (2, 16), 0, mcfg.vocab_size)
+    labels = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                mcfg.vocab_size)
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(2),
+                                           ids)
+    host = jax.tree_util.tree_map(np.asarray, params)
+    # dense reference without SP (same params)
+    dense_model = MixtralForCausalLM(tiny_moe_config(
+        dtype=jnp.float32, param_dtype=jnp.float32, num_layers=1,
+        capacity_factor=4.0))
+    dense = dense_model.apply(host, ids, labels, method="loss")
+
+    sharded = jax.jit(ps.shard_map(
+        lambda p, i, l: model.apply(p, i, l, method="loss"), mesh,
+        in_specs=(pm.param_specs, P(None, None), P(None, None)),
+        out_specs=P()))(params, ids, labels)
+    np.testing.assert_allclose(float(sharded), float(dense), rtol=2e-4)

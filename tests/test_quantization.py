@@ -1,0 +1,90 @@
+"""Quantization tests: quantize/dequantize roundtrip, quantized layer
+accuracy vs float, TP parity, convert API."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import layers as pl
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.quantization import (
+    QuantizationType, QuantizedColumnParallel, QuantizedDtype,
+    QuantizedRowParallel, convert, dequantize, quantize)
+
+
+@pytest.mark.parametrize("dtype", [QuantizedDtype.INT8,
+                                   QuantizedDtype.FP8E4M3])
+@pytest.mark.parametrize("qtype", [QuantizationType.PER_TENSOR_SYMMETRIC,
+                                   QuantizationType.PER_CHANNEL_SYMMETRIC])
+def test_quantize_roundtrip(dtype, qtype):
+    w = jax.random.normal(jax.random.key(0), (32, 16)) * 0.1
+    q, scale = quantize(w, dtype, qtype)
+    assert q.dtype == dtype.jnp_dtype
+    back = dequantize(q, scale if qtype.name.startswith("PER_TENSOR")
+                      else scale, jnp.float32)
+    err = np.abs(np.asarray(back) - np.asarray(w)).max()
+    # int8: 8-bit grid; fp8e4m3: 3 mantissa bits (~6% rel near max)
+    limit = 0.01 if dtype == QuantizedDtype.INT8 else 0.05
+    assert err < limit, err
+
+
+@pytest.mark.parametrize("act_quant", [False, True])
+def test_quantized_column_close_to_float(act_quant):
+    ps.initialize_model_parallel()
+    x = jax.random.normal(jax.random.key(0), (4, 16)) * 0.5
+    w = jax.random.normal(jax.random.key(1), (16, 32)) * 0.1
+    ref = x @ w
+
+    layer = QuantizedColumnParallel(features=32,
+                                    activation_quantization=act_quant,
+                                    dtype=jnp.float32)
+    q, scale = quantize(w, QuantizedDtype.INT8,
+                        QuantizationType.PER_CHANNEL_SYMMETRIC)
+    params = {"params": {"kernel_q": q, "kernel_scale": scale.reshape(-1)}}
+    out = layer.apply(params, x)
+    rel = (np.abs(np.asarray(out) - np.asarray(ref)).max()
+           / np.abs(np.asarray(ref)).max())
+    assert rel < (0.05 if act_quant else 0.02), rel
+
+
+def test_quantized_layers_tp_parity():
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    x = jax.random.normal(jax.random.key(0), (4, 16)) * 0.5
+    wc = jax.random.normal(jax.random.key(1), (16, 32)) * 0.1
+    wr = jax.random.normal(jax.random.key(2), (32, 16)) * 0.1
+
+    col = QuantizedColumnParallel(features=32, dtype=jnp.float32)
+    row = QuantizedRowParallel(features=16, dtype=jnp.float32)
+    qc, sc = quantize(wc, QuantizedDtype.INT8,
+                      QuantizationType.PER_CHANNEL_SYMMETRIC)
+    qr, sr = quantize(wr, QuantizedDtype.INT8,
+                      QuantizationType.PER_CHANNEL_SYMMETRIC)
+    pc = {"params": {"kernel_q": qc, "kernel_scale": sc.reshape(-1)}}
+    pr = {"params": {"kernel_q": qr, "kernel_scale": sr.reshape(-1)}}
+
+    def f(pc, pr, x):
+        h = col.apply(pc, x)
+        return row.apply(pr, h)
+
+    dense = f(pc, pr, x)
+    specs = ({"params": {"kernel_q": P(None, "tp"), "kernel_scale": P("tp")}},
+             {"params": {"kernel_q": P("tp", None), "kernel_scale": P(None)}},
+             P(None, None))
+    out = jax.jit(ps.shard_map(f, mesh, in_specs=specs,
+                               out_specs=P(None, None)))(pc, pr, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_convert_param_tree():
+    tree = {"layer": {"kernel": jnp.ones((8, 4)) * 0.5,
+                      "bias": jnp.zeros((4,))}}
+    qtree = convert(tree)
+    assert "kernel_q" in qtree["layer"] and "kernel_scale" in qtree["layer"]
+    assert "kernel" not in qtree["layer"]
+    assert qtree["layer"]["kernel_q"].dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(qtree["layer"]["bias"]), 0)

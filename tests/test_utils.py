@@ -1,0 +1,63 @@
+"""Aux utils: logger, timeline, tensor capture/replacement."""
+
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.utils import tensor_capture as tc
+from neuronx_distributed_tpu.utils.logger import get_logger, rmsg
+from neuronx_distributed_tpu.utils.timeline import Timeline
+
+
+def test_logger_and_rmsg():
+    lg = get_logger("nxd-test")
+    lg.info("hello")
+    ps.initialize_model_parallel(tensor_model_parallel_size=2)
+    msg = rmsg("step done")
+    assert "mesh" in msg and "step done" in msg
+
+
+def test_timeline_chrome_trace(tmp_path):
+    t = Timeline(str(tmp_path / "tl.json"))
+    with t.event("fwd"):
+        pass
+    t.mark_event_start("bwd")
+    t.mark_event_end("bwd")
+    p = t.save()
+    data = json.load(open(p))
+    names = [e["name"] for e in data["traceEvents"]]
+    assert names == ["fwd", "bwd"]
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in data["traceEvents"])
+
+
+def test_tensor_capture_and_replacement():
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+
+    ps.initialize_model_parallel()
+    cfg = tiny_config(num_layers=1, dtype=jnp.float32,
+                      param_dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = meta.unbox(model.init(jax.random.key(0), ids))
+
+    out, inter = tc.capture_intermediates(model, params, ids)
+    assert inter, "no intermediates captured"
+
+    # replacement: zero the final norm scale -> logits must change
+    ref = model.apply(params, ids)
+    zeroed = tc.apply_with_replacements(
+        model, params,
+        {"params/model/norm/scale": jnp.zeros((cfg.hidden_size,))}, ids)
+    assert not np.allclose(np.asarray(ref), np.asarray(zeroed))
+    diff = tc.max_diff(params, params)
+    assert max(diff.values()) == 0.0
+
+    import pytest
+
+    with pytest.raises(KeyError):
+        tc.apply_with_replacements(model, params, {"params/nope": ids}, ids)
