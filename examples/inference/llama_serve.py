@@ -1,0 +1,63 @@
+"""Llama serving: AOT-compiled prefill/decode with bucketed prompts.
+
+The analogue of the reference's ``examples/inference/llama/run.py`` +
+``NeuronBaseForCausalLM`` serving base.
+
+    python examples/inference/llama_serve.py --model tiny --max-new 32
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.core import meta
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.inference import SamplingConfig, generate
+from neuronx_distributed_tpu.models import llama
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--prompt-len", type=int, default=12)
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    args = ap.parse_args(argv)
+
+    nxd.neuronx_distributed_config(tensor_parallel_size=args.tp)
+    mcfg = (llama.tiny_config() if args.model == "tiny"
+            else getattr(llama, args.model.upper()))
+    model = llama.LlamaForCausalLM(mcfg)
+    params = meta.unbox(model.init(
+        jax.random.key(0),
+        jnp.zeros((args.batch, args.prompt_len), jnp.int32)))
+
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, mcfg.vocab_size,
+                          (args.batch, args.prompt_len))
+    prompt_len = jnp.full((args.batch,), args.prompt_len, jnp.int32)
+    sampling = (SamplingConfig(greedy=True) if args.temperature == 0
+                else SamplingConfig(temperature=args.temperature, top_k=50))
+
+    # warmup (compile prefill + decode)
+    toks = generate(mcfg, params, jnp.asarray(prompts), prompt_len,
+                    max_new_tokens=args.max_new, sampling=sampling)
+    jax.block_until_ready(toks)
+    t0 = time.perf_counter()
+    toks = generate(mcfg, params, jnp.asarray(prompts), prompt_len,
+                    max_new_tokens=args.max_new, sampling=sampling)
+    jax.block_until_ready(toks)
+    dt = time.perf_counter() - t0
+    total = args.batch * args.max_new
+    print(f"generated {total} tokens in {dt*1e3:.1f} ms "
+          f"({total/dt:,.0f} tok/s)")
+    print("tokens:", np.asarray(toks).tolist())
+
+
+if __name__ == "__main__":
+    main()

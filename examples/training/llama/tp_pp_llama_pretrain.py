@@ -1,0 +1,77 @@
+"""Llama pretraining with TP × PP (pipelined microbatch schedule).
+
+The analogue of the reference's 70B launcher
+(``examples/training/llama/tp_pp_llama_hf_pretrain/run_llama_nxd.py``).
+
+    python examples/training/llama/tp_pp_llama_pretrain.py \
+        --model 70b --tp 8 --pp 4 --microbatches 8
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.models import llama
+from neuronx_distributed_tpu.models import llama_pipeline as lpp
+from neuronx_distributed_tpu.trainer import (initialize_parallel_model,
+                                             initialize_parallel_optimizer,
+                                             make_train_step)
+from neuronx_distributed_tpu.trainer.loop import MetricsLogger, Trainer
+
+MODELS = {"tiny": llama.tiny_config(num_layers=4), "7b": llama.LLAMA2_7B,
+          "70b": llama.LLAMA2_70B}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny", choices=sorted(MODELS))
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--pp", type=int, default=2)
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    args = ap.parse_args(argv)
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=args.tp,
+        pipeline_parallel_size=args.pp,
+        pipeline_config=nxd.PipelineConfig(
+            num_microbatches=args.microbatches),
+        optimizer_config=nxd.OptimizerConfig(zero_one_enabled=True),
+        activation_checkpoint_config=nxd.ActivationCheckpointConfig(
+            mode="full"),
+    )
+    mcfg = nxd.configure_model(cfg, MODELS[args.model])
+    mcfg = type(mcfg)(**{**mcfg.__dict__, "max_seq_len": args.seq})
+    model = llama.LlamaForCausalLM(mcfg)
+
+    rng = np.random.RandomState(0)
+
+    def data():
+        while True:
+            ids = rng.randint(0, mcfg.vocab_size,
+                              (args.batch, args.seq + 1))
+            yield {"input_ids": jnp.asarray(ids[:, :-1]),
+                   "labels": jnp.asarray(ids[:, 1:])}
+
+    it = data()
+    sample = next(it)
+    pm, params = initialize_parallel_model(
+        cfg, model, jax.random.key(0), sample["input_ids"],
+        logical_axis_rules=lpp.PIPELINE_LOGICAL_RULES)
+    tx, state, sh = initialize_parallel_optimizer(pm, params, args.lr)
+    grad_fn = lpp.make_pipeline_grad_fn(
+        mcfg, num_microbatches=args.microbatches,
+        param_specs=pm.param_specs)
+    step = make_train_step(pm, tx, sh, grad_fn=grad_fn)
+    Trainer(step, state, callbacks=[MetricsLogger(every=5)]).fit(
+        it, max_steps=args.steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+"""Llama pretraining with TP + ZeRO-1 + sequence parallelism.
+
+The analogue of the reference's canonical 7B launcher
+(``examples/training/llama/tp_zero1_llama_hf_pretrain``): one SPMD process
+drives the whole mesh (no torchrun; SURVEY §7.1).
+
+    python examples/training/llama/tp_zero1_llama_pretrain.py \
+        --model 7b --tp 8 --batch 4 --seq 2048 --steps 100
+
+Uses synthetic data unless ``--data tokens.npy`` is given (a [N] uint16/32
+token stream, e.g. produced by any tokenizer).
+"""
+
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.models import llama
+from neuronx_distributed_tpu.trainer import (initialize_parallel_model,
+                                             initialize_parallel_optimizer,
+                                             make_train_step)
+from neuronx_distributed_tpu.trainer.loop import (CheckpointCallback,
+                                                  MetricsLogger, Trainer)
+
+MODELS = {
+    "tiny": llama.tiny_config(),
+    "7b": llama.LLAMA2_7B,
+    "8b": llama.LLAMA3_8B,
+    "70b": llama.LLAMA2_70B,
+}
+
+
+def batches(args, vocab):
+    if args.data:
+        stream = np.load(args.data, mmap_mode="r")
+        n = args.batch * (args.seq + 1)
+        i = 0
+        while True:
+            chunk = np.asarray(stream[i:i + n])
+            if len(chunk) < n:
+                i = 0
+                continue
+            i += n
+            ids = chunk.reshape(args.batch, args.seq + 1).astype(np.int32)
+            yield {"input_ids": jnp.asarray(ids[:, :-1]),
+                   "labels": jnp.asarray(ids[:, 1:])}
+    else:
+        rng = np.random.RandomState(0)
+        while True:
+            ids = rng.randint(0, vocab, (args.batch, args.seq + 1))
+            yield {"input_ids": jnp.asarray(ids[:, :-1]),
+                   "labels": jnp.asarray(ids[:, 1:])}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny", choices=sorted(MODELS))
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--no-zero1", action="store_true")
+    args = ap.parse_args(argv)
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=args.tp,
+        optimizer_config=nxd.OptimizerConfig(
+            zero_one_enabled=not args.no_zero1),
+        activation_checkpoint_config=nxd.ActivationCheckpointConfig(
+            mode="full"),
+        sequence_parallel=args.tp > 1,
+    )
+    mcfg = nxd.configure_model(cfg, MODELS[args.model])
+    mcfg = type(mcfg)(**{**mcfg.__dict__, "max_seq_len": args.seq})
+    model = llama.LlamaForCausalLM(mcfg)
+
+    data = batches(args, mcfg.vocab_size)
+    sample = next(data)
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(0),
+                                           sample["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, args.lr)
+    step = make_train_step(pm, tx, sh)
+
+    callbacks = [MetricsLogger(every=10)]
+    if args.ckpt_dir:
+        callbacks.append(CheckpointCallback(args.ckpt_dir, every=100))
+    trainer = Trainer(step, state, callbacks=callbacks,
+                      resume_path=args.ckpt_dir)
+    trainer.fit(data, max_steps=args.steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,11 +1,13 @@
 """Reference model families (reference: ``examples/training``/``inference``)."""
 
+from . import bert
+from . import gpt_neox
 from . import llama
 from . import llama_pipeline
 from . import mixtral
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel
 from .mixtral import MixtralConfig, MixtralForCausalLM
 
-__all__ = ["llama", "llama_pipeline", "mixtral", "LlamaConfig",
+__all__ = ["bert", "gpt_neox", "llama", "llama_pipeline", "mixtral", "LlamaConfig",
            "LlamaForCausalLM", "LlamaModel", "MixtralConfig",
            "MixtralForCausalLM"]

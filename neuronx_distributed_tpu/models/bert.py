@@ -1,0 +1,147 @@
+"""BERT model family (encoder + MLM pretraining head).
+
+Parity target: the reference's bert pretraining example
+(``examples/training/bert``; the reference's original demo workload).
+Bidirectional encoder built from the same parallel layers: learned position
+embeddings, post-LN transformer blocks, gelu MLP, tied or untied MLM head
+with vocab-parallel cross-entropy over masked positions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..modules import attention as attn_mod
+from ..modules.norms import LayerNorm
+from ..parallel import layers as pl
+from ..parallel import loss_functions as lf
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    layernorm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+    tp_size: Optional[int] = None
+
+
+BERT_LARGE = BertConfig()
+
+
+def tiny_bert_config(**kw) -> BertConfig:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_layers=2, num_heads=4, max_seq_len=64)
+    base.update(kw)
+    return BertConfig(**base)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        q, k, v = pl.GQAQKVColumnParallelLinear(
+            num_heads=cfg.num_heads, num_kv_heads=cfg.num_heads,
+            head_dim=hd, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, tp_size=cfg.tp_size,
+            name="qkv")(x)
+        b, s = q.shape[0], q.shape[1]
+        n_local = q.shape[-1] // hd
+        q = q.reshape(b, s, n_local, hd)
+        k = k.reshape(b, s, n_local, hd)
+        v = v.reshape(b, s, n_local, hd)
+        attn = attn_mod.sdpa_reference(q, k, v, causal=False)
+        attn = attn.reshape(b, s, n_local * hd)
+        attn = pl.RowParallelLinear(
+            features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="o_proj")(attn)
+        x = LayerNorm(eps=cfg.layernorm_eps, dtype=cfg.dtype,
+                      name="ln_attn")(x + attn)
+        h = pl.ColumnParallelLinear(
+            features=cfg.intermediate_size, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="up")(x)
+        h = nn.gelu(h)
+        h = pl.RowParallelLinear(
+            features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="down")(h)
+        return LayerNorm(eps=cfg.layernorm_eps, dtype=cfg.dtype,
+                         name="ln_mlp")(x + h)
+
+
+class _BertScanBody(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        return BertLayer(self.cfg, name="layer")(x), None
+
+
+class BertForPreTraining(nn.Module):
+    """Encoder + MLM head (``loss`` masks to the -100-ignored labels)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        cfg = self.cfg
+        x = pl.ParallelEmbedding(
+            num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed")(
+                input_ids)
+        pos_table = self.param(
+            "position_embedding",
+            nn.with_partitioning(pl.default_embed_init, (None, None)),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
+        x = x + pos_table[None, :x.shape[1]].astype(cfg.dtype)
+        if token_type_ids is not None:
+            type_table = self.param(
+                "type_embedding",
+                nn.with_partitioning(pl.default_embed_init, (None, None)),
+                (cfg.type_vocab_size, cfg.hidden_size), cfg.param_dtype)
+            x = x + jnp.take(type_table.astype(cfg.dtype), token_type_ids,
+                             axis=0)
+        x = LayerNorm(eps=cfg.layernorm_eps, dtype=cfg.dtype,
+                      name="embed_norm")(x)
+        if cfg.scan_layers:
+            body_cls = _BertScanBody
+            if cfg.remat:
+                body_cls = nn.remat(
+                    body_cls, prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            scanned = nn.scan(
+                body_cls, variable_axes={"params": 0},
+                split_rngs={"params": True}, length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"})(
+                    cfg, name="layers")
+            x, _ = scanned(x)
+        else:
+            for i in range(cfg.num_layers):
+                x = BertLayer(cfg, name=f"layer_{i}")(x)
+        logits = pl.ColumnParallelLinear(
+            features=cfg.vocab_size, use_bias=False, gather_output=False,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="mlm_head")(x)
+        return logits
+
+    def loss(self, input_ids, labels, ignore_index: int = -100):
+        logits = self(input_ids)
+        per_tok = lf.parallel_cross_entropy(logits, labels,
+                                            ignore_index=ignore_index)
+        denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
+        return jnp.sum(per_tok) / denom

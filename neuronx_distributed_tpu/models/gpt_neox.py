@@ -1,0 +1,188 @@
+"""GPT-NeoX model family.
+
+Parity target: the reference's gpt-neox training example
+(``examples/training/gpt_neox``, 20B config in
+``test/integration/gpt_neox_20B``). Architecture: parallel residual
+(``x + attn(ln1(x)) + mlp(ln2(x))``), LayerNorm with bias, partial rotary
+(``rotary_pct`` of each head dim), biased linears — all built from the same
+parallel layers as llama.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..modules import attention as attn_mod
+from ..modules.norms import LayerNorm
+from ..parallel import layers as pl
+from ..parallel import loss_functions as lf
+from ..parallel import mesh as ps
+
+
+@dataclass(frozen=True)
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 6144
+    intermediate_size: int = 24576
+    num_layers: int = 44
+    num_heads: int = 64
+    max_seq_len: int = 2048
+    rotary_pct: float = 0.25
+    rope_theta: float = 10000.0
+    layernorm_eps: float = 1e-5
+    use_parallel_residual: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    sequence_parallel: bool = False
+    remat: bool = False
+    scan_layers: bool = True
+    tp_size: Optional[int] = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+GPT_NEOX_20B = GPTNeoXConfig()
+
+
+def tiny_neox_config(**kw) -> GPTNeoXConfig:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=256,
+                num_layers=2, num_heads=4, max_seq_len=128)
+    base.update(kw)
+    return GPTNeoXConfig(**base)
+
+
+class NeoXAttention(nn.Module):
+    cfg: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions=None):
+        cfg = self.cfg
+        hd = cfg.head_dim
+        q, k, v = pl.GQAQKVColumnParallelLinear(
+            num_heads=cfg.num_heads, num_kv_heads=cfg.num_heads,
+            head_dim=hd, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            sequence_parallel=cfg.sequence_parallel, tp_size=cfg.tp_size,
+            name="qkv")(x)
+        b, s = q.shape[0], q.shape[1]
+        n_local = q.shape[-1] // hd
+        q = q.reshape(b, s, n_local, hd)
+        k = k.reshape(b, s, n_local, hd)
+        v = v.reshape(b, s, n_local, hd)
+        # partial rotary: first rotary_pct of the head dim rotates
+        rot = int(hd * cfg.rotary_pct)
+        if rot > 0:
+            q = jnp.concatenate([
+                attn_mod.apply_rotary(q[..., :rot], cos, sin, positions),
+                q[..., rot:]], axis=-1)
+            k = jnp.concatenate([
+                attn_mod.apply_rotary(k[..., :rot], cos, sin, positions),
+                k[..., rot:]], axis=-1)
+        out = attn_mod.sdpa_reference(q, k, v, causal=True)
+        out = out.reshape(b, s, n_local * hd)
+        return pl.RowParallelLinear(
+            features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            sequence_parallel=cfg.sequence_parallel, name="o_proj")(out)
+
+
+class NeoXMLP(nn.Module):
+    cfg: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = pl.ColumnParallelLinear(
+            features=cfg.intermediate_size, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            sequence_parallel=cfg.sequence_parallel, name="up")(x)
+        h = nn.gelu(h)
+        return pl.RowParallelLinear(
+            features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            sequence_parallel=cfg.sequence_parallel, name="down")(h)
+
+
+class NeoXLayer(nn.Module):
+    cfg: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions=None):
+        cfg = self.cfg
+        ln_kw = dict(eps=cfg.layernorm_eps, dtype=cfg.dtype,
+                     sequence_parallel=cfg.sequence_parallel)
+        attn_out = NeoXAttention(cfg, name="attn")(
+            LayerNorm(**ln_kw, name="ln1")(x), cos, sin, positions)
+        if cfg.use_parallel_residual:
+            mlp_out = NeoXMLP(cfg, name="mlp")(
+                LayerNorm(**ln_kw, name="ln2")(x))
+            return x + attn_out + mlp_out
+        x = x + attn_out
+        return x + NeoXMLP(cfg, name="mlp")(
+            LayerNorm(**ln_kw, name="ln2")(x))
+
+
+class _NeoXScanBody(nn.Module):
+    cfg: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions):
+        return NeoXLayer(self.cfg, name="layer")(x, cos, sin, positions), None
+
+
+class GPTNeoXForCausalLM(nn.Module):
+    cfg: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        from ..parallel import mappings
+
+        x = pl.ParallelEmbedding(
+            num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed")(
+                input_ids)
+        if cfg.sequence_parallel:
+            x = mappings.scatter_to_sequence_parallel_region(x, seq_dim=1)
+        rot_dim = max(2, int(cfg.head_dim * cfg.rotary_pct))
+        cos, sin = attn_mod.precompute_rope(rot_dim, cfg.max_seq_len,
+                                            cfg.rope_theta)
+        if cfg.scan_layers:
+            body_cls = _NeoXScanBody
+            if cfg.remat:
+                body_cls = nn.remat(
+                    body_cls, prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            scanned = nn.scan(
+                body_cls, variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"})(
+                    cfg, name="layers")
+            x, _ = scanned(x, cos, sin, positions)
+        else:
+            for i in range(cfg.num_layers):
+                x = NeoXLayer(cfg, name=f"layer_{i}")(x, cos, sin, positions)
+        x = LayerNorm(eps=cfg.layernorm_eps, dtype=cfg.dtype,
+                      sequence_parallel=cfg.sequence_parallel,
+                      name="final_norm")(x)
+        logits = pl.ColumnParallelLinear(
+            features=cfg.vocab_size, use_bias=False, gather_output=False,
+            sequence_parallel=cfg.sequence_parallel, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="lm_head")(x)
+        return logits
+
+    def loss(self, input_ids, labels, ignore_index: int = -100):
+        logits = self(input_ids)
+        per_tok = lf.parallel_cross_entropy(logits, labels,
+                                            ignore_index=ignore_index)
+        denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
+        return jnp.sum(per_tok) / denom

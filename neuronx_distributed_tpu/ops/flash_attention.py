@@ -39,9 +39,9 @@ def _block_attention(q, k_blk, v_blk, q_pos, k_pos_start, block_k, causal,
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_k"))
-def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True, block_k: int = 512,
-                    scale: Optional[float] = None) -> jax.Array:
+def flash_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True, block_k: int = 512,
+                        scale: Optional[float] = None) -> jax.Array:
     """Blockwise attention. ``q/k/v: [B, S, N, D]`` (kv already GQA-expanded);
     returns ``[B, S, N, D]``."""
     b, sq, n, d = q.shape
@@ -87,3 +87,153 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     (m, l, acc), _ = lax.scan(body, (m0, l0, acc0), blks)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas (Mosaic) TPU kernel — the hand-tiled fast path. Grid is
+# (batch*heads, q_blocks, k_blocks) with the KV dim innermost (sequential on
+# TPU): K/V stream through VMEM one (block_k, d) tile at a time while
+# m/l/acc accumulate in VMEM scratch — constant VMEM regardless of sequence
+# length. Forward only; the backward is the VJP of the scan formulation
+# above (same recompute profile as a flash backward, one golden
+# implementation to maintain).
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                      block_q: int, block_k: int, num_kb: int, causal: bool,
+                      scale: float):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # skip blocks strictly above the causal diagonal
+    @pl.when((not causal) or (kb * block_k <= qi * block_q + block_q - 1))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale       # [BQ, D]
+        k_blk = k_ref[0].astype(jnp.float32)           # [BK, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe[:, None]), 0.0)
+        corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)[:, None]
+                    ).astype(o_ref.dtype)
+
+
+def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
+                      interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * n, sq, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * n, sk, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * n, sk, d)
+    num_kb = sk // block_k
+    grid = (b * n, sq // block_q, num_kb)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, block_q=block_q,
+                          block_k=block_k, num_kb=num_kb, causal=causal,
+                          scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b * n, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q,), jnp.float32),
+                        pltpu.VMEM((block_q,), jnp.float32),
+                        pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.swapaxes(out.reshape(b, n, sq, d), 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_pallas(q, k, v, causal, block_q, block_k, scale, interpret):
+    return _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
+                             interpret)
+
+
+def _flash_pallas_vjp_fwd(q, k, v, causal, block_q, block_k, scale,
+                          interpret):
+    out = _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
+                            interpret)
+    return out, (q, k, v)
+
+
+def _flash_pallas_vjp_bwd(causal, block_q, block_k, scale, interpret, res, g):
+    q, k, v = res
+    # backward = VJP of the scan formulation (flash-style memory profile)
+    _, vjp = jax.vjp(
+        lambda q, k, v: flash_attention_xla(q, k, v, causal=causal,
+                                            block_k=block_k, scale=scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_pallas.defvjp(_flash_pallas_vjp_fwd, _flash_pallas_vjp_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "scale", "force_pallas"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_q: int = 256,
+                    block_k: int = 256,
+                    scale: Optional[float] = None,
+                    force_pallas: Optional[bool] = None) -> jax.Array:
+    """Flash attention entry point: Pallas kernel on TPU when the shapes
+    tile cleanly, scan/XLA formulation otherwise (the reference dispatches
+    NKI-vs-torch the same way, ``kernels/flash_attn.py``)."""
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    # clamp block sizes to the sequence before any divisibility decision
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    tileable = (sq % bq == 0 and sk % bk == 0 and d % 128 == 0)
+    if force_pallas:
+        if not tileable:
+            raise ValueError(
+                f"force_pallas: shapes (sq={sq}, sk={sk}, d={d}) don't tile "
+                f"with block_q={bq}, block_k={bk} (d must be a multiple of "
+                "128)")
+        use_pallas = True
+    elif force_pallas is None:
+        use_pallas = (jax.default_backend() in ("tpu", "axon") and tileable)
+    else:
+        use_pallas = False
+    if use_pallas:
+        interpret = jax.default_backend() == "cpu"
+        return _flash_pallas(q, k, v, causal, bq, bk, scale_, interpret)
+    return flash_attention_xla(q, k, v, causal=causal,
+                               block_k=bk, scale=scale_)

@@ -1,0 +1,119 @@
+"""High-level training loop with callbacks.
+
+Analogue of the reference's PyTorch-Lightning adapter layer (``lightning/``:
+``NeuronLTModule`` module.py:24, ``NeuronXLAStrategy`` strategy.py:36,
+TB logger, checkpoint IO, progress bar). In single-controller JAX a strategy/
+launcher/accelerator split is unnecessary — the loop is a plain function over
+the jitted train step; the Lightning surface maps to :class:`Callback` hooks
+(logging, checkpointing, early stop) around it.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import jax
+
+from ..config import NxDConfig
+from ..utils.logger import get_logger
+from . import checkpoint as ckpt
+
+logger = get_logger(__name__)
+
+
+class Callback:
+    """Hook points (the Lightning-callback analogue)."""
+
+    def on_train_start(self, trainer: "Trainer") -> None: ...
+
+    def on_step_end(self, trainer: "Trainer", metrics: Dict) -> None: ...
+
+    def on_train_end(self, trainer: "Trainer") -> None: ...
+
+
+class MetricsLogger(Callback):
+    """Rank-0 console/TSV metrics logging (reference ``lightning/logger.py``
+    TB logger)."""
+
+    def __init__(self, every: int = 10, file: Optional[str] = None):
+        self.every = every
+        self.file = file
+        self._t0 = None
+        self._tokens = 0
+
+    def on_train_start(self, trainer):
+        self._t0 = time.perf_counter()
+
+    def on_step_end(self, trainer, metrics):
+        step = int(trainer.state.step)
+        self._tokens += trainer.tokens_per_batch
+        if step % self.every == 0:
+            dt = time.perf_counter() - self._t0
+            tps = self._tokens / max(dt, 1e-9)
+            line = (f"step {step} loss {float(metrics['loss']):.4f} "
+                    f"grad_norm {float(metrics.get('grad_norm', 0)):.3f} "
+                    f"tokens/s {tps:,.0f}")
+            logger.info(line)
+            if self.file:
+                with open(self.file, "a") as f:
+                    f.write(line + "\n")
+
+
+class CheckpointCallback(Callback):
+    """Periodic async checkpointing with retention + final flush (reference
+    ``lightning/checkpoint_io.py`` over our checkpoint engine)."""
+
+    def __init__(self, path: str, every: int = 1000, num_kept: int = 3):
+        self.path = path
+        self.every = every
+        self.num_kept = num_kept
+
+    def on_step_end(self, trainer, metrics):
+        step = int(trainer.state.step)
+        if self.every and step % self.every == 0:
+            ckpt.save_checkpoint(self.path, step, trainer.state,
+                                 async_save=True, num_kept=self.num_kept)
+
+    def on_train_end(self, trainer):
+        ckpt.finalize_checkpoint()
+
+
+class Trainer:
+    """Minimal loop: resume → iterate batches → step → callbacks.
+
+    The analogue of ``NeuronLTModule`` + Lightning ``Trainer.fit`` for users
+    who don't bring their own loop.
+    """
+
+    def __init__(self, step_fn: Callable, state: Any,
+                 callbacks: Optional[List[Callback]] = None,
+                 resume_path: Optional[str] = None):
+        self.step_fn = step_fn
+        self.state = state
+        self.callbacks = callbacks or []
+        self.tokens_per_batch = 0
+        if resume_path is not None and ckpt.has_checkpoint(resume_path):
+            target = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=x.sharding), state)
+            self.state, _ = ckpt.load_checkpoint(resume_path, tag=None,
+                                                 target=target)
+            logger.info("resumed from step %d", int(self.state.step))
+
+    def fit(self, batches: Iterable, max_steps: Optional[int] = None):
+        for cb in self.callbacks:
+            cb.on_train_start(self)
+        metrics: Dict = {}
+        for batch in batches:
+            if max_steps is not None and int(self.state.step) >= max_steps:
+                break
+            ids = batch.get("input_ids")
+            self.tokens_per_batch = int(ids.size) if ids is not None else 0
+            self.state, metrics = self.step_fn(self.state, batch)
+            for cb in self.callbacks:
+                cb.on_step_end(self, metrics)
+        for cb in self.callbacks:
+            cb.on_train_end(self)
+        return self.state, metrics

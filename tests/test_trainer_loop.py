@@ -1,0 +1,48 @@
+"""High-level Trainer loop with callbacks: metrics, checkpointing, resume."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_config
+from neuronx_distributed_tpu.trainer import (initialize_parallel_model,
+                                             initialize_parallel_optimizer,
+                                             make_train_step)
+from neuronx_distributed_tpu.trainer.loop import (CheckpointCallback,
+                                                  MetricsLogger, Trainer)
+
+
+def test_trainer_loop_with_callbacks(tmp_path):
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=2)
+    mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                       num_layers=1)
+    model = LlamaForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (4, 17), 0, mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           batch["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 1e-3)
+    step = make_train_step(pm, tx, sh, donate=False)
+
+    log_file = str(tmp_path / "metrics.log")
+    ckpt_dir = str(tmp_path / "ckpt")
+    trainer = Trainer(step, state, callbacks=[
+        MetricsLogger(every=2, file=log_file),
+        CheckpointCallback(ckpt_dir, every=3, num_kept=2),
+    ])
+    final_state, metrics = trainer.fit(iter([batch] * 7), max_steps=7)
+    assert int(final_state.step) == 7
+    assert "loss" in metrics
+    assert open(log_file).read().count("loss") >= 2
+
+    from neuronx_distributed_tpu.trainer import checkpoint as ck
+
+    assert ck.has_checkpoint(ckpt_dir)
+
+    # resume: picks up from the newest checkpoint (step 6)
+    trainer2 = Trainer(step, state, resume_path=ckpt_dir)
+    assert int(trainer2.state.step) == 6
+    st, m = trainer2.fit(iter([batch] * 2), max_steps=8)
+    assert int(st.step) == 8
