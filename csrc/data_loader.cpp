@@ -1,0 +1,163 @@
+// Native tokenized-batch data loader.
+//
+// The training-IO runtime piece: the reference reaches its native data path
+// through torch's C++ DataLoader workers; here a small self-contained C++
+// loader mmaps a binary token stream (uint16/uint32), and background threads
+// prefetch shuffled [batch, seq+1] int32 batches into a bounded ring buffer
+// so the Python training loop never blocks on IO or tokenized decoding.
+//
+// C ABI (consumed by neuronx_distributed_tpu/data/native_loader.py via
+// ctypes):
+//   void* nxd_loader_create(const char* path, int dtype_code /*2|4 bytes*/,
+//                           long batch, long seqlen, long seed,
+//                           int nthreads, int capacity);
+//   long  nxd_loader_num_sequences(void* h);
+//   int   nxd_loader_next(void* h, int* out /* batch*(seqlen+1) */);
+//   void  nxd_loader_destroy(void* h);
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Batch {
+  std::vector<int32_t> data;
+};
+
+class Loader {
+ public:
+  Loader(const char* path, int dtype_code, long batch, long seqlen,
+         long seed, int nthreads, int capacity)
+      : dtype_code_(dtype_code), batch_(batch), seqlen_(seqlen),
+        capacity_(capacity), rng_seed_(seed) {
+    int fd = ::open(path, O_RDONLY);
+    if (fd < 0) { ok_ = false; return; }
+    struct stat st;
+    if (fstat(fd, &st) != 0) { ::close(fd); ok_ = false; return; }
+    size_ = static_cast<size_t>(st.st_size);
+    base_ = ::mmap(nullptr, size_, PROT_READ, MAP_PRIVATE, fd, 0);
+    ::close(fd);
+    if (base_ == MAP_FAILED) { ok_ = false; base_ = nullptr; return; }
+    ::madvise(base_, size_, MADV_SEQUENTIAL);
+    num_tokens_ = size_ / dtype_code_;
+    tokens_per_seq_ = seqlen_ + 1;
+    num_seqs_ = num_tokens_ / tokens_per_seq_;
+    if (num_seqs_ < static_cast<size_t>(batch_)) { ok_ = false; return; }
+    for (int i = 0; i < nthreads; ++i) {
+      workers_.emplace_back([this, i] { this->worker(i); });
+    }
+  }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      stop_ = true;
+    }
+    cv_space_.notify_all();
+    cv_data_.notify_all();
+    for (auto& t : workers_) t.join();
+    if (base_) ::munmap(base_, size_);
+  }
+
+  bool ok() const { return ok_; }
+  long num_sequences() const { return static_cast<long>(num_seqs_); }
+
+  int next(int32_t* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_data_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+    if (queue_.empty()) return -1;
+    Batch b = std::move(queue_.front());
+    queue_.pop_front();
+    lk.unlock();
+    cv_space_.notify_one();
+    std::memcpy(out, b.data.data(), b.data.size() * sizeof(int32_t));
+    return 0;
+  }
+
+ private:
+  void fill_batch(Batch* b, std::mt19937_64* rng) {
+    b->data.resize(batch_ * tokens_per_seq_);
+    std::uniform_int_distribution<size_t> dist(0, num_seqs_ - 1);
+    for (long r = 0; r < batch_; ++r) {
+      size_t seq = dist(*rng);
+      size_t off = seq * tokens_per_seq_;
+      int32_t* dst = b->data.data() + r * tokens_per_seq_;
+      if (dtype_code_ == 2) {
+        const uint16_t* src = static_cast<const uint16_t*>(base_) + off;
+        for (long t = 0; t < tokens_per_seq_; ++t) dst[t] = src[t];
+      } else {
+        const uint32_t* src = static_cast<const uint32_t*>(base_) + off;
+        for (long t = 0; t < tokens_per_seq_; ++t)
+          dst[t] = static_cast<int32_t>(src[t]);
+      }
+    }
+  }
+
+  void worker(int id) {
+    std::mt19937_64 rng(rng_seed_ + 0x9e3779b97f4a7c15ULL * (id + 1));
+    while (true) {
+      Batch b;
+      fill_batch(&b, &rng);
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_space_.wait(lk, [this] {
+        return stop_ || queue_.size() < static_cast<size_t>(capacity_);
+      });
+      if (stop_) return;
+      queue_.push_back(std::move(b));
+      lk.unlock();
+      cv_data_.notify_one();
+    }
+  }
+
+  int dtype_code_;
+  long batch_, seqlen_, capacity_;
+  long rng_seed_;
+  bool ok_ = true;
+  void* base_ = nullptr;
+  size_t size_ = 0, num_tokens_ = 0, num_seqs_ = 0;
+  long tokens_per_seq_ = 0;
+  bool stop_ = false;
+  std::mutex mu_;
+  std::condition_variable cv_data_, cv_space_;
+  std::deque<Batch> queue_;
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* nxd_loader_create(const char* path, int dtype_code, long batch,
+                        long seqlen, long seed, int nthreads, int capacity) {
+  auto* l = new Loader(path, dtype_code, batch, seqlen, seed, nthreads,
+                       capacity);
+  if (!l->ok()) {
+    delete l;
+    return nullptr;
+  }
+  return l;
+}
+
+long nxd_loader_num_sequences(void* h) {
+  return static_cast<Loader*>(h)->num_sequences();
+}
+
+int nxd_loader_next(void* h, int32_t* out) {
+  return static_cast<Loader*>(h)->next(out);
+}
+
+void nxd_loader_destroy(void* h) { delete static_cast<Loader*>(h); }
+
+}  // extern "C"

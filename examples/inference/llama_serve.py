@@ -21,7 +21,8 @@ from neuronx_distributed_tpu.models import llama
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--model", default="tiny",
+                    choices=["tiny", "7b", "8b", "70b"])
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--prompt-len", type=int, default=12)
@@ -30,8 +31,9 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     nxd.neuronx_distributed_config(tensor_parallel_size=args.tp)
-    mcfg = (llama.tiny_config() if args.model == "tiny"
-            else getattr(llama, args.model.upper()))
+    models = {"tiny": llama.tiny_config(), "7b": llama.LLAMA2_7B,
+              "8b": llama.LLAMA3_8B, "70b": llama.LLAMA2_70B}
+    mcfg = models[args.model]
     model = llama.LlamaForCausalLM(mcfg)
     params = meta.unbox(model.init(
         jax.random.key(0),

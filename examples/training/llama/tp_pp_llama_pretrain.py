@@ -8,6 +8,7 @@ The analogue of the reference's 70B launcher
 """
 
 import argparse
+import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -47,7 +48,7 @@ def main(argv=None):
             mode="full"),
     )
     mcfg = nxd.configure_model(cfg, MODELS[args.model])
-    mcfg = type(mcfg)(**{**mcfg.__dict__, "max_seq_len": args.seq})
+    mcfg = dataclasses.replace(mcfg, max_seq_len=args.seq)
     model = llama.LlamaForCausalLM(mcfg)
 
     rng = np.random.RandomState(0)

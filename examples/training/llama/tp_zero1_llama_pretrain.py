@@ -12,6 +12,7 @@ token stream, e.g. produced by any tokenizer).
 """
 
 import argparse
+import dataclasses
 import sys
 
 import jax
@@ -78,7 +79,7 @@ def main(argv=None):
         sequence_parallel=args.tp > 1,
     )
     mcfg = nxd.configure_model(cfg, MODELS[args.model])
-    mcfg = type(mcfg)(**{**mcfg.__dict__, "max_seq_len": args.seq})
+    mcfg = dataclasses.replace(mcfg, max_seq_len=args.seq)
     model = llama.LlamaForCausalLM(mcfg)
 
     data = batches(args, mcfg.vocab_size)

@@ -22,6 +22,8 @@ from . import inference
 from . import lora
 from . import quantization
 from . import utils
+from . import data
+from . import scripts
 
 __version__ = "0.1.0"
 
@@ -40,4 +42,6 @@ __all__ = [
     "lora",
     "quantization",
     "utils",
+    "data",
+    "scripts",
 ]

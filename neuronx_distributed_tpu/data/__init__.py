@@ -1,0 +1,5 @@
+"""Data loading (native C++ prefetch loader + pure-python fallback)."""
+
+from .native_loader import TokenBatchLoader
+
+__all__ = ["TokenBatchLoader"]

@@ -11,7 +11,9 @@
 from . import generation
 from . import kv_cache
 from . import model_builder
+from . import benchmark
 from . import sampling
+from . import speculative
 from .generation import decode_step, generate, pick_bucket, prefill
 from .kv_cache import KVCache, init_kv_cache
 from .model_builder import ModelBuilder, NxDModel, shard_checkpoint
@@ -19,6 +21,7 @@ from .sampling import SamplingConfig, sample
 
 __all__ = [
     "generation", "kv_cache", "model_builder", "sampling",
+    "benchmark", "speculative",
     "decode_step", "generate", "pick_bucket", "prefill",
     "KVCache", "init_kv_cache",
     "ModelBuilder", "NxDModel", "shard_checkpoint",

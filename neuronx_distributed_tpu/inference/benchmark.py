@@ -1,0 +1,52 @@
+"""Inference latency/throughput benchmark harness.
+
+Analogue of the reference's ``examples/inference/modules/benchmark.py``
+(``LatencyCollector``/``Benchmark:9-54``: 20-run mean/p50/p90/p99 via module
+hooks). Functional here: time any callable over N runs with device sync.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+@dataclass
+class LatencyCollector:
+    """Accumulates per-call latencies (reference ``LatencyCollector``)."""
+
+    latencies_ms: List[float] = field(default_factory=list)
+
+    def record(self, seconds: float) -> None:
+        self.latencies_ms.append(seconds * 1e3)
+
+    def percentile(self, p: float) -> float:
+        return float(np.percentile(self.latencies_ms, p))
+
+    def report(self) -> Dict[str, float]:
+        arr = np.asarray(self.latencies_ms)
+        return {
+            "n": int(arr.size),
+            "mean_ms": float(arr.mean()),
+            "p50_ms": float(np.percentile(arr, 50)),
+            "p90_ms": float(np.percentile(arr, 90)),
+            "p99_ms": float(np.percentile(arr, 99)),
+        }
+
+
+def benchmark(fn: Callable[[], Any], n_runs: int = 20,
+              warmup: int = 2) -> Dict[str, float]:
+    """Reference ``Benchmark``: warmup then n timed runs with
+    ``block_until_ready`` sync."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    col = LatencyCollector()
+    for _ in range(n_runs):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        col.record(time.perf_counter() - t0)
+    return col.report()

@@ -1,0 +1,110 @@
+"""Speculative decoding: draft-model and Medusa-style tree utilities.
+
+Analogue of the reference's speculative stack: draft process groups
+(``parallel_state.py:1533-1580``), Medusa buffers/candidates/acceptance
+(``utils/medusa_utils.py``), and the "speculation" ModelBuilder key
+(``examples/inference/modules/model_base.py:155``).
+
+TPU-native: the draft and target are two compiled functions over the same
+mesh; verification is one batched target forward over the drafted block with
+vectorised accept/reject — no extra process groups needed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def verify_draft_greedy(target_logits: jax.Array,
+                        draft_tokens: jax.Array) -> Tuple[jax.Array,
+                                                          jax.Array]:
+    """Greedy speculative acceptance.
+
+    ``target_logits [B, K+1, V]``: target logits at each drafted position
+    (position j conditions on draft tokens < j). ``draft_tokens [B, K]``.
+    Returns ``(num_accepted [B], next_tokens [B, K+1])`` where
+    ``next_tokens[:, j]`` is the token to emit at step j — accepted drafts
+    followed by the target's correction at the first mismatch.
+    """
+    b, kp1, _ = target_logits.shape
+    k = kp1 - 1
+    greedy = jnp.argmax(target_logits, axis=-1)  # [B, K+1]
+    match = greedy[:, :k] == draft_tokens
+    # number of leading accepts
+    accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    # emit: accepted drafts, then the target's token at the break position
+    return accepted, greedy
+
+
+@dataclass(frozen=True)
+class MedusaBuffers:
+    """Static tree-attention buffers (reference ``medusa_utils.py``:
+    generate_medusa_buffers)."""
+
+    tree_mask: jax.Array          # [T, T] ancestor mask over tree nodes
+    tree_positions: jax.Array     # [T] depth of each node (position offset)
+    parent: jax.Array             # [T] parent node index (-1 for root)
+    head_of_node: jax.Array       # [T] which medusa head proposed the node
+
+
+def build_medusa_tree(tree_choices: Tuple[Tuple[int, ...], ...]
+                      ) -> MedusaBuffers:
+    """Build tree buffers from path choices (reference medusa_choices
+    format: each entry is a path of head-candidate indices, e.g.
+    ``((0,), (1,), (0, 0), (0, 1))``)."""
+    paths = [()] + [tuple(p) for p in tree_choices]
+    index = {p: i for i, p in enumerate(paths)}
+    t = len(paths)
+    mask = jnp.zeros((t, t), jnp.bool_)
+    parent = []
+    depth = []
+    head = []
+    rows = []
+    for i, p in enumerate(paths):
+        depth.append(len(p))
+        parent.append(index[p[:-1]] if p else -1)
+        head.append(p[-1] if p else -1)
+        anc = [index[p[:j]] for j in range(len(p) + 1)]
+        row = jnp.zeros((t,), jnp.bool_).at[jnp.asarray(anc)].set(True)
+        rows.append(row)
+    return MedusaBuffers(
+        tree_mask=jnp.stack(rows),
+        tree_positions=jnp.asarray(depth, jnp.int32),
+        parent=jnp.asarray(parent, jnp.int32),
+        head_of_node=jnp.asarray(head, jnp.int32))
+
+
+def medusa_accept_longest(tree_logits: jax.Array,
+                          tree_tokens: jax.Array,
+                          buffers: MedusaBuffers) -> Tuple[jax.Array,
+                                                           jax.Array]:
+    """Pick the deepest tree path whose every node matches the target's
+    greedy choice at its parent (reference medusa candidate acceptance).
+
+    ``tree_logits [B, T, V]``: target logits at each tree node;
+    ``tree_tokens [B, T]``: the drafted token at each node (root = the
+    committed token). Returns ``(best_node [B], accept_len [B])`` — walk
+    ``buffers.parent`` from best_node to recover the accepted path.
+    """
+    greedy = jnp.argmax(tree_logits, axis=-1)  # [B, T]
+    parent = buffers.parent
+    # node j is locally consistent if target's greedy at its parent == its
+    # drafted token
+    parent_greedy = jnp.where(parent[None, :] >= 0,
+                              jnp.take_along_axis(
+                                  greedy,
+                                  jnp.maximum(parent, 0)[None, :], axis=1),
+                              tree_tokens[:, :1])
+    ok = parent_greedy == tree_tokens  # [B, T]
+    ok = ok.at[:, 0].set(True)  # root is committed
+    # a path is valid iff all its ancestors are ok: AND over ancestor mask
+    anc = buffers.tree_mask[None]  # [1, T, T]
+    path_ok = jnp.all(jnp.where(anc, ok[:, None, :], True), axis=-1)
+    depth = jnp.where(path_ok, buffers.tree_positions[None], -1)
+    best = jnp.argmax(depth, axis=-1)
+    accept_len = jnp.take_along_axis(depth, best[:, None], axis=1)[:, 0]
+    return best, accept_len

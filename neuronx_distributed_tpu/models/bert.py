@@ -141,7 +141,4 @@ class BertForPreTraining(nn.Module):
 
     def loss(self, input_ids, labels, ignore_index: int = -100):
         logits = self(input_ids)
-        per_tok = lf.parallel_cross_entropy(logits, labels,
-                                            ignore_index=ignore_index)
-        denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
-        return jnp.sum(per_tok) / denom
+        return lf.causal_lm_loss(logits, labels, ignore_index=ignore_index)

@@ -47,6 +47,13 @@ class GPTNeoXConfig:
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
 
+    @property
+    def rotary_dim(self) -> int:
+        """Rotated slice of each head dim: even, >= 2 (apply_rotary splits
+        it in half)."""
+        r = int(self.head_dim * self.rotary_pct)
+        return max(2, (r // 2) * 2)
+
 
 GPT_NEOX_20B = GPTNeoXConfig()
 
@@ -76,8 +83,8 @@ class NeoXAttention(nn.Module):
         q = q.reshape(b, s, n_local, hd)
         k = k.reshape(b, s, n_local, hd)
         v = v.reshape(b, s, n_local, hd)
-        # partial rotary: first rotary_pct of the head dim rotates
-        rot = int(hd * cfg.rotary_pct)
+        # partial rotary: first rotary_dim of the head dim rotates
+        rot = cfg.rotary_dim
         if rot > 0:
             q = jnp.concatenate([
                 attn_mod.apply_rotary(q[..., :rot], cos, sin, positions),
@@ -151,8 +158,7 @@ class GPTNeoXForCausalLM(nn.Module):
                 input_ids)
         if cfg.sequence_parallel:
             x = mappings.scatter_to_sequence_parallel_region(x, seq_dim=1)
-        rot_dim = max(2, int(cfg.head_dim * cfg.rotary_pct))
-        cos, sin = attn_mod.precompute_rope(rot_dim, cfg.max_seq_len,
+        cos, sin = attn_mod.precompute_rope(cfg.rotary_dim, cfg.max_seq_len,
                                             cfg.rope_theta)
         if cfg.scan_layers:
             body_cls = _NeoXScanBody
@@ -182,7 +188,4 @@ class GPTNeoXForCausalLM(nn.Module):
 
     def loss(self, input_ids, labels, ignore_index: int = -100):
         logits = self(input_ids)
-        per_tok = lf.parallel_cross_entropy(logits, labels,
-                                            ignore_index=ignore_index)
-        denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
-        return jnp.sum(per_tok) / denom
+        return lf.causal_lm_loss(logits, labels, ignore_index=ignore_index)

@@ -372,10 +372,7 @@ class LlamaForCausalLM(nn.Module):
     def loss(self, input_ids: jax.Array, labels: jax.Array,
              ignore_index: int = -100) -> jax.Array:
         logits = self(input_ids)
-        per_tok = lf.parallel_cross_entropy(logits, labels,
-                                            ignore_index=ignore_index)
-        denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
-        return jnp.sum(per_tok) / denom
+        return lf.causal_lm_loss(logits, labels, ignore_index=ignore_index)
 
 
 def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,

@@ -164,9 +164,6 @@ class MixtralForCausalLM(nn.Module):
     def loss(self, input_ids, labels, ignore_index: int = -100):
         cfg = self.cfg
         logits, aux = self(input_ids)
-        per_tok = lf.parallel_cross_entropy(logits, labels,
-                                            ignore_index=ignore_index)
-        denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
-        ce = jnp.sum(per_tok) / denom
+        ce = lf.causal_lm_loss(logits, labels, ignore_index=ignore_index)
         return (ce + cfg.router_aux_coef * aux[0]
                 + cfg.router_z_coef * aux[1])

@@ -99,9 +99,9 @@ def flash_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array,
 # implementation to maintain).
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                      block_q: int, block_k: int, num_kb: int, causal: bool,
-                      scale: float):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                      acc_ref, *, block_q: int, block_k: int, num_kb: int,
+                      causal: bool, scale: float):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -144,6 +144,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _finalize():
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)[:, None]
                     ).astype(o_ref.dtype)
+        # log-sum-exp per query row (softmax stats for the flash backward)
+        lse_ref[0] = jnp.where(
+            l_ref[:] > 0, m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30)),
+            -jnp.inf)
 
 
 def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
@@ -158,47 +162,96 @@ def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
     vt = jnp.swapaxes(v, 1, 2).reshape(b * n, sk, d)
     num_kb = sk // block_k
     grid = (b * n, sq // block_q, num_kb)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_q=block_q,
                           block_k=block_k, num_kb=num_kb, causal=causal,
                           scale=scale),
-        out_shape=jax.ShapeDtypeStruct((b * n, sq, d), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((b * n, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * n, sq), jnp.float32)],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+                   pl.BlockSpec((1, block_q), lambda i, j, kb: (i, j))],
         scratch_shapes=[pltpu.VMEM((block_q,), jnp.float32),
                         pltpu.VMEM((block_q,), jnp.float32),
                         pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt)
-    return jnp.swapaxes(out.reshape(b, n, sq, d), 1, 2)
+    return (jnp.swapaxes(out.reshape(b, n, sq, d), 1, 2),
+            lse.reshape(b, n, sq))
+
+
+def _flash_bwd_from_lse(q, k, v, out, lse, g, causal, block_k, scale):
+    """Standard flash backward from saved softmax stats: one blockwise pass
+    recomputing p = exp(s - lse) per KV block (no second forward's
+    max/sum accumulation). All in fp32; O(S) memory."""
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    nb = sk // block_k
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)      # [B,N,Sq,D]
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    ot = jnp.swapaxes(out, 1, 2).astype(jnp.float32)
+    gt = jnp.swapaxes(g, 1, 2).astype(jnp.float32)
+    delta = jnp.sum(gt * ot, axis=-1)                   # [B,N,Sq]
+    q_pos = jnp.arange(sq)
+
+    kb_ = kt.reshape(b, n, nb, block_k, d)
+    vb_ = vt.reshape(b, n, nb, block_k, d)
+
+    def body(dq, blk):
+        k_blk, v_blk, idx = blk
+        s = jnp.einsum("bnqd,bnkd->bnqk", qt, k_blk,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = idx * block_k + jnp.arange(block_k)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            s = jnp.where(mask, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s),
+                      jnp.exp(s - lse[..., None]), 0.0)  # [B,N,Sq,BK]
+        dv = jnp.einsum("bnqk,bnqd->bnkd", p, gt,
+                        preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bnqd,bnkd->bnqk", gt, v_blk,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bnqk,bnkd->bnqd", ds, k_blk,
+                             preferred_element_type=jnp.float32)
+        dk = jnp.einsum("bnqk,bnqd->bnkd", ds, qt,
+                        preferred_element_type=jnp.float32)
+        return dq, (dk, dv)
+
+    dq0 = jnp.zeros_like(qt)
+    dq, (dks, dvs) = lax.scan(
+        body, dq0, (jnp.moveaxis(kb_, 2, 0), jnp.moveaxis(vb_, 2, 0),
+                    jnp.arange(nb)))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, n, sk, d)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, n, sk, d)
+    return (jnp.swapaxes(dq, 1, 2).astype(q.dtype),
+            jnp.swapaxes(dk, 1, 2).astype(k.dtype),
+            jnp.swapaxes(dv, 1, 2).astype(v.dtype))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_pallas(q, k, v, causal, block_q, block_k, scale, interpret):
-    return _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
-                             interpret)
+    out, _ = _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
+                               interpret)
+    return out
 
 
 def _flash_pallas_vjp_fwd(q, k, v, causal, block_q, block_k, scale,
                           interpret):
-    out = _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
-                            interpret)
-    return out, (q, k, v)
+    out, lse = _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
+                                 interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_pallas_vjp_bwd(causal, block_q, block_k, scale, interpret, res, g):
-    q, k, v = res
-    # backward = VJP of the scan formulation (flash-style memory profile)
-    _, vjp = jax.vjp(
-        lambda q, k, v: flash_attention_xla(q, k, v, causal=causal,
-                                            block_k=block_k, scale=scale),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd_from_lse(q, k, v, out, lse, g, causal, block_k, scale)
 
 
 _flash_pallas.defvjp(_flash_pallas_vjp_fwd, _flash_pallas_vjp_bwd)
@@ -220,7 +273,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # clamp block sizes to the sequence before any divisibility decision
     bq = min(block_q, sq)
     bk = min(block_k, sk)
-    tileable = (sq % bq == 0 and sk % bk == 0 and d % 128 == 0)
+    # Mosaic tiling: lane dim multiple of 128, sublane-dim blocks multiple
+    # of 8 (fp32) — require it for auto-dispatch; force_pallas raises below
+    tileable = (sq % bq == 0 and sk % bk == 0 and d % 128 == 0
+                and bq % 8 == 0 and bk % 8 == 0)
     if force_pallas:
         if not tileable:
             raise ValueError(

@@ -103,3 +103,14 @@ def distributed_log_softmax(logits: jax.Array,
     sum_exp = mappings.reduce_from_tensor_parallel_region(
         jnp.sum(jnp.exp(shifted), axis=-1), axis)
     return shifted - jnp.log(sum_exp)[..., None]
+
+
+def causal_lm_loss(logits: jax.Array, labels: jax.Array,
+                   axis: str = ps.TP_AXIS,
+                   ignore_index: int = -100) -> jax.Array:
+    """Mean vocab-parallel CE over non-ignored tokens — the shared loss head
+    of every causal/MLM model family."""
+    per_tok = parallel_cross_entropy(logits, labels, axis=axis,
+                                     ignore_index=ignore_index)
+    denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
+    return jnp.sum(per_tok) / denom

@@ -1,0 +1,49 @@
+"""Attention-head padding for TP divisibility (inference-only).
+
+Analogue of the reference's ``parallel_layers/pad.py`` (``pad_model:32``,
+``get_number_of_extra_heads:14``, ``generate_padding_mask:114``): when a
+checkpoint's head count doesn't divide the tp degree, heads are padded with
+zero weights so each shard gets an integer number of heads; padded heads are
+masked out of the output projection.
+
+Here padding operates on the *param tree* (the functional analogue of the
+reference's module rewrite): q/o kernels gain zero head-columns/rows.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_number_of_extra_heads(num_heads: int, tp: int) -> int:
+    """Reference ``get_number_of_extra_heads:14``."""
+    return (tp - num_heads % tp) % tp
+
+
+def pad_attention_params(q_kernel, o_kernel, num_heads: int, head_dim: int,
+                         tp: int) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Zero-pad ``q_kernel [.., H, num_heads*hd]`` and ``o_kernel
+    [.., num_heads*hd, H]`` to a tp-divisible head count (reference
+    ``pad_model:32``). Returns ``(q_padded, o_padded, padded_heads)``."""
+    extra = get_number_of_extra_heads(num_heads, tp)
+    if extra == 0:
+        return np.asarray(q_kernel), np.asarray(o_kernel), num_heads
+    q = np.asarray(q_kernel)
+    o = np.asarray(o_kernel)
+    q_pad = np.zeros((*q.shape[:-1], extra * head_dim), q.dtype)
+    o_pad = np.zeros((*o.shape[:-2], extra * head_dim, o.shape[-1]), o.dtype)
+    return (np.concatenate([q, q_pad], axis=-1),
+            np.concatenate([o, o_pad], axis=-2),
+            num_heads + extra)
+
+
+def generate_padding_mask(num_real_heads: int, num_padded_heads: int,
+                          head_dim: int) -> jnp.ndarray:
+    """[num_padded*hd] mask, 1 for real-head features (reference
+    ``generate_padding_mask:114``)."""
+    m = np.zeros((num_padded_heads * head_dim,), np.float32)
+    m[:num_real_heads * head_dim] = 1.0
+    return jnp.asarray(m)

@@ -1,5 +1,6 @@
 """Conversion and operator CLIs (reference: ``scripts/``)."""
 
 from . import checkpoint_converter
+from . import reshard_checkpoint
 
-__all__ = ["checkpoint_converter"]
+__all__ = ["checkpoint_converter", "reshard_checkpoint"]

@@ -1,0 +1,44 @@
+"""Checkpoint reshard/merge CLI.
+
+Analogue of the reference's ``optimizer/convert_zero_checkpoints.py``
+(``nxd_convert_zero_checkpoints``: merge/split DP-sharded optimizer states
+sharded↔full↔resharded). Our checkpoint engine stores arrays
+sharding-agnostically (Orbax/TensorStore), so "merging to full" and
+"resharding" are both just a load (optionally onto a different mesh) plus a
+save — this CLI packages that for operators.
+
+    python -m neuronx_distributed_tpu.scripts.reshard_checkpoint \
+        --input ckpts/run1 --tag -1 --output merged/ [--sync]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="Merge or reshard a framework checkpoint")
+    ap.add_argument("--input", required=True, help="checkpoint root dir")
+    ap.add_argument("--tag", default="-1",
+                    help="tag to load (-1 = newest complete)")
+    ap.add_argument("--output", required=True, help="output checkpoint root")
+    ap.add_argument("--output-tag", default=None,
+                    help="tag to save under (default: same as loaded)")
+    args = ap.parse_args(argv)
+
+    from ..trainer import checkpoint as ckpt
+
+    state, user_content = ckpt.load_checkpoint(args.input, tag=args.tag)
+    tag = args.output_tag
+    if tag is None:
+        storage = ckpt.create_checkpoint_storage(args.input)
+        tags = ckpt._complete_tags(storage, ckpt._normalize_path(args.input))
+        tag = tags[-1] if args.tag in (None, "-1") else args.tag
+    ckpt.save_checkpoint(args.output, tag, state, user_content=user_content,
+                         async_save=False)
+    print(f"resharded {args.input}/{args.tag} -> {args.output}/{tag}")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

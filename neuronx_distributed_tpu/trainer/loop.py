@@ -47,7 +47,7 @@ class MetricsLogger(Callback):
         self._t0 = time.perf_counter()
 
     def on_step_end(self, trainer, metrics):
-        step = int(trainer.state.step)
+        step = trainer.host_step
         self._tokens += trainer.tokens_per_batch
         if step % self.every == 0:
             dt = time.perf_counter() - self._t0
@@ -71,7 +71,7 @@ class CheckpointCallback(Callback):
         self.num_kept = num_kept
 
     def on_step_end(self, trainer, metrics):
-        step = int(trainer.state.step)
+        step = trainer.host_step
         if self.every and step % self.every == 0:
             ckpt.save_checkpoint(self.path, step, trainer.state,
                                  async_save=True, num_kept=self.num_kept)
@@ -94,24 +94,30 @@ class Trainer:
         self.state = state
         self.callbacks = callbacks or []
         self.tokens_per_batch = 0
+        # host-side mirror of state.step: callbacks read this instead of
+        # int(state.step), which would force a device sync every iteration
+        # and break async dispatch overlap
+        self.host_step = int(state.step)
         if resume_path is not None and ckpt.has_checkpoint(resume_path):
             target = jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                                sharding=x.sharding), state)
             self.state, _ = ckpt.load_checkpoint(resume_path, tag=None,
                                                  target=target)
-            logger.info("resumed from step %d", int(self.state.step))
+            self.host_step = int(self.state.step)
+            logger.info("resumed from step %d", self.host_step)
 
     def fit(self, batches: Iterable, max_steps: Optional[int] = None):
         for cb in self.callbacks:
             cb.on_train_start(self)
         metrics: Dict = {}
         for batch in batches:
-            if max_steps is not None and int(self.state.step) >= max_steps:
+            if max_steps is not None and self.host_step >= max_steps:
                 break
             ids = batch.get("input_ids")
             self.tokens_per_batch = int(ids.size) if ids is not None else 0
             self.state, metrics = self.step_fn(self.state, batch)
+            self.host_step += 1
             for cb in self.callbacks:
                 cb.on_step_end(self, metrics)
         for cb in self.callbacks:

@@ -165,3 +165,15 @@ def test_stale_newest_pointer_ignored(tmp_path):
     loaded, _ = ckpt.load_checkpoint(path, tag=None)
     np.testing.assert_allclose(loaded["params"]["w"],
                                _state(2)["params"]["w"])
+
+
+def test_reshard_cli(tmp_path):
+    from neuronx_distributed_tpu.scripts import reshard_checkpoint
+
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    ckpt.save_checkpoint(src, 42, _state(3), async_save=False)
+    reshard_checkpoint.main(["--input", src, "--output", dst])
+    loaded, _ = ckpt.load_checkpoint(dst, 42)
+    np.testing.assert_allclose(loaded["params"]["w"],
+                               _state(3)["params"]["w"])

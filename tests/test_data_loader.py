@@ -1,0 +1,67 @@
+"""Native C++ data loader: build, correctness, throughput sanity, fallback
+parity."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.data import TokenBatchLoader
+
+
+@pytest.fixture(scope="module")
+def token_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("data") / "tokens.bin"
+    # 1000 sequences of length 9 (seq 8 + 1), token value = sequence index
+    seqs = np.repeat(np.arange(1000, dtype=np.uint16)[:, None], 9, axis=1)
+    seqs.tofile(p)
+    return str(p)
+
+
+def test_native_loader_builds_and_loads(token_file):
+    loader = TokenBatchLoader(token_file, batch=4, seqlen=8, seed=1)
+    assert loader.native, "native .so failed to build"
+    assert loader.num_sequences == 1000
+    b = loader.next_batch()
+    assert b["input_ids"].shape == (4, 8)
+    assert b["labels"].shape == (4, 8)
+    # every row is a constant-valued sequence (by construction), and labels
+    # are the shifted continuation of the same row
+    for r in range(4):
+        assert len(set(b["input_ids"][r].tolist())) == 1
+        assert (b["labels"][r] == b["input_ids"][r][0]).all()
+    # rows vary across batches (shuffled)
+    vals = {int(loader.next_batch()["input_ids"][0, 0]) for _ in range(20)}
+    assert len(vals) > 5
+    loader.close()
+
+
+def test_python_fallback_same_semantics(token_file):
+    loader = TokenBatchLoader(token_file, batch=4, seqlen=8,
+                              force_python=True)
+    assert not loader.native
+    b = loader.next_batch()
+    assert b["input_ids"].shape == (4, 8)
+    for r in range(4):
+        assert (b["labels"][r] == b["input_ids"][r][0]).all()
+
+
+def test_native_loader_rejects_bad_input(tmp_path, token_file):
+    small = tmp_path / "small.bin"
+    np.arange(5, dtype=np.uint16).tofile(small)
+    with pytest.raises((ValueError, RuntimeError)):
+        TokenBatchLoader(str(small), batch=8, seqlen=8)
+
+
+def test_native_prefetch_overlap(token_file):
+    """Prefetched batches should be near-instant after warmup."""
+    loader = TokenBatchLoader(token_file, batch=64, seqlen=8, nthreads=2,
+                              capacity=4)
+    loader.next_batch()
+    time.sleep(0.05)  # let workers fill the ring
+    t0 = time.perf_counter()
+    loader.next_batch()
+    dt = time.perf_counter() - t0
+    assert dt < 0.05, f"prefetched batch took {dt * 1e3:.1f} ms"
+    loader.close()
