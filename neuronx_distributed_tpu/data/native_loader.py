@@ -77,6 +77,11 @@ class TokenBatchLoader:
         self.dtype = np.dtype(dtype)
         if self.dtype.itemsize not in (2, 4):
             raise ValueError("token dtype must be uint16 or uint32")
+        if nthreads < 1:
+            raise ValueError("nthreads must be >= 1 (zero producer threads "
+                             "would deadlock next_batch)")
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
         self._handle = None
         self._lib = None if force_python else _load_native()
         if self._lib is not None:

@@ -59,7 +59,6 @@ def build_medusa_tree(tree_choices: Tuple[Tuple[int, ...], ...]
     paths = [()] + [tuple(p) for p in tree_choices]
     index = {p: i for i, p in enumerate(paths)}
     t = len(paths)
-    mask = jnp.zeros((t, t), jnp.bool_)
     parent = []
     depth = []
     head = []

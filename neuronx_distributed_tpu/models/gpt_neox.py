@@ -49,10 +49,9 @@ class GPTNeoXConfig:
 
     @property
     def rotary_dim(self) -> int:
-        """Rotated slice of each head dim: even, >= 2 (apply_rotary splits
-        it in half)."""
-        r = int(self.head_dim * self.rotary_pct)
-        return max(2, (r // 2) * 2)
+        """Rotated slice of each head dim (even; 0 disables rotary —
+        apply_rotary splits the slice in half)."""
+        return (int(self.head_dim * self.rotary_pct) // 2) * 2
 
 
 GPT_NEOX_20B = GPTNeoXConfig()
@@ -158,8 +157,8 @@ class GPTNeoXForCausalLM(nn.Module):
                 input_ids)
         if cfg.sequence_parallel:
             x = mappings.scatter_to_sequence_parallel_region(x, seq_dim=1)
-        cos, sin = attn_mod.precompute_rope(cfg.rotary_dim, cfg.max_seq_len,
-                                            cfg.rope_theta)
+        cos, sin = attn_mod.precompute_rope(max(2, cfg.rotary_dim),
+                                            cfg.max_seq_len, cfg.rope_theta)
         if cfg.scan_layers:
             body_cls = _NeoXScanBody
             if cfg.remat:

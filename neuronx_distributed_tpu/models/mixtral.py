@@ -41,6 +41,13 @@ MIXTRAL_8X7B = MixtralConfig(
     num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=1e6,
     num_experts=8, top_k=2)
 
+# DBRX (reference: examples/training/dbrx): 16 fine-grained experts, top-4,
+# GQA with 8 kv heads — same decoder skeleton, different routing width.
+DBRX = MixtralConfig(
+    vocab_size=100352, hidden_size=6144, intermediate_size=10752,
+    num_layers=40, num_heads=48, num_kv_heads=8, rope_theta=5e5,
+    max_seq_len=32768, num_experts=16, top_k=4)
+
 
 def tiny_moe_config(**kw) -> MixtralConfig:
     base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,

@@ -3,12 +3,14 @@
 from . import expert_mlps
 from . import model
 from . import routing
+from . import token_shuffling
 from .expert_mlps import ExpertMLPs, build_dispatch_combine, compute_capacity
 from .model import MoE, SharedExperts
 from .routing import GroupLimitedRouter, RouterSinkhorn, RouterTopK
 
 __all__ = [
     "expert_mlps",
+    "token_shuffling",
     "model",
     "routing",
     "ExpertMLPs",

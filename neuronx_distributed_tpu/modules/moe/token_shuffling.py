@@ -1,0 +1,42 @@
+"""MoE token shuffling for load balance.
+
+Analogue of the reference's ``modules/moe/token_shuffling.py``
+(``token_shuffle:64``, ``token_unshuffle:102``): randomly permute tokens
+across the data shards before routing so hot prompts don't overload one
+shard's experts, then invert after the MoE block.
+
+TPU-native: the permutation is a seeded on-device ``jax.random.permutation``
+plus an all-to-all over the shuffle axis (dp_exp in the expert mesh view);
+the inverse uses the same seed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...parallel import comm, mappings
+from ...parallel import mesh as ps
+
+
+def token_shuffle(x: jax.Array, key: jax.Array,
+                  axis: str = ps.EXP_DP_AXIS) -> Tuple[jax.Array, jax.Array]:
+    """Shuffle tokens [T, H] across the shuffle axis; returns
+    ``(shuffled, perm)`` where ``perm`` inverts the local permutation."""
+    t = x.shape[0]
+    perm = jax.random.permutation(key, t)
+    x = x[perm]
+    # tiled all-to-all splits dim 0 into axis-size slices and exchanges
+    # them in place — no reshape needed
+    x = comm.all_to_all(x, axis, split_dim=0, concat_dim=0)
+    return x, perm
+
+
+def token_unshuffle(x: jax.Array, perm: jax.Array,
+                    axis: str = ps.EXP_DP_AXIS) -> jax.Array:
+    """Invert :func:`token_shuffle` (reference ``token_unshuffle:102``)."""
+    x = comm.all_to_all(x, axis, split_dim=0, concat_dim=0)
+    inv = jnp.argsort(perm)
+    return x[inv]

@@ -273,19 +273,22 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # clamp block sizes to the sequence before any divisibility decision
     bq = min(block_q, sq)
     bk = min(block_k, sk)
-    # Mosaic tiling: lane dim multiple of 128, sublane-dim blocks multiple
-    # of 8 (fp32) — require it for auto-dispatch; force_pallas raises below
-    tileable = (sq % bq == 0 and sk % bk == 0 and d % 128 == 0
-                and bq % 8 == 0 and bk % 8 == 0)
+    # Mosaic tiling: d and (because the lse output's lane dim is block_q)
+    # the block sizes must be 128-aligned for the compiled TPU path; the
+    # force path accepts 8-aligned blocks (interpret mode / expert use)
+    tileable_loose = (sq % bq == 0 and sk % bk == 0 and d % 128 == 0
+                      and bq % 8 == 0 and bk % 8 == 0)
+    tileable_strict = (tileable_loose and bq % 128 == 0 and bk % 128 == 0)
     if force_pallas:
-        if not tileable:
+        if not tileable_loose:
             raise ValueError(
                 f"force_pallas: shapes (sq={sq}, sk={sk}, d={d}) don't tile "
                 f"with block_q={bq}, block_k={bk} (d must be a multiple of "
-                "128)")
+                "128, blocks of 8)")
         use_pallas = True
     elif force_pallas is None:
-        use_pallas = (jax.default_backend() in ("tpu", "axon") and tileable)
+        use_pallas = (jax.default_backend() in ("tpu", "axon")
+                      and tileable_strict)
     else:
         use_pallas = False
     if use_pallas:

@@ -29,15 +29,21 @@ def main(argv=None) -> None:
 
     from ..trainer import checkpoint as ckpt
 
-    state, user_content = ckpt.load_checkpoint(args.input, tag=args.tag)
-    tag = args.output_tag
-    if tag is None:
+    # resolve the tag FIRST so the loaded state and the saved tag can never
+    # disagree (a concurrent writer could complete a newer tag in between)
+    tag = args.tag
+    if tag in (None, "-1"):
         storage = ckpt.create_checkpoint_storage(args.input)
         tags = ckpt._complete_tags(storage, ckpt._normalize_path(args.input))
-        tag = tags[-1] if args.tag in (None, "-1") else args.tag
-    ckpt.save_checkpoint(args.output, tag, state, user_content=user_content,
-                         async_save=False)
-    print(f"resharded {args.input}/{args.tag} -> {args.output}/{tag}")
+        if not tags:
+            raise FileNotFoundError(
+                f"no complete checkpoint under {args.input}")
+        tag = tags[-1]
+    state, user_content = ckpt.load_checkpoint(args.input, tag=tag)
+    out_tag = args.output_tag if args.output_tag is not None else tag
+    ckpt.save_checkpoint(args.output, out_tag, state,
+                         user_content=user_content, async_save=False)
+    print(f"resharded {args.input}/{tag} -> {args.output}/{out_tag}")
 
 
 if __name__ == "__main__":  # pragma: no cover

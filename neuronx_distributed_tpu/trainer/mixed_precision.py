@@ -38,6 +38,10 @@ def with_fp32_master_weights(
         return MasterWeightsState(master=master, inner=tx.init(master))
 
     def update(grads, state, params=None):
+        if params is None:
+            raise ValueError(
+                "with_fp32_master_weights requires the live params: call "
+                "tx.update(grads, state, params)")
         grads32 = jax.tree_util.tree_map(
             lambda g: g.astype(jnp.float32), grads)
         updates, inner = tx.update(grads32, state.inner, state.master)

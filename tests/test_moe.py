@@ -203,3 +203,48 @@ def test_mixtral_sequence_parallel_matches_dense():
         in_specs=(pm.param_specs, P(None, None), P(None, None)),
         out_specs=P()))(params, ids, labels)
     np.testing.assert_allclose(float(sharded), float(dense), rtol=2e-4)
+
+
+def test_token_shuffle_roundtrip():
+    from neuronx_distributed_tpu.modules.moe.token_shuffling import (
+        token_shuffle, token_unshuffle)
+
+    nxd.neuronx_distributed_config(expert_parallel_size=2)
+    em = ps.get_expert_mesh()
+    x = jnp.arange(32.0).reshape(16, 2)
+
+    def f(x):
+        sh, perm = token_shuffle(x, jax.random.key(0))
+        back = token_unshuffle(sh, perm)
+        return sh, back
+
+    sh, back = jax.jit(ps.shard_map(
+        f, em, in_specs=P("dp_exp", None),
+        out_specs=(P("dp_exp", None), P("dp_exp", None))))(x)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+    assert not np.allclose(np.asarray(sh), np.asarray(x))
+
+
+def test_dbrx_config_trains():
+    from neuronx_distributed_tpu.models.mixtral import (DBRX,
+                                                        MixtralForCausalLM)
+    import dataclasses
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=2)
+    mcfg = dataclasses.replace(
+        DBRX, vocab_size=256, hidden_size=64, intermediate_size=64,
+        num_layers=1, num_heads=4, num_kv_heads=2, max_seq_len=64,
+        dtype=jnp.float32, param_dtype=jnp.float32, capacity_factor=4.0)
+    assert mcfg.num_experts == 16 and mcfg.top_k == 4
+    model = MixtralForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (4, 17), 0, 256)
+    from neuronx_distributed_tpu.trainer import (initialize_parallel_model,
+                                                 initialize_parallel_optimizer,
+                                                 make_train_step)
+
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           ids[:, :-1])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 3e-3)
+    step = make_train_step(pm, tx, sh)
+    state, m = step(state, {"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+    assert np.isfinite(float(m["loss"]))
