@@ -16,10 +16,52 @@ The reference repo publishes no in-tree numbers (BASELINE.md), so
 import json
 import os
 import sys
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
+
+def _init_backend_with_watchdog(timeout_s: float = 180.0):
+    """The axon TPU tunnel can wedge such that even ``jax.devices()`` blocks
+    forever (observed 2026-07-28). Probe backend init on a daemon thread; on
+    timeout, re-exec on the CPU backend so the driver still gets a JSON line
+    instead of a hang."""
+    if os.environ.get("NXD_BENCH_CPU_FALLBACK") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return jax
+    result = {}
+
+    def probe():
+        try:
+            import jax as _jax
+
+            result["n"] = len(_jax.devices())
+            result["jax"] = _jax
+        except Exception as e:  # pragma: no cover
+            result["err"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "n" in result:
+        return result["jax"]
+    if "err" in result:
+        print(f"bench: TPU backend init failed: {result['err']!r}; "
+              "re-executing on CPU backend", file=sys.stderr)
+    else:
+        print(f"bench: TPU backend init unresponsive after {timeout_s:.0f}s; "
+              "re-executing on CPU backend", file=sys.stderr)
+    env = dict(os.environ)
+    env["NXD_BENCH_CPU_FALLBACK"] = "1"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
+              env)
+
+
+jax = _init_backend_with_watchdog()
+import jax.numpy as jnp  # noqa: E402
 
 
 def main():
@@ -34,7 +76,16 @@ def main():
     n_dev = len(jax.devices())
     platform = jax.devices()[0].platform
 
-    if n_dev >= 8:
+    if platform == "cpu":
+        # fallback mode (TPU unreachable): tiny model so the run finishes;
+        # the metric name marks it as a cpu measurement
+        mcfg = llama.LlamaConfig(
+            vocab_size=1024, hidden_size=256, intermediate_size=704,
+            num_layers=4, num_heads=8, num_kv_heads=8, max_seq_len=512,
+            remat=True)
+        tp = 2 if n_dev % 2 == 0 else 1
+        batch, seq = 4, 512
+    elif n_dev >= 8:
         # Llama-2-7B TP=8 + ZeRO-1 + remat: the reference's canonical config
         mcfg = llama.LLAMA2_7B
         tp = 8
@@ -86,11 +137,13 @@ def main():
                                  "BENCH_BASELINE.json")
     vs_baseline = 1.0
     try:
+        # baseline comparisons are per-platform: a CPU-fallback run must
+        # neither seed nor be compared against the TPU baseline
         if os.path.exists(baseline_path):
             base = json.load(open(baseline_path))
-            if base.get("value"):
+            if base.get("value") and base.get("platform") == platform:
                 vs_baseline = tok_per_sec_per_chip / base["value"]
-        else:
+        elif platform != "cpu":
             json.dump({"value": tok_per_sec_per_chip,
                        "platform": platform, "n_dev": n_dev},
                       open(baseline_path, "w"))

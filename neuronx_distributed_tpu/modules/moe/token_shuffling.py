@@ -12,12 +12,12 @@ the inverse uses the same seed.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 
-from ...parallel import comm, mappings
+from ...parallel import comm
 from ...parallel import mesh as ps
 
 

@@ -293,6 +293,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         use_pallas = False
     if use_pallas:
         interpret = jax.default_backend() == "cpu"
+        if not interpret and not tileable_strict:
+            raise ValueError(
+                f"force_pallas on TPU requires 128-aligned blocks "
+                f"(got block_q={bq}, block_k={bk}); loose 8-aligned blocks "
+                "are only valid in CPU interpret mode")
         return _flash_pallas(q, k, v, causal, bq, bk, scale_, interpret)
     return flash_attention_xla(q, k, v, causal=causal,
                                block_k=bk, scale=scale_)
