@@ -26,9 +26,11 @@ def _init_backend_with_watchdog(timeout_s: float = 180.0):
     timeout, re-exec on the CPU backend so the driver still gets a JSON line
     instead of a hang."""
     if os.environ.get("NXD_BENCH_CPU_FALLBACK") == "1":
+        from neuronx_distributed_tpu.utils.cpu_mesh import force_cpu_platform
+
+        force_cpu_platform(8)
         import jax
 
-        jax.config.update("jax_platforms", "cpu")
         return jax
     result = {}
 

@@ -1,0 +1,44 @@
+"""Force the host (CPU) backend with a virtual multi-device mesh.
+
+The axon TPU plugin's sitecustomize overrides the ``JAX_PLATFORMS`` env var,
+and its backend init can hang indefinitely when the tunnel is wedged
+(observed 2026-07-28: even ``jax.devices()`` blocked forever).  The
+in-process config update below is the only reliable way to bypass it; it
+must run before the first backend use.  ``XLA_FLAGS`` is likewise read at
+backend init, so topping up the virtual device count here works as long as
+no jax computation ran earlier in this process.
+
+Single home for the workaround used by ``tests/conftest.py``,
+``__graft_entry__.dryrun_multichip`` and ``bench.py``'s CPU fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_platform(n_devices: int = 8) -> None:
+    """Pin jax to the CPU backend with >= ``n_devices`` virtual devices.
+
+    Must be called before the first backend use in the process.  If an
+    ``xla_force_host_platform_device_count`` flag is already present with a
+    smaller count, it is raised to ``n_devices``; a larger count is kept.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(_COUNT_FLAG + r"=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"{_COUNT_FLAG}={n_devices}")
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialised; caller's device check decides

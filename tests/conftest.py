@@ -6,19 +6,13 @@ code runs unchanged on ``--xla_force_host_platform_device_count=8`` CPU
 devices.
 """
 
-import os
-
-# Must be set before the CPU backend initialises.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
+from neuronx_distributed_tpu.utils.cpu_mesh import force_cpu_platform
 
 # The axon sitecustomize pins jax_platforms to the TPU plugin; tests always
-# run on the virtual CPU mesh.
-jax.config.update("jax_platforms", "cpu")
+# run on the virtual CPU mesh. Must run before the CPU backend initialises.
+force_cpu_platform(8)
+
+import jax  # noqa: E402
 
 import pytest  # noqa: E402
 
