@@ -24,6 +24,7 @@ import hashlib
 import io
 import json
 import logging
+import math
 import os
 import pickle
 import zipfile
@@ -116,8 +117,10 @@ class NxDModel:
         return sorted({k for k, _ in self._artifacts})
 
     def router(self, key: str, args) -> TraceArtifacts:
-        """Pick the first bucket whose shapes fit ``args``; exact match
-        preferred, else smallest bucket with every dim >=."""
+        """Pick the bucket whose shapes fit ``args``: exact match preferred,
+        else the *smallest-volume* bucket with every dim >= (reference
+        ``router:451`` picks the tightest bucket; insertion order must not
+        matter)."""
         flat_in = [jnp.shape(x) for x in jax.tree_util.tree_leaves(args)]
         candidates = []
         for (k, bi), art in sorted(self._artifacts.items(),
@@ -131,18 +134,41 @@ class NxDModel:
             if len(flat_b) == len(flat_in) and all(
                     len(a) == len(b) and all(x >= y for x, y in zip(a, b))
                     for a, b in zip(flat_b, flat_in)):
-                candidates.append(art)
+                volume = sum(math.prod(s) for s in flat_b)
+                candidates.append((volume, art))
         if candidates:
-            return candidates[0]
+            return min(candidates, key=lambda c: c[0])[1]
         raise KeyError(
             f"no bucket of {key!r} fits shapes {flat_in}; "
             f"available keys: {self.keys()}")
 
-    def forward(self, key: str, *args):
-        """Execute the matching compiled bucket. Args must already match the
-        bucket shapes (use :func:`pad_to_bucket` / the generation loop's
-        bucketing for ragged inputs)."""
+    def forward(self, key: str, *args, pad_inputs: bool = False):
+        """Execute the matching compiled bucket.
+
+        A shape mismatch with the routed bucket raises a clear error by
+        default. With ``pad_inputs=True`` inputs are right-padded with
+        zeros up to the bucket shapes — note outputs then come back at the
+        *bucket* shape, with trailing positions corresponding to padding
+        (the caller owns slicing/masking; see the generation loop's
+        bucketing for the canonical use)."""
         art = self.router(key, args)
+        flat_args, treedef = jax.tree_util.tree_flatten(tuple(args))
+        flat_bucket = jax.tree_util.tree_leaves(art.bucket)
+        if any(jnp.shape(a) != tuple(b.shape)
+               for a, b in zip(flat_args, flat_bucket)):
+            if not pad_inputs:
+                raise ValueError(
+                    f"args shapes {[jnp.shape(a) for a in flat_args]} do not "
+                    f"exactly match bucket "
+                    f"{[tuple(b.shape) for b in flat_bucket]} of {key!r} "
+                    "(pass pad_inputs=True to zero-pad up to the bucket; "
+                    "outputs then come back at the bucket shape)")
+            flat_args = [
+                jnp.pad(a, [(0, bs - s) for s, bs in
+                            zip(jnp.shape(a), b.shape)])
+                if jnp.shape(a) != tuple(b.shape) else a
+                for a, b in zip(flat_args, flat_bucket)]
+            args = jax.tree_util.tree_unflatten(treedef, flat_args)
         if art.compiled is None:
             # loaded-from-disk path: compile the exported artifact lazily.
             # A multi-device export must be compiled in a matching device

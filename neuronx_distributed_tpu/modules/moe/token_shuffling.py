@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ...parallel import comm
 from ...parallel import mesh as ps
+from ...parallel import random as prandom
 
 
 def token_shuffle(x: jax.Array, key: jax.Array,
@@ -26,6 +27,10 @@ def token_shuffle(x: jax.Array, key: jax.Array,
     """Shuffle tokens [T, H] across the shuffle axis; returns
     ``(shuffled, perm)`` where ``perm`` inverts the local permutation."""
     t = x.shape[0]
+    # decorrelate the local permutation per shard — identical permutations
+    # on every shard would degenerate cross-shard mixing to the fixed
+    # block all-to-all
+    key = prandom.fold_in_bound_axes(key, (axis,))
     perm = jax.random.permutation(key, t)
     x = x[perm]
     # tiled all-to-all splits dim 0 into axis-size slices and exchanges
@@ -36,7 +41,10 @@ def token_shuffle(x: jax.Array, key: jax.Array,
 
 def token_unshuffle(x: jax.Array, perm: jax.Array,
                     axis: str = ps.EXP_DP_AXIS) -> jax.Array:
-    """Invert :func:`token_shuffle` (reference ``token_unshuffle:102``)."""
+    """Invert :func:`token_shuffle` (reference ``token_unshuffle:102``).
+
+    ``perm`` is the (per-shard) permutation returned by
+    :func:`token_shuffle`, already derived from the folded key."""
     x = comm.all_to_all(x, axis, split_dim=0, concat_dim=0)
     inv = jnp.argsort(perm)
     return x[inv]

@@ -56,6 +56,15 @@ class CheckpointIOState:
         for _, t in pending:
             t.join()
 
+    def wait_tag(self, tag: str) -> None:
+        """Join in-flight saves of one tag (overwrite must not race the
+        previous commit thread re-writing the done-marker)."""
+        with self._lock:
+            pending = [p for p in self._pending if p[0] == tag]
+            self._pending = [p for p in self._pending if p[0] != tag]
+        for _, t in pending:
+            t.join()
+
 
 _IO_STATE = CheckpointIOState()
 atexit.register(_IO_STATE.wait_all)
@@ -130,11 +139,24 @@ def save_checkpoint(
     tdir = _tag_dir(path, tag)
     storage.create_dir(tdir)
 
+    # Commit-protocol invariant: done-marker implies durable tensors. An
+    # overwrite of an existing complete tag must drop the stale marker
+    # before the state dir is touched, else a crash mid-rewrite leaves a
+    # half-written checkpoint that _is_complete() accepts. An in-flight
+    # async save of the same tag would re-write the marker from its commit
+    # thread — join it first.
+    _IO_STATE.wait_tag(tag)
+    storage.remove_file(os.path.join(tdir, DONE_FILE))
+
     ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
     state_path = _orbax_path(tdir)
     if storage.dir_exists(state_path):
         storage.remove_dir(state_path)
-    ckptr.save(state_path, args=ocp.args.StandardSave(state))
+    try:
+        ckptr.save(state_path, args=ocp.args.StandardSave(state))
+    except Exception:
+        ckptr.close()
+        raise
 
     if user_content is not None:
         storage.save_object(user_content, os.path.join(tdir,

@@ -45,6 +45,9 @@ class BaseCheckpointStorage(ABC):
     def remove_dir(self, dirname: str) -> None: ...
 
     @abstractmethod
+    def remove_file(self, filename: str) -> None: ...
+
+    @abstractmethod
     def save_text(self, text: str, filename: str) -> None: ...
 
     @abstractmethod
@@ -78,6 +81,12 @@ class FilesysCheckpointStorage(BaseCheckpointStorage):
 
     def remove_dir(self, dirname: str) -> None:
         shutil.rmtree(dirname, ignore_errors=True)
+
+    def remove_file(self, filename: str) -> None:
+        try:
+            os.remove(filename)
+        except FileNotFoundError:
+            pass
 
     def save_text(self, text: str, filename: str) -> None:
         os.makedirs(os.path.dirname(filename), exist_ok=True)
@@ -128,6 +137,14 @@ class ObjectStoreCheckpointStorage(BaseCheckpointStorage):
 
     def remove_dir(self, dirname: str) -> None:
         self._fs.rm(dirname, recursive=True)
+
+    def remove_file(self, filename: str) -> None:
+        # try/except rather than isfile-then-rm: fsspec dircaches can
+        # report a stale False and silently skip the delete
+        try:
+            self._fs.rm(filename)
+        except FileNotFoundError:
+            pass
 
     def save_text(self, text: str, filename: str) -> None:
         with self._fs.open(filename, "w") as f:

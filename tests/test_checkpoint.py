@@ -177,3 +177,20 @@ def test_reshard_cli(tmp_path):
     loaded, _ = ckpt.load_checkpoint(dst, 42)
     np.testing.assert_allclose(loaded["params"]["w"],
                                _state(3)["params"]["w"])
+
+
+def test_overwrite_drops_stale_done_marker(tmp_path):
+    """Re-saving an existing complete tag must drop the done-marker before
+    the rewrite starts: a save that dies mid-write must not leave the tag
+    looking complete (advisor finding r1)."""
+    path = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(path, 5, _state(), async_save=False)
+    assert ckpt.has_checkpoint(path, 5)
+
+    class _Unsaveable:
+        pass
+
+    with pytest.raises(Exception):
+        ckpt.save_checkpoint(path, 5, {"bad": _Unsaveable()},
+                             async_save=False)
+    assert not ckpt.has_checkpoint(path, 5)

@@ -192,3 +192,32 @@ def test_distributed_argmax_topk():
         in_specs=P(None, "tp"), out_specs=(P(None, None), P(None, None))))(x)
     np.testing.assert_allclose(np.asarray(v), np.asarray(ref_v), rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i))
+
+
+def test_router_smallest_bucket_and_padding(tiny_model):
+    """Router must pick the tightest fitting bucket regardless of
+    registration order, and forward must zero-pad ragged args up to the
+    bucket (advisor finding r1: first-registered large bucket swallowed
+    small inputs and unpadded args hit an opaque XLA shape error)."""
+    cfg, model, params = tiny_model
+
+    def ce_fn(ids):
+        return model.apply(params, ids)
+
+    # larger bucket registered FIRST
+    nxd_model = (ModelBuilder()
+                 .add("ce", ce_fn, [(jnp.zeros((2, 16), jnp.int32),),
+                                    (jnp.zeros((2, 8), jnp.int32),)])
+                 .trace().compile())
+
+    ids = jax.random.randint(jax.random.key(8), (2, 5), 0, cfg.vocab_size)
+    art = nxd_model.router("ce", (ids,))
+    assert jax.tree_util.tree_leaves(art.bucket)[0].shape == (2, 8)
+
+    out = nxd_model.forward("ce", ids, pad_inputs=True)
+    padded = jnp.pad(ids, ((0, 0), (0, 3)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ce_fn(padded)),
+                               rtol=1e-3, atol=1e-5)
+    # loud failure by default: padding changes output shapes, caller opts in
+    with pytest.raises(ValueError, match="pad_inputs"):
+        nxd_model.forward("ce", ids)

@@ -248,3 +248,25 @@ def test_dbrx_config_trains():
     step = make_train_step(pm, tx, sh)
     state, m = step(state, {"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
     assert np.isfinite(float(m["loss"]))
+
+
+def test_token_shuffle_decorrelated_across_shards():
+    """Each dp_exp shard must apply a different local permutation (advisor
+    finding r1: identical keys degenerate mixing to the fixed all-to-all)."""
+    from neuronx_distributed_tpu.modules.moe.token_shuffling import (
+        token_shuffle)
+
+    nxd.neuronx_distributed_config(expert_parallel_size=2)
+    em = ps.get_expert_mesh()
+    x = jnp.arange(64.0).reshape(32, 2)
+
+    def f(x):
+        _, perm = token_shuffle(x, jax.random.key(0))
+        return perm[None]
+
+    perms = np.asarray(jax.jit(ps.shard_map(
+        f, em, in_specs=P("dp_exp", None),
+        out_specs=P("dp_exp", None)))(x))
+    assert perms.shape[0] > 1
+    assert not all((perms[i] == perms[0]).all()
+                   for i in range(1, perms.shape[0]))
