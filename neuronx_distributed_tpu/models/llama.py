@@ -361,7 +361,25 @@ class LlamaForCausalLM(nn.Module):
     def __call__(self, input_ids: jax.Array,
                  positions: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
-        x = LlamaModel(cfg, name="model")(input_ids, positions)
+        model = LlamaModel(cfg, name="model")
+        x = model(input_ids, positions)
+        if cfg.tie_embeddings:
+            if _lora_kw(cfg, "lm_head"):
+                raise ValueError(
+                    "LoRA on 'lm_head' is incompatible with "
+                    "tie_embeddings=True (there is no lm_head param); "
+                    "target 'embed' instead")
+            # tied word embeddings (reference register_shared_weights,
+            # pipeline/model.py:750): no lm_head param; logits re-use the
+            # vocab-sharded embedding table. Gradients flow through both
+            # uses of the one param.
+            from flax.core import meta
+
+            table = meta.unbox(
+                model.variables["params"]["embed"]["embedding"])
+            return pl.embedding_attend(
+                table, x, sequence_parallel=cfg.sequence_parallel,
+                dtype=cfg.dtype)
         logits = pl.ColumnParallelLinear(
             features=cfg.vocab_size, use_bias=False, gather_output=False,
             sequence_parallel=cfg.sequence_parallel,
@@ -426,11 +444,16 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
 
     norm = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype)
     x = norm.apply({"params": p["model"]["norm"]}, x)
-    head = pl.ColumnParallelLinear(
-        features=cfg.vocab_size, use_bias=False, gather_output=True,
-        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-        **_lora_kw(cfg, "lm_head"))
-    logits = head.apply({"params": p["lm_head"]}, x)
+    if cfg.tie_embeddings:
+        logits = pl.embedding_attend(
+            p["model"]["embed"]["embedding"], x, dtype=cfg.dtype,
+            gather_output=True)
+    else:
+        head = pl.ColumnParallelLinear(
+            features=cfg.vocab_size, use_bias=False, gather_output=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            **_lora_kw(cfg, "lm_head"))
+        logits = head.apply({"params": p["lm_head"]}, x)
     new_cache = KVCache(k=new_k, v=new_v, pos=slot_pos,
                         index=kv_cache.index + s)
     return logits, new_cache

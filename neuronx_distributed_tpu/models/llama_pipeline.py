@@ -101,14 +101,28 @@ def pipelined_loss_fn(cfg: LlamaConfig, num_microbatches: int,
         # accumulated per microbatch
         norm_p = jax.tree_util.tree_map(eng.stage_replicated_param,
                                         p["model"]["norm"])
-        head_p = jax.tree_util.tree_map(eng.stage_replicated_param,
-                                        p["lm_head"])
+        if cfg.tie_embeddings:
+            # tied word embeddings: the head re-uses the (already
+            # stage-replicated-wrapped) embedding table — the copy_to
+            # backward psum over pp collects the stage-0 embedding grad and
+            # the last-stage head grad into one (reference
+            # register_shared_weights/_reduce_shared_weights,
+            # pipeline/model.py:750,791)
+            head_p = embed_p["embedding"]
+        else:
+            head_p = jax.tree_util.tree_map(eng.stage_replicated_param,
+                                            p["lm_head"])
         labels_mb = eng.microbatch(labels, M)
 
         def mb_loss(carry, om):
             o, lb = om
             h = norm_mod.apply({"params": norm_p}, o)
-            logits = head_mod.apply({"params": head_p}, h)
+            if cfg.tie_embeddings:
+                logits = pl.embedding_attend(
+                    head_p, h, sequence_parallel=cfg.sequence_parallel,
+                    dtype=cfg.dtype)
+            else:
+                logits = head_mod.apply({"params": head_p}, h)
             per_tok = lf.parallel_cross_entropy(logits, lb,
                                                 ignore_index=ignore_index)
             n_valid = jnp.sum((lb != ignore_index).astype(jnp.float32))
@@ -126,9 +140,17 @@ def pipelined_loss_fn(cfg: LlamaConfig, num_microbatches: int,
 
 def make_pipeline_grad_fn(cfg: LlamaConfig, num_microbatches: int,
                           param_specs: Any,
-                          ignore_index: int = -100):
+                          ignore_index: int = -100,
+                          schedule: str = "gpipe",
+                          num_chunks: int = 1):
     """Build ``grad_fn(params, batch) -> (loss, grads)`` for
     :func:`..trainer.make_train_step`.
+
+    ``schedule``: ``"gpipe"`` (autodiff of the scanned forward,
+    :mod:`..pipeline.spmd_engine`), ``"1f1b"`` or ``"interleaved"``
+    (explicit-VJP executor with O(S·C) live activations,
+    :mod:`..pipeline.engine_1f1b`) — mirroring the reference's schedule
+    selection (``pipeline/model.py:690``).
 
     Gradients are computed *inside* shard_map and synchronised over the data
     axes with raw psum before crossing the boundary as primal outputs
@@ -138,12 +160,178 @@ def make_pipeline_grad_fn(cfg: LlamaConfig, num_microbatches: int,
     """
     from ..parallel import grads as grads_mod
 
+    if schedule != "interleaved" and num_chunks != 1:
+        raise ValueError(
+            f"num_chunks={num_chunks} only applies to "
+            f"schedule='interleaved', got schedule={schedule!r}")
+    if schedule in ("1f1b", "interleaved"):
+        return make_1f1b_grad_fn(
+            cfg, num_microbatches, param_specs, num_chunks=num_chunks,
+            ignore_index=ignore_index)
+    if schedule != "gpipe":
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
     pp_loss = pipelined_loss_fn(cfg, num_microbatches, ignore_index)
 
     def inner(params, ids, labels):
         loss, g = jax.value_and_grad(pp_loss)(params, ids, labels)
         g = grads_mod.allreduce_gradients(g, specs=param_specs)
         return loss, g
+
+    def grad_fn(params, batch):
+        mesh = ps.get_mesh()
+        return ps.shard_map(
+            inner, mesh,
+            in_specs=(param_specs, P(ps.DP_AXIS, None), P(ps.DP_AXIS, None)),
+            out_specs=(P(), param_specs))(
+                params, batch["input_ids"], batch["labels"])
+
+    return grad_fn
+
+
+def _permute_layer_stack(variables: Any, perm) -> Any:
+    out = jax.tree_util.tree_map(lambda x: x, variables)  # shallow copy
+    out["params"]["model"]["layers"] = jax.tree_util.tree_map(
+        lambda x: x[perm], variables["params"]["model"]["layers"])
+    return out
+
+
+def interleave_pipeline_params(variables: Any, cfg: LlamaConfig,
+                               num_stages: int, num_chunks: int) -> Any:
+    """Reorder the scanned layer stack from canonical order into the
+    chunk-within-stage storage the interleaved executor expects
+    (:func:`..pipeline.engine_1f1b.interleaved_layer_order`)."""
+    from ..pipeline.engine_1f1b import interleaved_layer_order
+
+    order = interleaved_layer_order(cfg.num_layers, num_stages, num_chunks)
+    return _permute_layer_stack(variables, order)
+
+
+def deinterleave_pipeline_params(variables: Any, cfg: LlamaConfig,
+                                 num_stages: int, num_chunks: int) -> Any:
+    """Inverse of :func:`interleave_pipeline_params` (checkpoint export)."""
+    import numpy as np
+
+    from ..pipeline.engine_1f1b import interleaved_layer_order
+
+    order = interleaved_layer_order(cfg.num_layers, num_stages, num_chunks)
+    return _permute_layer_stack(variables, np.argsort(order))
+
+
+def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
+                      param_specs: Any, num_chunks: int = 1,
+                      ignore_index: int = -100):
+    """1F1B / interleaved executor (:mod:`..pipeline.engine_1f1b`).
+
+    Unlike the GPipe path, forward and backward interleave explicitly and
+    live activation memory is ``O(stages · chunks)`` instead of
+    ``O(num_microbatches)`` — the reference's flagship 70B config depends on
+    exactly this property (``pipeline/scheduler.py:157``).
+
+    For ``num_chunks > 1`` the layer-stack params must already be stored in
+    *interleaved* order — convert a canonical-order tree explicitly with
+    :func:`interleave_pipeline_params` (and back with
+    :func:`deinterleave_pipeline_params` before checkpoint export); passing
+    a canonical-order tree would silently train a layer-permuted model.
+    """
+    from ..parallel import grads as grads_mod
+    from ..pipeline import engine_1f1b as e1
+
+    if not cfg.scan_layers:
+        raise ValueError("pipeline path requires scan_layers=True")
+    C = num_chunks
+
+    embed_mod = pl.ParallelEmbedding(
+        num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+    norm_mod = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
+                       sequence_parallel=cfg.sequence_parallel)
+    head_mod = pl.ColumnParallelLinear(
+        features=cfg.vocab_size, use_bias=False, gather_output=False,
+        sequence_parallel=cfg.sequence_parallel,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+
+    def inner(params, ids, labels):
+        p = params["params"]
+        S = ps.get_pipeline_model_parallel_size()
+        M = num_microbatches
+        if cfg.num_layers % (S * C) != 0:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by "
+                f"stages*chunks {S * C}")
+        lv = cfg.num_layers // (S * C)
+        denom = jnp.maximum(
+            jnp.sum(labels != ignore_index).astype(jnp.float32), 1.0)
+        cos, sin = attn_mod.precompute_rope(
+            cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta,
+            use_scaled=cfg.rope_scaling)
+
+        def embed_fn(ep, ids_):
+            x = embed_mod.apply({"params": ep}, ids_)
+            if cfg.sequence_parallel:
+                x = mappings.scatter_to_sequence_parallel_region(x, seq_dim=1)
+            return x
+
+        body = nn.scan(
+            _ScanBody,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+            length=lv,
+        )(cfg)
+
+        def stage_fn(chunk_p, act):
+            out, _ = body.apply({"params": chunk_p}, act, cos, sin, None)
+            return out
+
+        if cfg.remat:
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+        tied = cfg.tie_embeddings
+
+        def head_loss_fn(hp, act, lb):
+            h = norm_mod.apply({"params": hp["norm"]}, act)
+            if tied:
+                logits = pl.embedding_attend(
+                    hp["table"], h, sequence_parallel=cfg.sequence_parallel,
+                    dtype=cfg.dtype)
+            else:
+                logits = head_mod.apply({"params": hp["lm_head"]}, h)
+            per_tok = lf.parallel_cross_entropy(logits, lb,
+                                                ignore_index=ignore_index)
+            return jnp.sum(per_tok) / denom
+
+        layers_c = jax.tree_util.tree_map(
+            lambda x: x.reshape((C, lv) + x.shape[1:]), p["model"]["layers"])
+        head_p = {"norm": p["model"]["norm"]}
+        if tied:
+            head_p["table"] = p["model"]["embed"]["embedding"]
+        else:
+            head_p["lm_head"] = p["lm_head"]
+        eng_params = {"embed": p["model"]["embed"], "layers": layers_c,
+                      "head": head_p}
+        ids_mb = eng.microbatch(ids, M)
+        labels_mb = eng.microbatch(labels, M)
+
+        loss, g = e1.pipeline_1f1b_grads(
+            embed_fn, stage_fn, head_loss_fn, eng_params, ids_mb, labels_mb,
+            num_stages=S, num_microbatches=M, num_chunks=C)
+
+        g_layers = jax.tree_util.tree_map(
+            lambda x: x.reshape((C * lv,) + x.shape[2:]), g["layers"])
+        g_embed = dict(g["embed"])
+        if tied:
+            g_embed["embedding"] = (g_embed["embedding"]
+                                    + g["head"]["table"])
+        g_model = {"embed": g_embed, "layers": g_layers,
+                   "norm": g["head"]["norm"]}
+        gp = {"model": g_model}
+        if not tied:
+            gp["lm_head"] = g["head"]["lm_head"]
+        grads = {"params": gp}
+        grads = grads_mod.allreduce_gradients(grads, specs=param_specs)
+        return eng.data_parallel_mean(loss), grads
 
     def grad_fn(params, batch):
         mesh = ps.get_mesh()

@@ -129,6 +129,33 @@ class ColumnParallelLinear(nn.Module):
         return y
 
 
+def embedding_attend(table: jax.Array, x: jax.Array, *,
+                     sequence_parallel: bool = False,
+                     dtype: Dtype = jnp.bfloat16,
+                     axis: str = ps.TP_AXIS, seq_dim: int = 1,
+                     gather_output: bool = False) -> jax.Array:
+    """Tied-embedding LM head: ``x @ table.T`` with the vocab dim tp-sharded.
+
+    The column-parallel dual of :class:`ParallelEmbedding` — same entry
+    collectives as :class:`ColumnParallelLinear` (``gather_output=False``) so
+    the result feeds vocab-parallel CE directly. Used for tied word
+    embeddings (reference ``pipeline/model.py:750``
+    ``register_shared_weights`` and the HF ``tie_word_embeddings`` configs).
+    """
+    if sequence_parallel:
+        x = mappings.gather_from_sequence_parallel_region(
+            x, axis, seq_dim, to_model_parallel=True)
+    else:
+        x = mappings.copy_to_tensor_parallel_region(x, axis)
+    y = jnp.dot(x.astype(dtype), jnp.swapaxes(table.astype(dtype), 0, 1))
+    if gather_output:
+        return mappings.gather_from_tensor_parallel_region(y, axis, -1)
+    if _bound_size(axis) is None:
+        y = ps.with_sharding_constraint(
+            y, *([None] * (y.ndim - 1) + [axis]))
+    return y
+
+
 class RowParallelLinear(nn.Module):
     """Linear with input features sharded over the tp axis.
 

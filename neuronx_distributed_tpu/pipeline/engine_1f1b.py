@@ -1,0 +1,276 @@
+"""Scanned SPMD 1F1B / interleaved pipeline executor.
+
+Executes the reference's ``Train1F1BSchedule`` (``pipeline/scheduler.py:157``)
+and ``TrainInterleavedSchedule`` (``:256``) — selected by ``NxDPPModel``'s
+exec loop (``pipeline/model.py:690,1728``) — as ONE jitted SPMD program, the
+TPU-native counterpart of the reference's host-driven per-rank task loop.
+
+Where the GPipe engine (:mod:`.spmd_engine`) derives its backward by autodiff
+of the whole scanned forward (residuals for every one of the ``M+S-1`` ticks
+stay live), this engine interleaves forward and backward *explicitly*:
+
+* every scan tick runs one forward sub-slot and one backward sub-slot per
+  stage (the 1F1B steady state);
+* backward uses ``jax.vjp`` with **recompute-from-saved-input** — each stage
+  keeps only a ring buffer of ``W = 2·S·C`` microbatch *inputs* (the
+  activation-recompute analogue of the reference's
+  ``deallocate_output_tensors`` + activation checkpointing), so live
+  activation memory is ``O(S·C)`` and independent of ``M``;
+* stage IO is a ``lax.ppermute`` ring (``s -> s+1 mod S`` for activations,
+  the reverse ring for gradients); the mod-S wraparound is what carries a
+  microbatch from chunk ``c`` on the last stage to chunk ``c+1`` on stage 0
+  in the interleaved schedule;
+* embedding and LM-head/loss run inside the tick under ``lax.cond`` whose
+  predicates are uniform across the tp group (they depend only on the tick
+  and the pp index), so non-owning stages skip the vocab-sized matmuls at
+  runtime instead of computing masked garbage.
+
+Clock (derived from the schedule task lists, which remain the specification
+— ``tests/test_pipeline.py`` pins the tick↔task mapping):
+
+* with ``SC = S·C`` virtual stages and injection in groups of ``S``
+  microbatches, forward of (microbatch ``f``, chunk ``c``) runs on stage
+  ``s`` at tick ``τ(f,c) + s`` with
+  ``τ(f,c) = (f//S)·SC + c·S + f%S``;
+* backward runs at ``(SC-1) + β(f,c) + (S-1-s)`` with
+  ``β(f,c) = (f//S)·SC + (C-1-c)·S + f%S`` — on the last stage the first
+  backward of a microbatch coincides with its last-chunk forward, so the
+  loss head feeds the backward directly;
+* ``C=1`` reduces exactly to non-interleaved 1F1B (``τ(f,0)=β(f,0)=f``);
+  total ticks ``M·C + S·C + S - 2`` vs ``2·M·C + ...`` work — the bubble is
+  ``O(S·C)`` ticks of 1-chunk work, amortised away for ``M >> S``.
+
+Interleaved storage layout: stage ``s`` holds its ``C`` chunks contiguously,
+i.e. the global scan-dim order is ``chunk-within-stage`` — use
+:func:`interleaved_layer_order` to convert to/from the canonical (dense)
+layer order for checkpoints.
+
+Gradient convention: per-shard grads are ``d(local_mean_loss)/dw`` exactly as
+:mod:`..parallel.grads` expects; pp-replicated leaves (embed/head) are
+psum'd over pp here so every rank returns identical values.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..parallel import comm
+from ..parallel import mesh as ps
+
+
+def interleaved_layer_order(num_layers: int, num_stages: int,
+                            num_chunks: int) -> np.ndarray:
+    """``order[j]`` = canonical layer index stored at interleaved slot ``j``.
+
+    Interleaved storage packs stage ``s``'s chunks contiguously so the pp
+    sharding of the scan dim stays a plain contiguous split:
+    ``storage[j] = dense[order[j]]``; invert with ``np.argsort(order)``.
+    """
+    sc = num_stages * num_chunks
+    if num_layers % sc != 0:
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by stages*chunks {sc}")
+    lv = num_layers // sc
+    order = [v * lv + i
+             for s in range(num_stages)
+             for c in range(num_chunks)
+             for v in ((c * num_stages + s),)
+             for i in range(lv)]
+    return np.asarray(order)
+
+
+def ring_buffer_slots(num_stages: int, num_chunks: int = 1) -> int:
+    """Saved-input ring size: max in-flight (f, c) lifetime is
+    ``2·S·C - 2`` ticks (stage 0, chunk 0)."""
+    return 2 * num_stages * num_chunks
+
+
+def pipeline_1f1b_grads(
+    embed_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    head_loss_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    params: Dict[str, Any],
+    ids_mb: jax.Array,
+    labels_mb: jax.Array,
+    num_stages: int,
+    num_microbatches: int,
+    num_chunks: int = 1,
+    axis: str = ps.PP_AXIS,
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Run the full 1F1B (or interleaved, ``num_chunks>1``) fwd+bwd pipeline.
+
+    Must be called with ``axis`` bound (inside shard_map over the mesh).
+
+    Args:
+      embed_fn: ``(embed_params, ids [mb, seq]) -> act`` — stage-0 chunk-0
+        prologue (embedding (+ SP scatter)).
+      stage_fn: ``(chunk_params, act) -> act`` — one chunk of this stage's
+        layer stack; ``chunk_params`` has the chunk dim already selected.
+      head_loss_fn: ``(head_params, act, labels [mb, seq]) -> scalar`` —
+        last-stage epilogue returning this microbatch's *contribution to the
+        local mean loss* (i.e. already divided by the local batch token
+        count) so cotangent seeds are 1.
+      params: ``{"embed": ..., "layers": ..., "head": ...}``; every leaf of
+        ``layers`` leads with a ``[C, lv, ...]`` chunk dim (``C=1`` for plain
+        1F1B).
+      ids_mb / labels_mb: ``[M, mb, seq]``.
+
+    Returns ``(local_loss, grads)`` with ``grads`` shaped like ``params``
+    (pp-replicated leaves already psum'd over pp; data-axis sync is the
+    caller's job via :func:`..parallel.grads.allreduce_gradients`).
+    """
+    S, M, C = num_stages, num_microbatches, num_chunks
+    SC = S * C
+    if C > 1 and M % S != 0:
+        raise ValueError(
+            f"interleaved schedule requires num_microbatches {M} divisible "
+            f"by pipeline stages {S}")
+    bound = comm._axis_size(axis)
+    if bound is None and S > 1:
+        raise ValueError(
+            f"pipeline_1f1b_grads with num_stages={S} requires the {axis!r} "
+            "axis bound (call inside shard_map over the mesh)")
+    if bound is not None and bound != S:
+        raise ValueError(f"pp axis size {bound} != num_stages {S}")
+    my = lax.axis_index(axis) if bound else jnp.zeros((), jnp.int32)
+
+    embed_p, layers_p, head_p = (params["embed"], params["layers"],
+                                 params["head"])
+    W = ring_buffer_slots(S, C)
+    T = M * C + SC + S - 2
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    # trace one embed to get the activation shape/dtype for buffers
+    act_shape = jax.eval_shape(embed_fn, embed_p, ids_mb[0])
+    zero_act = jnp.zeros(act_shape.shape, act_shape.dtype)
+
+    f32 = functools.partial(jax.tree_util.tree_map,
+                            lambda p: jnp.zeros(jnp.shape(p), jnp.float32))
+
+    def pick_chunk(c):
+        return jax.tree_util.tree_map(
+            lambda p: lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+            layers_p)
+
+    def slot_decode(slot):
+        """slot -> (valid, f, c) for the group-of-S injection order."""
+        valid = (slot >= 0) & (slot < M * C)
+        slot = jnp.clip(slot, 0, M * C - 1)
+        g, r = slot // SC, slot % SC
+        c, j = r // S, r % S
+        return valid, g * S + j, c
+
+    def tick(carry, t):
+        (buf, act_recv, grad_recv, g_layers, g_embed, g_head, loss_acc) = carry
+
+        # ---- forward sub-slot -------------------------------------------
+        fvalid, f, c_f = slot_decode(t - my)
+        sigma_f = (f // S) * SC + c_f * S + (f % S)
+        ids_f = lax.dynamic_index_in_dim(ids_mb, f, 0, keepdims=False)
+
+        x_emb = lax.cond(
+            fvalid & (my == 0) & (c_f == 0),
+            lambda ep, i: embed_fn(ep, i).astype(zero_act.dtype),
+            lambda ep, i: zero_act,
+            embed_p, ids_f)
+        inp = jnp.where((my == 0) & (c_f == 0), x_emb, act_recv)
+        out = stage_fn(pick_chunk(c_f), inp)
+        prev_in_slot = lax.dynamic_index_in_dim(buf, sigma_f % W, 0,
+                                                keepdims=False)
+        buf = lax.dynamic_update_index_in_dim(
+            buf, jnp.where(fvalid, inp, prev_in_slot), sigma_f % W, 0)
+
+        # ---- last-stage loss head: backward seed for (b, C-1) -----------
+        # backward drains chunks in reverse: slot position p in the bwd
+        # order corresponds to chunk C-1-p (β(f,c) = g·SC + (C-1-c)·S + j)
+        bvalid, b, c_pos = slot_decode(t - (SC - 1) - (S - 1 - my))
+        c_b = (C - 1) - c_pos
+        sigma_b = (b // S) * SC + c_b * S + (b % S)
+        labels_b = lax.dynamic_index_in_dim(labels_mb, b, 0, keepdims=False)
+
+        def head_vjp(hp, act, lb):
+            loss_b, vjp = jax.vjp(lambda hp_, a_: head_loss_fn(hp_, a_, lb),
+                                  hp, act)
+            dhp, dact = vjp(jnp.ones((), jnp.float32))
+            return loss_b, jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), dhp), dact
+
+        head_pred = bvalid & (my == S - 1) & (c_b == C - 1)
+        loss_b, dhead_b, dact_head = lax.cond(
+            head_pred, head_vjp,
+            lambda hp, act, lb: (jnp.zeros((), jnp.float32), f32(head_p),
+                                 jnp.zeros_like(act)),
+            head_p, out, labels_b)
+        loss_acc = loss_acc + loss_b
+        g_head = jax.tree_util.tree_map(jnp.add, g_head, dhead_b)
+
+        dout = jnp.where((my == S - 1) & (c_b == C - 1), dact_head, grad_recv)
+
+        # ---- backward sub-slot: recompute fwd of (b, c_b) from the saved
+        # input, vjp into (chunk params, input activation) ----------------
+        saved_in = lax.dynamic_index_in_dim(buf, sigma_b % W, 0,
+                                            keepdims=False)
+        _, s_vjp = jax.vjp(stage_fn, pick_chunk(c_b), saved_in)
+        dchunk, dact_in = s_vjp(dout.astype(act_shape.dtype))
+        bmask = bvalid.astype(jnp.float32)
+        g_layers = jax.tree_util.tree_map(
+            lambda acc, g: lax.dynamic_update_index_in_dim(
+                acc,
+                lax.dynamic_index_in_dim(acc, c_b, 0, keepdims=False)
+                + bmask * g.astype(jnp.float32),
+                c_b, 0),
+            g_layers, dchunk)
+
+        # ---- stage-0 chunk-0 backward continues into the embedding ------
+        def embed_vjp(ep, i, d):
+            _, vjp = jax.vjp(lambda ep_: embed_fn(ep_, i).astype(d.dtype),
+                             ep)
+            (dep,) = vjp(d)
+            return jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), dep)
+
+        ids_b = lax.dynamic_index_in_dim(ids_mb, b, 0, keepdims=False)
+        dembed_b = lax.cond(
+            bvalid & (my == 0) & (c_b == 0), embed_vjp,
+            lambda ep, i, d: f32(embed_p),
+            embed_p, ids_b, dact_in)
+        g_embed = jax.tree_util.tree_map(jnp.add, g_embed, dembed_b)
+
+        # ---- ring communications ----------------------------------------
+        act_next = comm.ppermute(out, axis, fwd_perm)
+        grad_next = comm.ppermute(dact_in, axis, bwd_perm)
+        return (buf, act_next, grad_next, g_layers, g_embed, g_head,
+                loss_acc), None
+
+    carry0 = (
+        jnp.zeros((W,) + tuple(act_shape.shape), act_shape.dtype),
+        zero_act,
+        zero_act,
+        f32(layers_p),
+        f32(embed_p),
+        f32(head_p),
+        jnp.zeros((), jnp.float32),
+    )
+    (_, _, _, g_layers, g_embed, g_head, loss_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(T))
+
+    # loss lives on the last stage; replicate over pp (primal psum is safe —
+    # no cotangent crosses here, grads are already explicit)
+    if bound is not None and bound > 1:
+        loss = lax.psum(jnp.where(my == S - 1, loss_acc, 0.0), axis)
+        g_embed = jax.tree_util.tree_map(
+            lambda g: lax.psum(jnp.where(my == 0, g, jnp.zeros_like(g)),
+                               axis), g_embed)
+        g_head = jax.tree_util.tree_map(
+            lambda g: lax.psum(jnp.where(my == S - 1, g, jnp.zeros_like(g)),
+                               axis), g_head)
+    else:
+        loss = loss_acc
+    return loss, {"embed": g_embed, "layers": g_layers, "head": g_head}

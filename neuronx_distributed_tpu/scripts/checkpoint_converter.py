@@ -77,16 +77,22 @@ def convert_hf_llama_to_nxd(state_dict: Dict[str, Any], cfg) -> Dict:
         "post_norm": {"scale": stack(
             "model.layers.{}.post_attention_layernorm.weight", np.asarray)},
     }
-    lm_head = (sd["lm_head.weight"] if "lm_head.weight" in sd
-               else sd["model.embed_tokens.weight"])
-    return {"params": {
+    tree = {"params": {
         "model": {
             "embed": {"embedding": sd["model.embed_tokens.weight"]},
             "layers": {"layer": layers},
             "norm": {"scale": sd["model.norm.weight"]},
         },
-        "lm_head": {"kernel": _t(lm_head)},
     }}
+    if getattr(cfg, "tie_embeddings", False):
+        # tied models carry no lm_head param (llama.py tie_embeddings);
+        # matches HF's tie_word_embeddings checkpoints omitting
+        # lm_head.weight
+        return tree
+    lm_head = (sd["lm_head.weight"] if "lm_head.weight" in sd
+               else sd["model.embed_tokens.weight"])
+    tree["params"]["lm_head"] = {"kernel": _t(lm_head)}
+    return tree
 
 
 def convert_nxd_to_hf_llama(params: Dict, cfg) -> Dict[str, np.ndarray]:
@@ -97,8 +103,11 @@ def convert_nxd_to_hf_llama(params: Dict, cfg) -> Dict[str, np.ndarray]:
         "model.embed_tokens.weight": np.asarray(
             p["model"]["embed"]["embedding"]),
         "model.norm.weight": np.asarray(p["model"]["norm"]["scale"]),
-        "lm_head.weight": _t(p["lm_head"]["kernel"]),
     }
+    if "lm_head" in p:
+        out["lm_head.weight"] = _t(p["lm_head"]["kernel"])
+    # tied models (no lm_head param) export the HF tie_word_embeddings
+    # convention: lm_head.weight omitted, embed_tokens carries the table
     L = cfg.num_layers
     for i in range(L):
         pre = f"model.layers.{i}."
