@@ -43,6 +43,22 @@ class KVCache(struct.PyTreeNode):
         return self.k.shape[2]
 
 
+# Make the cache serializable in jax.export artifacts (it is part of the
+# calling convention of the bundled context-encoding/token-generation
+# programs — reference packages its state buffers the same way,
+# nxd_model.py:277).
+try:
+    from jax import export as _jax_export
+
+    _jax_export.register_pytree_node_serialization(
+        KVCache,
+        serialized_name="neuronx_distributed_tpu.inference.KVCache",
+        serialize_auxdata=lambda aux: b"",
+        deserialize_auxdata=lambda b: ())  # no static fields
+except ValueError:  # pragma: no cover - double import/registration
+    pass
+
+
 def init_kv_cache(num_layers: int, batch: int, max_len: int,
                   num_kv_heads: int, head_dim: int,
                   dtype: Any = jnp.bfloat16) -> KVCache:

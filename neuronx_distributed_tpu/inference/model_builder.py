@@ -35,6 +35,9 @@ import jax
 import jax.numpy as jnp
 from jax import export as jax_export
 
+from . import kv_cache as _kv_cache  # noqa: F401 — registers KVCache
+#                                       serialization for jax.export
+
 logger = logging.getLogger(__name__)
 
 
@@ -112,6 +115,10 @@ class NxDModel:
 
     def __init__(self, artifacts: Dict[Tuple[str, int], TraceArtifacts]):
         self._artifacts = artifacts
+        # populated by load() when the bundle carries them (format v2)
+        self.params: Any = None
+        self.state_spec: Optional[dict] = None
+        self.generation_config: Optional[dict] = None
 
     def keys(self) -> List[str]:
         return sorted({k for k, _ in self._artifacts})
@@ -180,8 +187,17 @@ class NxDModel:
 
                 from ..parallel import mesh as ps
 
-                if (not ps.model_parallel_is_initialized()
-                        or ps.get_world_size() != n):
+                if not ps.model_parallel_is_initialized():
+                    # serving-process bootstrap (reference load() builds its
+                    # runtime world the same way): a plain dp mesh over the
+                    # artifact's device count
+                    if len(jax.devices()) < n:
+                        raise RuntimeError(
+                            f"artifact {key!r} was exported for {n} devices;"
+                            f" only {len(jax.devices())} available")
+                    ps.initialize_model_parallel(
+                        devices=jax.devices()[:n])
+                elif ps.get_world_size() != n:
                     raise RuntimeError(
                         f"artifact {key!r} was exported for {n} devices; "
                         "initialize_model_parallel over the same device "
@@ -192,12 +208,26 @@ class NxDModel:
                 *art.bucket).compile()
         return art.compiled(*args)
 
-    # -- persistence (reference ``nxd_model.py:565,591`` save/load of the
-    # TorchScript archive; here a zip of jax.export payloads) ---------------
+    # -- persistence (reference ``nxd_model.py:277-353,565,591``: the saved
+    # archive carries the compiled programs AND the weights, state
+    # initializer and generation config, so a fresh process can serve from
+    # the file alone; here a zip of jax.export payloads + raw tensors) ------
 
-    FORMAT_VERSION = 1
+    FORMAT_VERSION = 2
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, params: Any = None,
+             state_spec: Optional[dict] = None,
+             generation_config: Optional[dict] = None) -> None:
+        """Write the full serving bundle.
+
+        ``params``: pytree of arrays (nested dicts) packaged with the
+        programs. ``state_spec``: kwargs for
+        :func:`..inference.kv_cache.init_kv_cache` describing the KV state
+        buffers (reference ``StateInitializer``). ``generation_config``:
+        JSON-serializable dict (buckets, eos, sampling defaults).
+        """
+        import numpy as np
+
         with zipfile.ZipFile(path, "w") as z:
             manifest = []
             for i, ((key, bi), art) in enumerate(
@@ -206,28 +236,138 @@ class NxDModel:
                 z.writestr(name, art.exported.serialize())
                 manifest.append({"key": key, "bucket_index": bi,
                                  "file": name})
+            weights = []
+            if params is not None:
+                for j, (p, leaf) in enumerate(
+                        jax.tree_util.tree_leaves_with_path(params)):
+                    keypath = "/".join(_path_entry(e) for e in p)
+                    arr = np.asarray(leaf)
+                    fname = f"weight_{j}.bin"
+                    z.writestr(fname, arr.tobytes())
+                    weights.append({"path": keypath, "file": fname,
+                                    "dtype": str(arr.dtype),
+                                    "shape": list(arr.shape)})
             z.writestr("manifest.json", json.dumps(
                 {"version": self.FORMAT_VERSION,
                  "jax_version": jax.__version__,
-                 "artifacts": manifest}))
+                 "artifacts": manifest,
+                 "weights": weights,
+                 "state_spec": state_spec,
+                 "generation_config": generation_config}))
         logger.info("saved NxDModel to %s", path)
 
     @classmethod
     def load(cls, path: str) -> "NxDModel":
+        import numpy as np
+
         artifacts: Dict[Tuple[str, int], TraceArtifacts] = {}
         with zipfile.ZipFile(path) as z:
             manifest = json.loads(z.read("manifest.json"))
-            if manifest["version"] != cls.FORMAT_VERSION:
+            if manifest["version"] not in (1, cls.FORMAT_VERSION):
                 raise ValueError(
                     f"unsupported NxDModel format {manifest['version']}")
             for item in manifest["artifacts"]:
                 exported = jax_export.deserialize(z.read(item["file"]))
-                args = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
-                             for a in exported.in_avals)
+                leaves = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                          for a in exported.in_avals]
+                # rebuild the exported calling convention's arg pytree
+                args, _ = jax.tree_util.tree_unflatten(exported.in_tree,
+                                                       leaves)
                 artifacts[(item["key"], item["bucket_index"])] = (
-                    TraceArtifacts(key=item["key"], bucket=args,
+                    TraceArtifacts(key=item["key"], bucket=tuple(args),
                                    exported=exported))
-        return cls(artifacts)
+            params = None
+            if manifest.get("weights"):
+                flat = {}
+                for w in manifest["weights"]:
+                    arr = np.frombuffer(
+                        z.read(w["file"]),
+                        dtype=jnp.dtype(w["dtype"])).reshape(w["shape"])
+                    # commit to device once here, so every forward() reuses
+                    # resident buffers instead of re-transferring weights
+                    flat[w["path"]] = jnp.asarray(arr)
+                params = _unflatten_paths(flat)
+        model = cls(artifacts)
+        model.params = params
+        model.state_spec = manifest.get("state_spec")
+        model.generation_config = manifest.get("generation_config")
+        return model
+
+    def init_state(self):
+        """Fresh KV state buffers from the packaged spec (reference
+        ``StateInitializer``, ``base_nxd_model.py:11``)."""
+        if not getattr(self, "state_spec", None):
+            raise ValueError("bundle was saved without a state_spec")
+        from .kv_cache import init_kv_cache
+
+        spec = dict(self.state_spec)
+        spec["dtype"] = jnp.dtype(spec.get("dtype", "bfloat16"))
+        return init_kv_cache(**spec)
+
+
+def _path_entry(e) -> str:
+    if hasattr(e, "key"):
+        return str(e.key)
+    if hasattr(e, "idx"):
+        raise ValueError(
+            "bundled params must be nested dicts (got a sequence entry)")
+    return str(e)
+
+
+def _unflatten_paths(flat: Dict[str, Any]) -> dict:
+    out: dict = {}
+    for path, arr in flat.items():
+        node = out
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return out
+
+
+def bundle_generate(model: "NxDModel", input_ids, prompt_len,
+                    max_new_tokens: int):
+    """Greedy generation driven purely from a loaded bundle — programs,
+    weights, KV-state init and generation config all come from the zip
+    (the reference's serving flow: ``NxDModel.forward`` after ``load``,
+    ``nxd_model.py:460,591``).
+
+    Bundle protocol: key ``"context_encoding"`` has signature
+    ``(params, input_ids [B,S], positions [B,S], cache) -> (logits, cache)``
+    and ``"token_generation"`` the same at S=1.
+    """
+    from .generation import pick_bucket
+    from .kv_cache import PAD_POSITION
+
+    if model.params is None:
+        raise ValueError("bundle carries no weights; re-save with params=")
+    gc = model.generation_config or {}
+    input_ids = jnp.asarray(input_ids)
+    prompt_len = jnp.asarray(prompt_len)
+    b, s = input_ids.shape
+    bucket = pick_bucket(s, gc.get("buckets", (s,)))
+    if bucket > s:
+        input_ids = jnp.pad(input_ids, ((0, 0), (0, bucket - s)))
+    cache = model.init_state()
+
+    ar = jnp.broadcast_to(jnp.arange(bucket), (b, bucket))
+    positions = jnp.where(ar < prompt_len[:, None], ar, PAD_POSITION)
+    logits, cache = model.forward("context_encoding", model.params,
+                                  input_ids, positions, cache)
+    last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None],
+                               axis=1)[:, 0]
+    toks = []
+    for t in range(max_new_tokens):
+        tok = jnp.argmax(last, axis=-1)
+        toks.append(tok)
+        if t == max_new_tokens - 1:
+            break  # last emitted token needs no further forward
+        pos = (prompt_len + t)[:, None]
+        logits, cache = model.forward("token_generation", model.params,
+                                      tok[:, None].astype(jnp.int32), pos,
+                                      cache)
+        last = logits[:, 0]
+    return jnp.stack(toks, axis=1)
 
 
 def shard_checkpoint(params: Any, param_specs: Any) -> Any:

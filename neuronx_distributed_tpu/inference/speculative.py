@@ -17,6 +17,7 @@ from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def verify_draft_greedy(target_logits: jax.Array,
@@ -107,3 +108,128 @@ def medusa_accept_longest(tree_logits: jax.Array,
     best = jnp.argmax(depth, axis=-1)
     accept_len = jnp.take_along_axis(depth, best[:, None], axis=1)[:, 0]
     return best, accept_len
+
+
+# ---------------------------------------------------------------------------
+# End-to-end draft-model speculative generation (the reference's
+# "speculation" serving key, examples/inference/modules/model_base.py:155).
+#
+# TPU-native cache rollback: slots are masked, not rewound. The KV cache
+# masks attention by *stored position* (kv_cache.PAD_POSITION), so rejecting
+# a drafted suffix is one scatter setting those slots' positions to the pad
+# sentinel — no ragged per-batch cache copies, fully static shapes. Rejected
+# slots are simply wasted capacity (bounded by K per round).
+# ---------------------------------------------------------------------------
+
+def speculative_generate(cfg, params, draft_cfg, draft_params, input_ids,
+                         prompt_len, max_new_tokens: int,
+                         speculation_length: int = 4,
+                         buckets=(128, 512, 2048), kv_dtype=None):
+    """Greedy speculative decoding with a draft model.
+
+    Exactness property (the decisive test): greedy speculative output ==
+    the target model's own greedy decoding, for ANY draft model. Returns
+    ``(tokens [B, max_new_tokens], stats)`` with
+    ``stats['mean_accepted']`` = average accepted drafts per round.
+    """
+    from ..models.llama import llama_forward_with_cache
+    from .generation import _jit_prefill, pick_bucket
+    from .kv_cache import PAD_POSITION, init_kv_cache
+
+    input_ids = jnp.asarray(input_ids)
+    prompt_len = jnp.asarray(prompt_len)
+    b, s = input_ids.shape
+    k = speculation_length
+    bucket = pick_bucket(s, buckets)
+    if bucket > s:
+        input_ids = jnp.pad(input_ids, ((0, 0), (0, bucket - s)))
+
+    slack = max_new_tokens * (k + 1) + k + 1
+    tcache = init_kv_cache(cfg.num_layers, b, bucket + slack,
+                           cfg.num_kv_heads, cfg.head_dim_,
+                           dtype=kv_dtype or cfg.dtype)
+    dcache = init_kv_cache(draft_cfg.num_layers, b, bucket + slack,
+                           draft_cfg.num_kv_heads, draft_cfg.head_dim_,
+                           dtype=kv_dtype or draft_cfg.dtype)
+
+    tlogits, tcache = _jit_prefill(cfg)(params, input_ids, prompt_len,
+                                        tcache)
+    _, dcache = _jit_prefill(draft_cfg)(draft_params, input_ids, prompt_len,
+                                        dcache)
+
+    committed0 = jnp.argmax(tlogits, axis=-1)              # [B]
+    out0 = jnp.zeros((b, max_new_tokens + k + 1), jnp.int32)
+    out0 = out0.at[:, 0].set(committed0)
+
+    def mask_rejected(cache, start_index, num_slots, accepted):
+        """Mark slots start_index+j (j in [0, num_slots)) with j > accepted
+        as never-attended."""
+        jj = jnp.arange(num_slots)[None, :]                # [1, n]
+        window = lax.dynamic_slice_in_dim(cache.pos, start_index, num_slots,
+                                          axis=1)
+        window = jnp.where(jj <= accepted[:, None], window, PAD_POSITION)
+        return cache.replace(pos=lax.dynamic_update_slice_in_dim(
+            cache.pos, window, start_index, axis=1))
+
+    def run(carry, params, draft_params):
+        def round_body(carry):
+            (tcache, dcache, committed, pos, filled, out, acc_sum,
+             rounds) = carry
+
+            # 1. draft K tokens autoregressively
+            def draft_step(c, _):
+                dc, tok, p = c
+                logits, dc = llama_forward_with_cache(
+                    draft_cfg, draft_params, tok[:, None], p[:, None], dc)
+                nxt = jnp.argmax(logits[:, 0], axis=-1)
+                return (dc, nxt, p + 1), nxt
+
+            (dcache, _, _), drafted = lax.scan(
+                draft_step, (dcache, committed, pos), None, length=k)
+            drafted = jnp.swapaxes(drafted, 0, 1)          # [B, K]
+
+            # 2. one target forward over [committed, drafts]
+            block = jnp.concatenate([committed[:, None], drafted], axis=1)
+            positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+            t_index = tcache.index
+            logits, tcache = llama_forward_with_cache(cfg, params, block,
+                                                      positions, tcache)
+
+            # 3. accept/reject
+            accepted, greedy = verify_draft_greedy(logits, drafted)
+            jj = jnp.arange(k + 1)[None, :]
+            emit = jnp.where(jj < accepted[:, None],
+                             jnp.pad(drafted, ((0, 0), (0, 1))), greedy)
+
+            # 4. cache rollback by slot masking
+            tcache = mask_rejected(tcache, t_index, k + 1, accepted)
+            dcache = mask_rejected(dcache, dcache.index - k, k, accepted)
+
+            # 5. scatter emitted tokens at per-batch offsets (invalid or
+            # overflow entries land in the sacrificial last column)
+            valid = jj <= accepted[:, None]
+            dest = jnp.where(
+                valid & (filled[:, None] + jj < max_new_tokens),
+                filled[:, None] + jj, out.shape[1] - 1)
+            rows = jnp.broadcast_to(jnp.arange(b)[:, None], dest.shape)
+            out = out.at[rows, dest].set(emit)
+
+            new_committed = jnp.take_along_axis(greedy, accepted[:, None],
+                                                axis=1)[:, 0]
+            filled = jnp.minimum(filled + accepted + 1, max_new_tokens)
+            return (tcache, dcache, new_committed, pos + accepted + 1,
+                    filled, out, acc_sum + jnp.sum(accepted), rounds + 1)
+
+        def cond(carry):
+            return jnp.any(carry[4] < max_new_tokens)
+
+        return lax.while_loop(cond, round_body, carry)
+
+    carry = (tcache, dcache, committed0, prompt_len,
+             jnp.ones((b,), jnp.int32), out0, jnp.zeros((), jnp.int32),
+             jnp.zeros((), jnp.int32))
+    (_, _, _, _, _, out, acc_sum, rounds) = jax.jit(run)(
+        carry, params, draft_params)
+    stats = {"mean_accepted": acc_sum / jnp.maximum(rounds * b, 1),
+             "rounds": rounds}
+    return out[:, :max_new_tokens], stats

@@ -30,6 +30,9 @@ class MixtralConfig(LlamaConfig):
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 2.0
+    # "capacity" or "blockwise" (dropless; reference expert_mlps_v2.py:691)
+    moe_dispatch: str = "capacity"
+    moe_block_size: int = 512
     router_type: str = "top_k"
     shared_expert_intermediate: int = 0
     router_aux_coef: float = 0.02
@@ -82,6 +85,8 @@ class MixtralDecoderLayer(nn.Module):
             num_experts=cfg.num_experts, hidden_size=cfg.hidden_size,
             intermediate_size=cfg.intermediate_size, top_k=cfg.top_k,
             capacity_factor=cfg.capacity_factor,
+            dispatch_mode=cfg.moe_dispatch,
+            block_size=cfg.moe_block_size,
             router_type=cfg.router_type,
             shared_expert_intermediate=cfg.shared_expert_intermediate,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="moe")(h)

@@ -1,0 +1,327 @@
+"""Dropless (blockwise) MoE expert computation.
+
+Analogue of the reference's blockwise NKI path
+(``modules/moe/expert_mlps_v2.py:691`` ``forward_blockwise``,
+``modules/moe/blockwise.py:856`` kernel family): no token is ever dropped —
+tokens are sorted by expert and processed in fixed-size blocks by a
+block-sparse grouped matmul, so compute scales with the *actual* tokens per
+expert instead of a capacity bound.
+
+TPU-native design (the megablox/ragged-gmm pattern):
+
+* routing metadata is computed in XLA (sort by expert, per-expert counts,
+  block-aligned padding; all static shapes — the worst case is
+  ``T·K + E·B`` padded slots);
+* the grouped matmul is a Pallas kernel over a grid of token blocks whose
+  expert index arrives via scalar prefetch
+  (``pltpu.PrefetchScalarGridSpec``): the weight BlockSpec's index_map reads
+  ``block_expert[b]`` so each block streams exactly its expert's weights
+  from HBM — consecutive blocks of the same expert elide the re-fetch;
+* the backward is the same pattern transposed: dx is a grouped matmul with
+  the transposed weights, dW accumulates per-expert by *output revisiting*
+  (consecutive blocks of one expert map to the same output block, which
+  Mosaic keeps in VMEM and flushes once — no atomics needed);
+* the capacity-factor path (:mod:`.expert_mlps`) is the golden reference:
+  with capacity >= T·K both paths drop nothing and must agree exactly.
+
+The kernel operates on the *local* shard of the expert weights — under
+shard_map the ep/tp axes are bound and ``E_local``/``I_local`` arrive
+pre-sliced; under GSPMD (single-program) the global sizes are used.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def compute_block_metadata(idx: jax.Array, num_experts: int,
+                           block_size: int):
+    """Routing metadata for the blockwise path.
+
+    ``idx``: [T, K] expert assignment. Returns
+    ``(order, src, dest_slot, block_expert, num_blocks, padded)`` where
+
+    * ``order``: [T*K] flat (token·K + choice) pair index in
+      sorted-by-expert order (stable, so in-expert order is deterministic),
+    * ``src``: [T*K] token index of each sorted pair (``order // K``),
+    * ``dest_slot``: [T*K] slot of each sorted pair in the block-padded
+      layout,
+    * ``block_expert``: [num_blocks] expert id of each block,
+    * ``num_blocks`` / ``padded`` (static): worst case ``(T·K + E·B) / B``
+      blocks / slot count.
+    """
+    t, k = idx.shape
+    tk = t * k
+    flat = idx.reshape(tk)
+    order = jnp.argsort(flat, stable=True)            # [TK] sorted pairs
+    sorted_expert = flat[order]
+    src = order // k                                  # token of sorted pair
+    counts = jnp.bincount(flat, length=num_experts)   # [E]
+    # every expert gets >= 1 (possibly all-zero) block: the dW kernel
+    # zero-initializes an expert's grad slice on its first block, so an
+    # expert with no block would leave uninitialized HBM in its gradient
+    # (the worst-case `padded` already reserves E blocks of slack)
+    padded_counts = jnp.maximum(
+        ((counts + block_size - 1) // block_size) * block_size, block_size)
+    starts = jnp.cumsum(counts) - counts              # exclusive cumsum
+    padded_starts = jnp.cumsum(padded_counts) - padded_counts
+    pos_in_expert = jnp.arange(tk) - starts[sorted_expert]
+    dest_slot = padded_starts[sorted_expert] + pos_in_expert
+
+    padded = round_up(tk, block_size) + num_experts * block_size
+    num_blocks = padded // block_size
+    block_start = jnp.arange(num_blocks) * block_size
+    # expert owning each block; blocks beyond the last expert's padded
+    # region clamp to the last expert (they hold only zero slots)
+    ends = jnp.cumsum(padded_counts)
+    block_expert = jnp.searchsorted(ends, block_start, side="right")
+    block_expert = jnp.minimum(block_expert, num_experts - 1).astype(
+        jnp.int32)
+    return order, src, dest_slot, block_expert, num_blocks, padded
+
+
+def scatter_to_blocks(x: jax.Array, src: jax.Array, dest_slot: jax.Array,
+                      padded: int) -> jax.Array:
+    """Place sorted (token, choice) rows into the block-padded layout
+    ``[P, H]``; padding slots stay zero (their outputs are discarded)."""
+    h = x.shape[-1]
+    return jnp.zeros((padded, h), x.dtype).at[dest_slot].set(x[src])
+
+
+def combine_from_blocks(ys: jax.Array, gates: jax.Array, order: jax.Array,
+                        src: jax.Array, dest_slot: jax.Array,
+                        num_tokens: int) -> jax.Array:
+    """Invert the scatter and combine: ``y[t] = Σ_k gates[t,k] · expert_out``
+    (reference combine in ``forward_blockwise``)."""
+    rows = ys[dest_slot]                              # [TK, H] sorted pairs
+    pair_gate = gates.reshape(-1)[order]              # gate of sorted pair
+    return jnp.zeros((num_tokens, ys.shape[-1]), ys.dtype).at[src].add(
+        rows * pair_gate[:, None].astype(ys.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Pallas grouped GLU kernels. xs [P, H] is the block-padded sorted token
+# layout; each grid block b computes silu(x@Wg)·(x@Wu) @ Wd with the weights
+# of expert block_expert[b] (scalar-prefetched so the BlockSpec index_maps
+# can select the expert's weight tiles). The intermediate dim is tiled
+# (grid dim ib) so weight tiles fit VMEM at 7B/70B sizes.
+# ---------------------------------------------------------------------------
+
+def _silu(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def _dsilu(x):
+    s = jax.nn.sigmoid(x)
+    return s * (1 + x * (1 - s))
+
+
+def _glu_fwd_kernel(be_ref, x_ref, gu_ref, dn_ref, y_ref, *, num_ib: int):
+    from jax.experimental import pallas as pl
+
+    ib = pl.program_id(1)
+
+    @pl.when(ib == 0)
+    def _init():
+        y_ref[...] = jnp.zeros_like(y_ref)
+
+    x = x_ref[...].astype(jnp.float32)                # [B, H]
+    gu = gu_ref[0].astype(jnp.float32)                # [H, 2, bI]
+    g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    a = _silu(g) * u                                  # [B, bI]
+    y_ref[...] = y_ref[...] + jax.lax.dot_general(
+        a, dn_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(y_ref.dtype)
+
+
+def _glu_dx_kernel(be_ref, x_ref, gu_ref, dn_ref, dy_ref, dx_ref, *,
+                   num_ib: int):
+    from jax.experimental import pallas as pl
+
+    ib = pl.program_id(1)
+
+    @pl.when(ib == 0)
+    def _init():
+        dx_ref[...] = jnp.zeros_like(dx_ref)
+
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    gu = gu_ref[0].astype(jnp.float32)                # [H, 2, bI]
+    dn = dn_ref[0].astype(jnp.float32)                # [bI, H]
+    g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    da = jax.lax.dot_general(dy, dn, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [B, bI]
+    dg = da * u * _dsilu(g)
+    du = da * _silu(g)
+    dx = jax.lax.dot_general(dg, gu[:, 0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dx = dx + jax.lax.dot_general(du, gu[:, 1], (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    dx_ref[...] = dx_ref[...] + dx.astype(dx_ref.dtype)
+
+
+def _glu_dw_kernel(be_ref, x_ref, gu_ref, dn_ref, dy_ref, dgu_ref, ddn_ref,
+                   *, num_ib: int):
+    """Grid (ib, b): consecutive b of one expert revisit the same dW output
+    block, accumulating in VMEM; zero it on the expert's first block."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(1)
+    first_of_expert = jnp.logical_or(
+        b == 0, be_ref[jnp.maximum(b, 1) - 1] != be_ref[b])
+
+    @pl.when(first_of_expert)
+    def _init():
+        dgu_ref[...] = jnp.zeros_like(dgu_ref)
+        ddn_ref[...] = jnp.zeros_like(ddn_ref)
+
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    gu = gu_ref[0].astype(jnp.float32)
+    dn = dn_ref[0].astype(jnp.float32)
+    g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    a = _silu(g) * u
+    da = jax.lax.dot_general(dy, dn, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dg = da * u * _dsilu(g)
+    du = da * _silu(g)
+    # ddown[e, ib] += a^T @ dy ; dgu[e, :, 0/1, ib] += x^T @ dg/du
+    ddn_ref[0] = ddn_ref[0] + jax.lax.dot_general(
+        a, dy, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(ddn_ref.dtype)
+    dgw = jax.lax.dot_general(x, dg, (((0,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    duw = jax.lax.dot_general(x, du, (((0,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    dgu_ref[0] = dgu_ref[0] + jnp.stack([dgw, duw], axis=1).astype(
+        dgu_ref.dtype)
+
+
+def _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
+                        block_i, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p, h = xs.shape
+    e, _, _, i = gate_up.shape
+    nb = p // block_size
+    num_ib = i // block_i
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb, num_ib),
+        in_specs=[
+            pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
+            pl.BlockSpec((1, h, 2, block_i),
+                         lambda b, ib, be: (be[b], 0, 0, ib)),
+            pl.BlockSpec((1, block_i, h), lambda b, ib, be: (be[b], ib, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
+    )
+    return pl.pallas_call(
+        functools.partial(_glu_fwd_kernel, num_ib=num_ib),
+        out_shape=jax.ShapeDtypeStruct((p, h), xs.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_expert, xs, gate_up, down)
+
+
+def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,
+                            block_i, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p, h = xs.shape
+    e, _, _, i = gate_up.shape
+    nb = p // block_size
+    num_ib = i // block_i
+
+    dx = pl.pallas_call(
+        functools.partial(_glu_dx_kernel, num_ib=num_ib),
+        out_shape=jax.ShapeDtypeStruct((p, h), xs.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nb, num_ib),
+            in_specs=[
+                pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
+                pl.BlockSpec((1, h, 2, block_i),
+                             lambda b, ib, be: (be[b], 0, 0, ib)),
+                pl.BlockSpec((1, block_i, h),
+                             lambda b, ib, be: (be[b], ib, 0)),
+                pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
+            ],
+            out_specs=pl.BlockSpec((block_size, h),
+                                   lambda b, ib, be: (b, 0)),
+        ),
+        interpret=interpret,
+    )(block_expert, xs, gate_up, down, dy)
+
+    dgu, ddn = pl.pallas_call(
+        functools.partial(_glu_dw_kernel, num_ib=num_ib),
+        out_shape=[jax.ShapeDtypeStruct(gate_up.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(down.shape, jnp.float32)],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(num_ib, nb),
+            in_specs=[
+                pl.BlockSpec((block_size, h), lambda ib, b, be: (b, 0)),
+                pl.BlockSpec((1, h, 2, block_i),
+                             lambda ib, b, be: (be[b], 0, 0, ib)),
+                pl.BlockSpec((1, block_i, h),
+                             lambda ib, b, be: (be[b], ib, 0)),
+                pl.BlockSpec((block_size, h), lambda ib, b, be: (b, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, h, 2, block_i),
+                             lambda ib, b, be: (be[b], 0, 0, ib)),
+                pl.BlockSpec((1, block_i, h),
+                             lambda ib, b, be: (be[b], ib, 0)),
+            ],
+        ),
+        interpret=interpret,
+    )(block_expert, xs, gate_up, down, dy)
+    return dx, dgu.astype(gate_up.dtype), ddn.astype(down.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def grouped_glu(xs, gate_up, down, block_expert, block_size, block_i,
+                interpret):
+    """Block-sparse grouped GLU: ``ys[b] = silu(x_b@Wg_e)·(x_b@Wu_e) @ Wd_e``
+    with ``e = block_expert[b]`` (the dropless expert matmul)."""
+    return _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
+                               block_i, interpret)
+
+
+def _grouped_glu_fwd(xs, gate_up, down, block_expert, block_size, block_i,
+                     interpret):
+    ys = _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
+                             block_i, interpret)
+    return ys, (xs, gate_up, down, block_expert)
+
+
+def _grouped_glu_bwd(block_size, block_i, interpret, res, dy):
+    xs, gate_up, down, block_expert = res
+    dx, dgu, ddn = _grouped_glu_pallas_bwd(
+        xs, gate_up, down, block_expert, dy, block_size, block_i, interpret)
+    dbe = jnp.zeros(block_expert.shape, jax.dtypes.float0)
+    return dx, dgu, ddn, dbe
+
+
+grouped_glu.defvjp(_grouped_glu_fwd, _grouped_glu_bwd)

@@ -72,6 +72,11 @@ class ExpertMLPs(nn.Module):
     intermediate_size: int
     top_k: int = 2
     capacity_factor: float = 2.0
+    # "capacity" (mask-einsum, may drop) or "blockwise" (dropless Pallas
+    # grouped matmul, reference expert_mlps_v2.py:691)
+    dispatch_mode: str = "capacity"
+    block_size: int = 512   # tokens per block (blockwise)
+    block_i: int = 512      # intermediate-dim tile (blockwise)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     tp_axis: str = ps.TP_AXIS
@@ -96,6 +101,17 @@ class ExpertMLPs(nn.Module):
             nn.with_partitioning(pl.default_kernel_init,
                                  (self.ep_axis, self.tp_axis, None)),
             (e_local, i_local, self.hidden_size), self.param_dtype)
+
+        if self.dispatch_mode == "blockwise":
+            if ep is not None and ep > 1:
+                raise NotImplementedError(
+                    "blockwise dispatch under a bound ep axis is not yet "
+                    "supported; use dispatch_mode='capacity' with EP")
+            return self._forward_blockwise(x, gates, idx, gate_up, down,
+                                           i_local)
+        if self.dispatch_mode != "capacity":
+            raise ValueError(
+                f"unknown dispatch_mode {self.dispatch_mode!r}")
 
         capacity = compute_capacity(t, self.num_experts, self.top_k,
                                     self.capacity_factor)
@@ -128,4 +144,29 @@ class ExpertMLPs(nn.Module):
         y = jnp.einsum("tec,ech->th", combine.astype(self.dtype),
                        out)
         aux = {"dropped_fraction": dropped}
+        return y.astype(self.dtype), aux
+
+    def _forward_blockwise(self, x, gates, idx, gate_up, down, i_local):
+        """Dropless path: sort-by-expert + Pallas block-sparse grouped GLU
+        (:mod:`.blockwise`; reference ``forward_blockwise``,
+        ``expert_mlps_v2.py:691``). Zero drops by construction."""
+        from . import blockwise as bw
+
+        t = x.shape[0]
+        order, src, dest, be, _, padded = bw.compute_block_metadata(
+            idx, self.num_experts, self.block_size)
+        xin = mappings.copy_to_tensor_parallel_region(x, self.tp_axis)
+        xs = bw.scatter_to_blocks(xin.astype(self.dtype), src, dest, padded)
+        bi = min(self.block_i, i_local)
+        if i_local % bi != 0:
+            bi = i_local
+        interpret = jax.default_backend() == "cpu"
+        ys = bw.grouped_glu(xs, gate_up.astype(self.dtype),
+                            down.astype(self.dtype), be, self.block_size,
+                            bi, interpret)
+        y = bw.combine_from_blocks(ys, gates, order, src, dest, t)
+        # expert-fused row-parallel exit: partial sums over the tp shard of
+        # the intermediate dim
+        y = mappings.reduce_from_tensor_parallel_region(y, self.tp_axis)
+        aux = {"dropped_fraction": jnp.zeros((), jnp.float32)}
         return y.astype(self.dtype), aux

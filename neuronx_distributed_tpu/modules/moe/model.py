@@ -60,6 +60,8 @@ class MoE(nn.Module):
     intermediate_size: int
     top_k: int = 2
     capacity_factor: float = 2.0
+    dispatch_mode: str = "capacity"  # or "blockwise" (dropless)
+    block_size: int = 512
     router_type: str = "top_k"
     shared_expert_intermediate: int = 0
     dtype: Any = jnp.bfloat16
@@ -82,6 +84,7 @@ class MoE(nn.Module):
             num_experts=self.num_experts, hidden_size=h,
             intermediate_size=self.intermediate_size,
             top_k=gates.shape[-1], capacity_factor=self.capacity_factor,
+            dispatch_mode=self.dispatch_mode, block_size=self.block_size,
             dtype=self.dtype, param_dtype=self.param_dtype, name="experts")
         y, eaux = experts(flat, gates, idx)
         aux.update(eaux)

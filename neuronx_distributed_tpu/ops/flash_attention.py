@@ -150,6 +150,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
             -jnp.inf)
 
 
+def _causal_kv_index(causal, block_q, block_k):
+    """BlockSpec index_map for KV tiles in a (i, q_block, k_block) grid.
+
+    Causal truncation: skipped (above-diagonal) iterations clamp the KV
+    block index to the q-block's diagonal — Mosaic elides the DMA when
+    consecutive iterations map to the same block, so masked blocks cost
+    neither compute (``pl.when``) nor HBM traffic."""
+    if not causal:
+        return lambda i, j, kb: (i, kb, 0)
+    return lambda i, j, kb: (
+        i, jnp.minimum(kb, (j * block_q + block_q - 1) // block_k), 0)
+
+
 def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
                       interpret=False):
     from jax.experimental import pallas as pl
@@ -162,6 +175,8 @@ def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
     vt = jnp.swapaxes(v, 1, 2).reshape(b * n, sk, d)
     num_kb = sk // block_k
     grid = (b * n, sq // block_q, num_kb)
+    kv_index = _causal_kv_index(causal, block_q, block_k)
+
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_q=block_q,
                           block_k=block_k, num_kb=num_kb, causal=causal,
@@ -171,8 +186,8 @@ def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
         ],
         out_specs=[pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
                    pl.BlockSpec((1, block_q), lambda i, j, kb: (i, j))],
@@ -235,6 +250,180 @@ def _flash_bwd_from_lse(q, k, v, out, lse, g, causal, block_k, scale):
             jnp.swapaxes(dv, 1, 2).astype(v.dtype))
 
 
+# ---------------------------------------------------------------------------
+# Pallas flash backward (reference binds NKI flash_attn_bwd the same way,
+# kernels/flash_attn.py:18). Two kernels, the standard split:
+#   dq:    grid (b*n, q_blocks, k_blocks) — dq accumulates over KV blocks;
+#   dk/dv: grid (b*n, k_blocks, q_blocks) — dk/dv accumulate over Q blocks.
+# Both recompute p = exp(s - lse) from the saved log-sum-exp; delta =
+# sum(g * out) per row is precomputed in XLA (cheap elementwise reduce).
+# The XLA scan formulation above (_flash_bwd_from_lse) stays as the golden
+# fallback.
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, block_q: int, block_k: int,
+                         num_kb: int, causal: bool, scale: float):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when((not causal) or (kb * block_k <= qi * block_q + block_q - 1))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                          block_k: int, num_qb: int, causal: bool,
+                          scale: float):
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when((not causal) or (qi * block_q + block_q - 1 >= kb * block_k))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse[:, None]), 0.0)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k, scale,
+                      interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * n, sq, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * n, sk, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * n, sk, d)
+    gt = jnp.swapaxes(g, 1, 2).reshape(b * n, sq, d)
+    ot = jnp.swapaxes(out, 1, 2).reshape(b * n, sq, d)
+    lse_t = lse.reshape(b * n, sq)
+    delta = jnp.sum(gt.astype(jnp.float32) * ot.astype(jnp.float32), -1)
+    num_qb, num_kb = sq // block_q, sk // block_k
+
+    kv_index = _causal_kv_index(causal, block_q, block_k)
+    if causal:
+        # first q block at/below the diagonal for this KV block
+        def q_index(i, kb, j):
+            return (i, jnp.maximum(j, (kb * block_k) // block_q), 0)
+
+        def qrow_index(i, kb, j):
+            return (i, jnp.maximum(j, (kb * block_k) // block_q))
+    else:
+        def q_index(i, kb, j):
+            return (i, j, 0)
+
+        def qrow_index(i, kb, j):
+            return (i, j)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, num_kb=num_kb, causal=causal,
+                          scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b * n, sq, d), q.dtype),
+        grid=(b * n, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j, kb: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, j, kb: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse_t, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, num_qb=num_qb, causal=causal,
+                          scale=scale),
+        out_shape=[jax.ShapeDtypeStruct((b * n, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * n, sk, d), v.dtype)],
+        grid=(b * n, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q), qrow_index),
+            pl.BlockSpec((1, block_q), qrow_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(kt, vt, qt, gt, lse_t, delta)
+
+    return (jnp.swapaxes(dq.reshape(b, n, sq, d), 1, 2),
+            jnp.swapaxes(dk.reshape(b, n, sk, d), 1, 2),
+            jnp.swapaxes(dv.reshape(b, n, sk, d), 1, 2))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_pallas(q, k, v, causal, block_q, block_k, scale, interpret):
     out, _ = _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
@@ -251,7 +440,8 @@ def _flash_pallas_vjp_fwd(q, k, v, causal, block_q, block_k, scale,
 
 def _flash_pallas_vjp_bwd(causal, block_q, block_k, scale, interpret, res, g):
     q, k, v, out, lse = res
-    return _flash_bwd_from_lse(q, k, v, out, lse, g, causal, block_k, scale)
+    return _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
+                             scale, interpret)
 
 
 _flash_pallas.defvjp(_flash_pallas_vjp_fwd, _flash_pallas_vjp_bwd)

@@ -95,3 +95,30 @@ def test_pallas_kernel_grads():
     for a, r in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_bwd_kernels_match_xla_golden():
+    """The Pallas dq/dkv kernels (interpret mode) against the XLA scan
+    backward (_flash_bwd_from_lse), causal and not, incl. rectangular
+    sq != sk."""
+    from neuronx_distributed_tpu.ops.flash_attention import (
+        _flash_bwd_from_lse, _flash_pallas_bwd, _flash_pallas_fwd)
+
+    for (sq, sk, causal) in [(128, 128, True), (128, 128, False),
+                             (64, 128, False)]:
+        b, n, d = 2, 2, 128
+        ks = jax.random.split(jax.random.key(5), 4)
+        q = jax.random.normal(ks[0], (b, sq, n, d))
+        k = jax.random.normal(ks[1], (b, sk, n, d))
+        v = jax.random.normal(ks[2], (b, sk, n, d))
+        g = jax.random.normal(ks[3], (b, sq, n, d))
+        scale = 1.0 / np.sqrt(d)
+        out, lse = _flash_pallas_fwd(q, k, v, causal, 64, 64, scale,
+                                     interpret=True)
+        ref = _flash_bwd_from_lse(q, k, v, out, lse, g, causal, 64, scale)
+        got = _flash_pallas_bwd(q, k, v, out, lse, g, causal, 64, 64, scale,
+                                interpret=True)
+        for a, r, name in zip(got, ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(r), rtol=2e-5, atol=2e-5,
+                err_msg=f"d{name} sq={sq} sk={sk} causal={causal}")

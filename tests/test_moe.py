@@ -270,3 +270,146 @@ def test_token_shuffle_decorrelated_across_shards():
     assert perms.shape[0] > 1
     assert not all((perms[i] == perms[0]).all()
                    for i in range(1, perms.shape[0]))
+
+
+# ---------------------------------------------------------------------------
+# dropless (blockwise) dispatch
+# ---------------------------------------------------------------------------
+
+def _blockwise_pair(T=32, H=16, I=32, E=4, K=2, seed=0):
+    x = jax.random.normal(jax.random.key(seed), (T, H))
+    gates = jax.random.uniform(jax.random.key(seed + 1), (T, K))
+    idx = jax.random.randint(jax.random.key(seed + 2), (T, K), 0, E)
+    cap = ExpertMLPs(num_experts=E, hidden_size=H, intermediate_size=I,
+                     top_k=K, capacity_factor=float(T * K),
+                     dtype=jnp.float32)
+    blk = ExpertMLPs(num_experts=E, hidden_size=H, intermediate_size=I,
+                     top_k=K, dispatch_mode="blockwise", block_size=16,
+                     block_i=16, dtype=jnp.float32)
+    params = meta.unbox(cap.init(jax.random.key(seed + 3), x, gates, idx))
+    return cap, blk, params, x, gates, idx
+
+
+def test_blockwise_matches_capacity_at_infinite_capacity():
+    """Dropless parity gate: with capacity >= T*K the capacity path drops
+    nothing, so the Pallas blockwise path must agree exactly — fwd and all
+    grads (VERDICT r1 'Done =' criterion)."""
+    cap, blk, params, x, gates, idx = _blockwise_pair()
+    y_cap, _ = cap.apply(params, x, gates, idx)
+    y_blk, aux = blk.apply(params, x, gates, idx)
+    np.testing.assert_allclose(np.asarray(y_blk), np.asarray(y_cap),
+                               rtol=1e-5, atol=1e-6)
+    assert float(aux["dropped_fraction"]) == 0.0
+
+    def loss(m):
+        return lambda p, x: jnp.sum(m.apply(p, x, gates, idx)[0] ** 2)
+
+    gc = jax.grad(loss(cap), argnums=(0, 1))(params, x)
+    gb = jax.grad(loss(blk), argnums=(0, 1))(params, x)
+    for a, b in zip(jax.tree_util.tree_leaves(gc),
+                    jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_blockwise_zero_drop_on_skewed_routing():
+    """All tokens routed to one expert: capacity_factor=1 drops most of
+    them; blockwise drops none."""
+    T, H, I, E, K = 32, 16, 32, 4, 1
+    x = jax.random.normal(jax.random.key(9), (T, H))
+    gates = jnp.ones((T, K))
+    idx = jnp.zeros((T, K), jnp.int32)  # everyone -> expert 0
+    blk = ExpertMLPs(num_experts=E, hidden_size=H, intermediate_size=I,
+                     top_k=K, dispatch_mode="blockwise", block_size=16,
+                     block_i=16, dtype=jnp.float32)
+    nodrop = ExpertMLPs(num_experts=E, hidden_size=H, intermediate_size=I,
+                        top_k=K, capacity_factor=float(T * K),
+                        dtype=jnp.float32)
+    dropping = ExpertMLPs(num_experts=E, hidden_size=H, intermediate_size=I,
+                          top_k=K, capacity_factor=1.0, dtype=jnp.float32)
+    params = meta.unbox(blk.init(jax.random.key(10), x, gates, idx))
+    y_blk, aux = blk.apply(params, x, gates, idx)
+    y_ref, _ = nodrop.apply(params, x, gates, idx)
+    _, aux_drop = dropping.apply(params, x, gates, idx)
+    assert float(aux_drop["dropped_fraction"]) > 0.5  # capacity drops
+    assert float(aux["dropped_fraction"]) == 0.0      # blockwise doesn't
+    np.testing.assert_allclose(np.asarray(y_blk), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_blockwise_tp_parity():
+    """Blockwise under shard_map tp=2 (local I shard in the kernel, row-
+    parallel exit) matches the unsharded blockwise output."""
+    cap, blk, params, x, gates, idx = _blockwise_pair()
+    dense, _ = blk.apply(params, x, gates, idx)
+
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=2)
+    pspec = {"params": {"gate_up": P(None, None, None, "tp"),
+                        "down": P(None, "tp", None)}}
+    y, _ = jax.jit(ps.shard_map(
+        lambda p, x, g, i: blk.apply(p, x, g, i), mesh,
+        in_specs=(pspec, P(), P(), P()),
+        out_specs=(P(), P())))(params, x, gates, idx)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_blockwise_decode_small_blocks():
+    """Decode-shaped workload (few tokens): small blocks make the grouped
+    kernel compute only the routed (token, expert) pairs — the TPU-native
+    counterpart of the reference's selective expert loading + fused
+    token-gen kernel (expert_mlps_v2.py:595, moe_fused_tkg.py:85)."""
+    cap, blk, params, x, gates, idx = _blockwise_pair(T=8)
+    blk8 = ExpertMLPs(num_experts=4, hidden_size=16, intermediate_size=32,
+                      top_k=2, dispatch_mode="blockwise", block_size=8,
+                      block_i=16, dtype=jnp.float32)
+    y_ref, _ = cap.apply(params, x, gates, idx)
+    y, _ = blk8.apply(params, x, gates, idx)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_mixtral_blockwise_trains():
+    from neuronx_distributed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                        tiny_moe_config)
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model, initialize_parallel_optimizer,
+        make_train_step)
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=2)
+    mcfg = tiny_moe_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                           moe_dispatch="blockwise", moe_block_size=16)
+    model = MixtralForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (8, 33), 0, mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           batch["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 3e-3)
+    step = make_train_step(pm, tx, sh)
+    losses = []
+    for _ in range(10):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.9, losses
+    assert np.isfinite(losses).all()
+
+
+def test_blockwise_every_expert_owns_a_block():
+    """Regression (r2 review): an expert with zero routed tokens must still
+    own >= 1 block, else the dW kernel never zero-initializes its gradient
+    slice and leaves uninitialized memory on TPU."""
+    from neuronx_distributed_tpu.modules.moe.blockwise import (
+        compute_block_metadata)
+
+    idx = jnp.concatenate([jnp.zeros((8, 1), jnp.int32),
+                           jnp.full((8, 1), 2, jnp.int32)])  # expert 1 empty
+    _, _, _, block_expert, _, _ = compute_block_metadata(idx, 3, 8)
+    owners = set(np.asarray(block_expert).tolist())
+    assert {0, 1, 2} <= owners
+    # and grads for the empty expert are exactly zero
+    cap, blk, params, x, gates, _ = _blockwise_pair(T=16, E=3, K=1)
+    idx2 = jnp.where(jnp.arange(16)[:, None] < 8, 0, 2).astype(jnp.int32)
+    g = jax.grad(lambda p: jnp.sum(blk.apply(p, x, gates[:, :1], idx2)[0]
+                                   ** 2))(params)
+    np.testing.assert_array_equal(
+        np.asarray(g["params"]["gate_up"][1]), 0.0)
