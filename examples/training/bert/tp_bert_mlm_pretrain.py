@@ -1,0 +1,79 @@
+"""BERT masked-LM pretraining with TP (the reference's original demo
+workload, ``examples/training/bert``):
+
+    python examples/training/bert/tp_bert_mlm_pretrain.py \
+        --model tiny --tp 2 --steps 50
+
+Synthetic MLM batches: 15% of tokens masked; only masked positions carry
+labels (others -100, ignored by the vocab-parallel CE).
+"""
+
+import argparse
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.models import bert
+from neuronx_distributed_tpu.trainer import (initialize_parallel_model,
+                                             initialize_parallel_optimizer,
+                                             make_train_step)
+from neuronx_distributed_tpu.trainer.loop import (CheckpointCallback,
+                                                  MetricsLogger, Trainer)
+
+MASK_ID = 1
+
+MODELS = {
+    "tiny": bert.tiny_bert_config(),
+    "large": bert.BERT_LARGE,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny", choices=sorted(MODELS))
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--lr", type=float, default=1e-4)
+    ap.add_argument("--ckpt-dir", default=None)
+    args = ap.parse_args(argv)
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=args.tp,
+        optimizer_config=nxd.OptimizerConfig(zero_one_enabled=True),
+    )
+    mcfg = nxd.configure_model(cfg, MODELS[args.model])
+    mcfg = dataclasses.replace(mcfg, max_seq_len=args.seq)
+    model = bert.BertForPreTraining(mcfg)
+
+    rng = np.random.RandomState(0)
+
+    def batches():
+        while True:
+            ids = rng.randint(2, mcfg.vocab_size, (args.batch, args.seq))
+            mask = rng.rand(args.batch, args.seq) < 0.15
+            labels = np.where(mask, ids, -100)
+            masked = np.where(mask, MASK_ID, ids)
+            yield {"input_ids": jnp.asarray(masked),
+                   "labels": jnp.asarray(labels)}
+
+    data = batches()
+    sample = next(data)
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(0),
+                                           sample["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, args.lr)
+    step = make_train_step(pm, tx, sh)
+
+    callbacks = [MetricsLogger(every=10)]
+    if args.ckpt_dir:
+        callbacks.append(CheckpointCallback(args.ckpt_dir, every=100))
+    Trainer(step, state, callbacks=callbacks,
+            resume_path=args.ckpt_dir).fit(data, max_steps=args.steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""GPT-NeoX pretraining with TP + ZeRO-1.
+
+The analogue of the reference's gpt-neox launcher
+(``examples/training/gpt_neox``, 20B integration config):
+
+    python examples/training/gpt_neox/tp_neox_pretrain.py \
+        --model tiny --tp 2 --steps 50
+"""
+
+import argparse
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.models import gpt_neox
+from neuronx_distributed_tpu.trainer import (initialize_parallel_model,
+                                             initialize_parallel_optimizer,
+                                             make_train_step)
+from neuronx_distributed_tpu.trainer.loop import (CheckpointCallback,
+                                                  MetricsLogger, Trainer)
+
+MODELS = {
+    "tiny": gpt_neox.tiny_neox_config(),
+    "20b": gpt_neox.GPT_NEOX_20B,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny", choices=sorted(MODELS))
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--ckpt-dir", default=None)
+    args = ap.parse_args(argv)
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=args.tp,
+        optimizer_config=nxd.OptimizerConfig(zero_one_enabled=True),
+        activation_checkpoint_config=nxd.ActivationCheckpointConfig(
+            mode="full"),
+        sequence_parallel=args.tp > 1,
+    )
+    mcfg = nxd.configure_model(cfg, MODELS[args.model])
+    mcfg = dataclasses.replace(mcfg, max_seq_len=args.seq)
+    model = gpt_neox.GPTNeoXForCausalLM(mcfg)
+
+    rng = np.random.RandomState(0)
+
+    def batches():
+        while True:
+            ids = rng.randint(0, mcfg.vocab_size,
+                              (args.batch, args.seq + 1))
+            yield {"input_ids": jnp.asarray(ids[:, :-1]),
+                   "labels": jnp.asarray(ids[:, 1:])}
+
+    data = batches()
+    sample = next(data)
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(0),
+                                           sample["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, args.lr)
+    step = make_train_step(pm, tx, sh)
+
+    callbacks = [MetricsLogger(every=10)]
+    if args.ckpt_dir:
+        callbacks.append(CheckpointCallback(args.ckpt_dir, every=100))
+    Trainer(step, state, callbacks=callbacks,
+            resume_path=args.ckpt_dir).fit(data, max_steps=args.steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,9 @@ class BertConfig:
     max_seq_len: int = 512
     type_vocab_size: int = 2
     layernorm_eps: float = 1e-12
+    # HF BertForMaskedLM head: transform dense + gelu + LN, decoder tied to
+    # the word embeddings with a free bias (cls.predictions.*)
+    mlm_transform: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     scan_layers: bool = True
@@ -76,7 +79,7 @@ class BertLayer(nn.Module):
         h = pl.ColumnParallelLinear(
             features=cfg.intermediate_size, use_bias=True, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="up")(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)  # HF uses erf gelu
         h = pl.RowParallelLinear(
             features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="down")(h)
@@ -100,10 +103,10 @@ class BertForPreTraining(nn.Module):
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None):
         cfg = self.cfg
-        x = pl.ParallelEmbedding(
+        embed_mod = pl.ParallelEmbedding(
             num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed")(
-                input_ids)
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed")
+        x = embed_mod(input_ids)
         pos_table = self.param(
             "position_embedding",
             nn.with_partitioning(pl.default_embed_init, (None, None)),
@@ -133,6 +136,29 @@ class BertForPreTraining(nn.Module):
         else:
             for i in range(cfg.num_layers):
                 x = BertLayer(cfg, name=f"layer_{i}")(x)
+        if cfg.mlm_transform:
+            # HF cls.predictions head: transform dense + erf-gelu + LN,
+            # decoder tied to the word embeddings plus a free vocab bias
+            from flax.core import meta
+
+            from ..parallel import mesh as ps
+
+            h = pl.ColumnParallelLinear(
+                features=cfg.hidden_size, use_bias=True, gather_output=True,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="mlm_transform")(x)
+            h = nn.gelu(h, approximate=False)
+            h = LayerNorm(eps=cfg.layernorm_eps, dtype=cfg.dtype,
+                          name="mlm_norm")(h)
+            table = meta.unbox(embed_mod.variables["params"]["embedding"])
+            logits = pl.embedding_attend(table, h, dtype=cfg.dtype)
+            bias = self.param(
+                "mlm_bias",
+                nn.with_partitioning(nn.initializers.zeros_init(),
+                                     (ps.TP_AXIS,)),
+                (pl._maybe_local(cfg.vocab_size, ps.TP_AXIS),),
+                cfg.param_dtype)
+            return logits + bias.astype(cfg.dtype)
         logits = pl.ColumnParallelLinear(
             features=cfg.vocab_size, use_bias=False, gather_output=False,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,

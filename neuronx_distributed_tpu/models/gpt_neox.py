@@ -109,7 +109,7 @@ class NeoXMLP(nn.Module):
             features=cfg.intermediate_size, use_bias=True, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             sequence_parallel=cfg.sequence_parallel, name="up")(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)  # HF uses erf gelu
         return pl.RowParallelLinear(
             features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,

@@ -290,11 +290,38 @@ class _DecodeScanBody(nn.Module):
     @nn.compact
     def __call__(self, x, cache_kv, slot_pos, cos, sin, positions,
                  cache_index):
-        k_l, v_l = cache_kv
-        x, new_cache = LlamaDecoderLayer(self.cfg, name="layer")(
+        if len(cache_kv) == 4:
+            # quantized cache: dequant fuses into the attention read; only
+            # this step's freshly written slots are (re)quantized, so
+            # resident slots never accumulate requantization drift
+            from ..inference.kv_cache import dequantize_kv, quantize_kv
+
+            qk, qv, ks, vs = cache_kv
+            k_l = dequantize_kv(qk, ks, self.cfg.dtype)
+            v_l = dequantize_kv(qv, vs, self.cfg.dtype)
+        else:
+            k_l, v_l = cache_kv
+        x, (nk, nv) = LlamaDecoderLayer(self.cfg, name="layer")(
             x, cos, sin, positions, cache=(k_l, v_l, slot_pos),
             cache_index=cache_index)
-        return x, new_cache
+        if len(cache_kv) == 4:
+            s_step = x.shape[1]
+            nk_step = jax.lax.dynamic_slice_in_dim(nk, cache_index, s_step,
+                                                   axis=1)
+            nv_step = jax.lax.dynamic_slice_in_dim(nv, cache_index, s_step,
+                                                   axis=1)
+            qk_s, ks_s = quantize_kv(nk_step)
+            qv_s, vs_s = quantize_kv(nv_step)
+            return x, (
+                jax.lax.dynamic_update_slice_in_dim(qk, qk_s, cache_index,
+                                                    axis=1),
+                jax.lax.dynamic_update_slice_in_dim(qv, qv_s, cache_index,
+                                                    axis=1),
+                jax.lax.dynamic_update_slice_in_dim(ks, ks_s, cache_index,
+                                                    axis=1),
+                jax.lax.dynamic_update_slice_in_dim(vs, vs_s, cache_index,
+                                                    axis=1))
+        return x, (nk, nv)
 
 
 class LlamaModel(nn.Module):
@@ -400,10 +427,12 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
     serving path (``trace/model_builder.py:495`` keys).
 
     ``params``: LlamaForCausalLM variables (scan_layers=True layout).
-    ``kv_cache``: :class:`..inference.kv_cache.KVCache`. Writes this step's
-    K/V at ``kv_cache.index`` and returns ``(logits, new_cache)``.
+    ``kv_cache``: :class:`..inference.kv_cache.KVCache` or
+    :class:`..inference.kv_cache.QuantizedKVCache` (int8 cache; reference
+    kv_cache_quant, ``quantization_config.py:72``). Writes this step's K/V
+    at ``kv_cache.index`` and returns ``(logits, new_cache)``.
     """
-    from ..inference.kv_cache import KVCache
+    from ..inference.kv_cache import KVCache, QuantizedKVCache
 
     if not cfg.scan_layers:
         raise ValueError("cached decode requires scan_layers=True")
@@ -438,8 +467,12 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
         out_axes=0,
         length=cfg.num_layers,
     )(cfg)
-    x, (new_k, new_v) = scanned.apply(
-        {"params": p["model"]["layers"]}, x, (kv_cache.k, kv_cache.v),
+    quantized = isinstance(kv_cache, QuantizedKVCache)
+    cache_kv = ((kv_cache.k, kv_cache.v, kv_cache.k_scale,
+                 kv_cache.v_scale) if quantized
+                else (kv_cache.k, kv_cache.v))
+    x, new_kv = scanned.apply(
+        {"params": p["model"]["layers"]}, x, cache_kv,
         slot_pos, cos, sin, rope_pos, kv_cache.index)
 
     norm = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype)
@@ -454,6 +487,13 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             **_lora_kw(cfg, "lm_head"))
         logits = head.apply({"params": p["lm_head"]}, x)
-    new_cache = KVCache(k=new_k, v=new_v, pos=slot_pos,
-                        index=kv_cache.index + s)
+    if quantized:
+        new_k, new_v, nks, nvs = new_kv
+        new_cache = QuantizedKVCache(
+            k=new_k, v=new_v, k_scale=nks, v_scale=nvs, pos=slot_pos,
+            index=kv_cache.index + s)
+    else:
+        new_k, new_v = new_kv
+        new_cache = KVCache(k=new_k, v=new_v, pos=slot_pos,
+                            index=kv_cache.index + s)
     return logits, new_cache

@@ -190,9 +190,20 @@ def make_pipeline_grad_fn(cfg: LlamaConfig, num_microbatches: int,
 
 
 def _permute_layer_stack(variables: Any, perm) -> Any:
+    from jax.sharding import NamedSharding
+
+    def permute(x):
+        y = x[perm]
+        sh = getattr(x, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            # the gather unshards the scan dim; restore the original
+            # placement (pp-sharded layer stack)
+            y = jax.device_put(y, sh)
+        return y
+
     out = jax.tree_util.tree_map(lambda x: x, variables)  # shallow copy
     out["params"]["model"]["layers"] = jax.tree_util.tree_map(
-        lambda x: x[perm], variables["params"]["model"]["layers"])
+        permute, variables["params"]["model"]["layers"])
     return out
 
 

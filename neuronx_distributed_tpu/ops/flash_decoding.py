@@ -1,0 +1,77 @@
+"""Flash decoding: sequence-split KV attention for token generation.
+
+Analogue of the reference's KV-shared decode groups
+(``parallel_layers/parallel_state.py:1473-1531`` ``num_cores_per_group``;
+on-device combine ``trace/spmd.py:74`` ``combine_kv_on_device``): during
+decode the KV cache's *slot* dim is sharded over a core group, every core
+computes partial attention over its slice, and the partials merge with the
+numerically-stable log-sum-exp combine.
+
+TPU-native: the group is a mesh axis (normally ``tp`` — queries are small
+and replicated at decode); the merge is three collectives (pmax + 2 psum)
+inside shard_map. Inference-only (no VJP needed).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel import comm
+from ..parallel import mesh as ps
+
+
+def flash_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           slot_pos: jax.Array, q_pos: jax.Array,
+                           axis: str = ps.TP_AXIS,
+                           scale: Optional[float] = None) -> jax.Array:
+    """Attention of a small query block against a slot-sharded KV cache.
+
+    Args:
+      q: ``[B, S, N, D]`` queries (replicated over ``axis``).
+      k/v: ``[B, L_local, KV, D]`` this shard's cache slots (GQA: N % KV
+        == 0).
+      slot_pos: ``[B, L_local]`` stored token position per slot
+        (``PAD_POSITION`` for empty slots — never attended).
+      q_pos: ``[B, S]`` query token positions (causal: slot attended iff
+        ``slot_pos <= q_pos``).
+
+    Returns ``[B, S, N, D]``. When ``axis`` is unbound this is plain
+    masked attention over the full cache.
+    """
+    b, s, n, d = q.shape
+    kvh = k.shape[2]
+    g = n // kvh
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qf = (q.astype(jnp.float32) * scale).reshape(b, s, kvh, g, d)
+    scores = jnp.einsum("bskgd,blkd->bskgl", qf, k.astype(jnp.float32))
+    mask = (slot_pos[:, None, None, None, :]
+            <= q_pos[:, :, None, None, None])
+    scores = jnp.where(mask, scores, -jnp.inf)
+
+    m_local = jnp.max(scores, axis=-1)                  # [B,S,KV,G]
+    m_safe = jnp.where(jnp.isfinite(m_local), m_local, 0.0)
+    p = jnp.where(jnp.isfinite(scores),
+                  jnp.exp(scores - m_safe[..., None]), 0.0)
+    l_local = jnp.sum(p, axis=-1)
+    o_local = jnp.einsum("bskgl,blkd->bskgd", p, v.astype(jnp.float32))
+
+    if comm._axis_size(axis) not in (None, 1):
+        # log-sum-exp combine across the decode group (reference
+        # combine_kv_on_device, trace/spmd.py:74)
+        m = lax.pmax(m_local, axis)
+        m_gsafe = jnp.where(jnp.isfinite(m), m, 0.0)
+        corr = jnp.where(jnp.isfinite(m_local),
+                         jnp.exp(m_safe - m_gsafe), 0.0)
+        l = lax.psum(l_local * corr, axis)
+        o = lax.psum(o_local * corr[..., None], axis)
+    else:
+        l, o = l_local, o_local
+
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(b, s, n, d).astype(q.dtype)

@@ -21,6 +21,7 @@ reverse ring — same structure the pipeline engine relies on).
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
@@ -96,3 +97,167 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     m, l, acc = accumulate((m, l, acc), k_last, v_last, cp - 1)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas-fused ring attention: each ring step runs the hand-tiled flash
+# kernel (ops/flash_attention) on the visiting KV chunk; chunk partials
+# merge across steps with the stable log-sum-exp combine. The backward is a
+# second ring pass reusing the Pallas flash-backward kernels, with the
+# dk/dv accumulators travelling around the ring alongside their KV chunk
+# (one full circle returns them home). Reference ships this fusion as one
+# NKI kernel (kernels/ring_attention_kernel.py:118); the XLA formulation
+# above stays as the golden reference.
+#
+# Cross-rank causality is all-or-nothing per chunk: the diagonal chunk
+# (src == r) uses the causal kernel, chunks from earlier ranks the dense
+# kernel, later ranks contribute nothing — selected with lax.cond on the
+# rank-dependent predicate (no collectives inside, so divergence across cp
+# ranks is safe), which skips the masked chunks' compute entirely.
+# ---------------------------------------------------------------------------
+
+def _chunk_fwd(q, k_c, v_c, rel, block_q, block_k, scale, interpret):
+    """(out, lse) of q against one visiting chunk. rel = sign of
+    (r - src): 0 -> diagonal (causal), >0 -> fully attended, <0 -> skip."""
+    from .flash_attention import _flash_pallas_fwd
+
+    def diag(q, k_c, v_c):
+        return _flash_pallas_fwd(q, k_c, v_c, True, block_q, block_k,
+                                 scale, interpret)
+
+    def full(q, k_c, v_c):
+        return _flash_pallas_fwd(q, k_c, v_c, False, block_q, block_k,
+                                 scale, interpret)
+
+    def skip(q, k_c, v_c):
+        b, s, n, d = q.shape
+        return (jnp.zeros_like(q),
+                jnp.full((b, n, s), -jnp.inf, jnp.float32))
+
+    return lax.cond(rel == 0, diag,
+                    lambda q, k_c, v_c: lax.cond(rel > 0, full, skip,
+                                                 q, k_c, v_c),
+                    q, k_c, v_c)
+
+
+def _chunk_bwd(q, k_c, v_c, out, lse, g, rel, block_q, block_k, scale,
+               interpret):
+    from .flash_attention import _flash_pallas_bwd
+
+    def diag(args):
+        return _flash_pallas_bwd(*args, True, block_q, block_k, scale,
+                                 interpret)
+
+    def full(args):
+        return _flash_pallas_bwd(*args, False, block_q, block_k, scale,
+                                 interpret)
+
+    def skip(args):
+        q, k_c, v_c, _, _, _ = args
+        return jnp.zeros_like(q), jnp.zeros_like(k_c), jnp.zeros_like(v_c)
+
+    args = (q, k_c, v_c, out, lse, g)
+    return lax.cond(rel == 0, diag,
+                    lambda a: lax.cond(rel > 0, full, skip, a), args)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_pallas(q, k, v, axis, block_q, block_k, scale, interpret):
+    out, _ = _ring_pallas_fwd_pass(q, k, v, axis, block_q, block_k, scale,
+                                   interpret)
+    return out
+
+
+def _ring_pallas_fwd_pass(q, k, v, axis, block_q, block_k, scale,
+                          interpret):
+    cp = comm._axis_size(axis)
+    b, s_local, n, d = q.shape
+    r = lax.axis_index(axis)
+    ring_perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def step(carry, i):
+        o_run, lse_run, k_cur, v_cur = carry
+        src = (r - i) % cp
+        rel = r - src  # 0 diag; >0 earlier rank (attend); <0 later (skip)
+        o_i, lse_i = _chunk_fwd(q, k_cur, v_cur, rel, block_q, block_k,
+                                scale, interpret)
+        o_i = jnp.swapaxes(o_i, 1, 2).astype(jnp.float32)  # [B,N,S,D]
+        m = jnp.maximum(lse_run, lse_i)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        a = jnp.where(jnp.isfinite(lse_run), jnp.exp(lse_run - m_safe), 0.0)
+        bb = jnp.where(jnp.isfinite(lse_i), jnp.exp(lse_i - m_safe), 0.0)
+        denom = jnp.maximum(a + bb, 1e-30)
+        o_run = (o_run * (a / denom)[..., None]
+                 + o_i * (bb / denom)[..., None])
+        lse_run = m_safe + jnp.log(denom)
+        lse_run = jnp.where(a + bb > 0, lse_run, -jnp.inf)
+        k_next = comm.ppermute(k_cur, axis, ring_perm)
+        v_next = comm.ppermute(v_cur, axis, ring_perm)
+        return (o_run, lse_run, k_next, v_next), None
+
+    o0 = jnp.zeros((b, n, s_local, d), jnp.float32)
+    lse0 = jnp.full((b, n, s_local), -jnp.inf, jnp.float32)
+    (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(cp))
+    return jnp.swapaxes(o, 1, 2).astype(q.dtype), lse
+
+
+def _ring_pallas_vjp_fwd(q, k, v, axis, block_q, block_k, scale, interpret):
+    out, lse = _ring_pallas_fwd_pass(q, k, v, axis, block_q, block_k, scale,
+                                     interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_pallas_vjp_bwd(axis, block_q, block_k, scale, interpret, res, g):
+    q, k, v, out, lse = res
+    cp = comm._axis_size(axis)
+    r = lax.axis_index(axis)
+    ring_perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def step(carry, i):
+        dq_acc, k_cur, v_cur, dk_buf, dv_buf = carry
+        src = (r - i) % cp
+        rel = r - src
+        dq_i, dk_i, dv_i = _chunk_bwd(q, k_cur, v_cur, out, lse, g, rel,
+                                      block_q, block_k, scale, interpret)
+        dq_acc = dq_acc + dq_i.astype(jnp.float32)
+        dk_buf = dk_buf + dk_i.astype(jnp.float32)
+        dv_buf = dv_buf + dv_i.astype(jnp.float32)
+        # the accumulators travel with their chunk; after the full circle
+        # they are back at the chunk's home rank
+        k_cur = comm.ppermute(k_cur, axis, ring_perm)
+        v_cur = comm.ppermute(v_cur, axis, ring_perm)
+        dk_buf = comm.ppermute(dk_buf, axis, ring_perm)
+        dv_buf = comm.ppermute(dv_buf, axis, ring_perm)
+        return (dq_acc, k_cur, v_cur, dk_buf, dv_buf), None
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dkv0 = jnp.zeros(k.shape, jnp.float32)
+    (dq, _, _, dk, dv), _ = lax.scan(
+        step, (dq0, k, v, dkv0, jnp.zeros(v.shape, jnp.float32)),
+        jnp.arange(cp))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_pallas.defvjp(_ring_pallas_vjp_fwd, _ring_pallas_vjp_bwd)
+
+
+def ring_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
+                          axis: str = ps.CP_AXIS,
+                          block_q: int = 128, block_k: int = 128,
+                          scale: Optional[float] = None,
+                          interpret: Optional[bool] = None) -> jax.Array:
+    """Ring attention with the Pallas flash kernels fused into each ring
+    step. Same contract as :func:`ring_attention` (causal only — the
+    cross-chunk skip logic assumes causal). Falls back to
+    :func:`ring_attention` when cp is absent or shapes don't tile."""
+    cp = comm._axis_size(axis)
+    b, s_local, n, d = q.shape
+    bq, bk = min(block_q, s_local), min(block_k, s_local)
+    tiles = (s_local % bq == 0 and s_local % bk == 0 and d % 128 == 0
+             and bq % 8 == 0 and bk % 8 == 0)
+    if cp is None or cp == 1 or not tiles:
+        return ring_attention(q, k, v, axis=axis, causal=True, scale=scale)
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return _ring_pallas(q, k, v, axis, bq, bk, scale_, interpret)

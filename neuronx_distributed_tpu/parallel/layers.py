@@ -129,6 +129,88 @@ class ColumnParallelLinear(nn.Module):
         return y
 
 
+class OutputChannelParallelConv2d(nn.Module):
+    """Conv2d with output channels sharded over tp.
+
+    Reference: ``parallel_layers/layers.py:1309`` (``Conv2dColumnParallel``
+    pair for vision backbones). NHWC/HWIO layout — the TPU-native conv
+    layout XLA tiles onto the MXU."""
+
+    features: int  # global output channels
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    use_bias: bool = True
+    gather_output: bool = False
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Initializer = default_kernel_init
+    axis: str = ps.TP_AXIS
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        out_local = _maybe_local(self.features, self.axis)
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel",
+            _partitioned(self.kernel_init, (None, None, None, self.axis)),
+            (kh, kw, x.shape[-1], out_local), self.param_dtype)
+        x = mappings.copy_to_tensor_parallel_region(x, self.axis)
+        y = jax.lax.conv_general_dilated(
+            x.astype(self.dtype), kernel.astype(self.dtype),
+            window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            bias = self.param("bias",
+                              _partitioned(nn.initializers.zeros_init(),
+                                           (self.axis,)),
+                              (out_local,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        if self.gather_output:
+            y = mappings.gather_from_tensor_parallel_region(y, self.axis,
+                                                            -1)
+        return y
+
+
+class InputChannelParallelConv2d(nn.Module):
+    """Conv2d with input channels sharded over tp (the row-parallel dual,
+    reference ``parallel_layers/layers.py:1432``): partial sums over the
+    input-channel shard exit with an all-reduce."""
+
+    features: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    use_bias: bool = True
+    input_is_parallel: bool = True
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Initializer = default_kernel_init
+    axis: str = ps.TP_AXIS
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if not self.input_is_parallel:
+            x = mappings.scatter_to_tensor_parallel_region(x, self.axis, -1)
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel",
+            _partitioned(self.kernel_init, (None, None, self.axis, None)),
+            (kh, kw, x.shape[-1], self.features), self.param_dtype)
+        y = jax.lax.conv_general_dilated(
+            x.astype(self.dtype), kernel.astype(self.dtype),
+            window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = mappings.reduce_from_tensor_parallel_region(y, self.axis)
+        if self.use_bias:
+            bias = self.param("bias",
+                              _partitioned(nn.initializers.zeros_init(),
+                                           (None,)),
+                              (self.features,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
 def embedding_attend(table: jax.Array, x: jax.Array, *,
                      sequence_parallel: bool = False,
                      dtype: Dtype = jnp.bfloat16,

@@ -129,6 +129,223 @@ def convert_nxd_to_hf_llama(params: Dict, cfg) -> Dict[str, np.ndarray]:
     return out
 
 
+def _stack(sd: Dict[str, Any], fmt: str, num_layers: int,
+           transform=_t) -> np.ndarray:
+    """Stack per-layer HF tensors onto the leading scan dim (the analogue of
+    the reference ``CheckpointConverterBase`` layer loops,
+    ``scripts/checkpoint_converter.py:171-266``)."""
+    return np.stack([transform(sd[fmt.format(i)])
+                     for i in range(num_layers)])
+
+
+def _asnp(w) -> np.ndarray:
+    return np.asarray(w)
+
+
+def convert_hf_mixtral_to_nxd(state_dict: Dict[str, Any], cfg) -> Dict:
+    """HF Mixtral state dict → our param tree (``MixtralForCausalLM``,
+    ``scan_layers=True``). Expert stacking: HF's per-expert ``w1``
+    (gate) / ``w3`` (up) fuse into ``gate_up [L, E, H, 2, I]``; ``w2``
+    (down) stacks to ``[L, E, I, H]`` (reference Mixtral conversion)."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L, E = cfg.num_layers, cfg.num_experts
+
+    def expert_gate_up(i):
+        pre = f"model.layers.{i}.block_sparse_moe.experts"
+        return np.stack([
+            np.stack([_t(sd[f"{pre}.{e}.w1.weight"]),
+                      _t(sd[f"{pre}.{e}.w3.weight"])], axis=1)
+            for e in range(E)])  # [E, H, 2, I]
+
+    def expert_down(i):
+        pre = f"model.layers.{i}.block_sparse_moe.experts"
+        return np.stack([_t(sd[f"{pre}.{e}.w2.weight"])
+                         for e in range(E)])  # [E, I, H]
+
+    layers = {
+        "attn": {
+            "qkv": {
+                "q_kernel": _stack(
+                    sd, "model.layers.{}.self_attn.q_proj.weight", L),
+                "k_kernel": _stack(
+                    sd, "model.layers.{}.self_attn.k_proj.weight", L),
+                "v_kernel": _stack(
+                    sd, "model.layers.{}.self_attn.v_proj.weight", L),
+            },
+            "o_proj": {"kernel": _stack(
+                sd, "model.layers.{}.self_attn.o_proj.weight", L)},
+        },
+        "moe": {
+            "router": {"kernel": _stack(
+                sd, "model.layers.{}.block_sparse_moe.gate.weight", L)},
+            "experts": {
+                "gate_up": np.stack([expert_gate_up(i) for i in range(L)]),
+                "down": np.stack([expert_down(i) for i in range(L)]),
+            },
+        },
+        "input_norm": {"scale": _stack(
+            sd, "model.layers.{}.input_layernorm.weight", L, _asnp)},
+        "post_norm": {"scale": _stack(
+            sd, "model.layers.{}.post_attention_layernorm.weight", L,
+            _asnp)},
+    }
+    tree = {"params": {
+        "model": {
+            "embed": {"embedding": sd["model.embed_tokens.weight"]},
+            "layers": {"layer": layers},
+            "norm": {"scale": sd["model.norm.weight"]},
+        },
+    }}
+    if not getattr(cfg, "tie_embeddings", False):
+        lm_head = (sd["lm_head.weight"] if "lm_head.weight" in sd
+                   else sd["model.embed_tokens.weight"])
+        tree["params"]["lm_head"] = {"kernel": _t(lm_head)}
+    return tree
+
+
+def convert_hf_neox_to_nxd(state_dict: Dict[str, Any], cfg) -> Dict:
+    """HF GPT-NeoX state dict → our param tree (``GPTNeoXForCausalLM``).
+
+    The HF fused ``query_key_value`` is laid out head-major
+    ``[heads, 3, head_dim]`` on the output dim — the split/fuse the
+    reference's converter handles with its qkv helpers
+    (``checkpoint_converter.py:513``)."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L, n, hd = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    h = cfg.hidden_size
+
+    def qkv_w(i, j):
+        w = sd[f"gpt_neox.layers.{i}.attention.query_key_value.weight"]
+        return _t(w.reshape(n, 3, hd, h)[:, j].reshape(n * hd, h))
+
+    def qkv_b(i, j):
+        b = sd[f"gpt_neox.layers.{i}.attention.query_key_value.bias"]
+        return b.reshape(n, 3, hd)[:, j].reshape(n * hd)
+
+    layers = {
+        "attn": {
+            "qkv": {
+                "q_kernel": np.stack([qkv_w(i, 0) for i in range(L)]),
+                "k_kernel": np.stack([qkv_w(i, 1) for i in range(L)]),
+                "v_kernel": np.stack([qkv_w(i, 2) for i in range(L)]),
+                "q_bias": np.stack([qkv_b(i, 0) for i in range(L)]),
+                "k_bias": np.stack([qkv_b(i, 1) for i in range(L)]),
+                "v_bias": np.stack([qkv_b(i, 2) for i in range(L)]),
+            },
+            "o_proj": {
+                "kernel": _stack(
+                    sd, "gpt_neox.layers.{}.attention.dense.weight", L),
+                "bias": _stack(
+                    sd, "gpt_neox.layers.{}.attention.dense.bias", L,
+                    _asnp),
+            },
+        },
+        "mlp": {
+            "up": {
+                "kernel": _stack(
+                    sd, "gpt_neox.layers.{}.mlp.dense_h_to_4h.weight", L),
+                "bias": _stack(
+                    sd, "gpt_neox.layers.{}.mlp.dense_h_to_4h.bias", L,
+                    _asnp),
+            },
+            "down": {
+                "kernel": _stack(
+                    sd, "gpt_neox.layers.{}.mlp.dense_4h_to_h.weight", L),
+                "bias": _stack(
+                    sd, "gpt_neox.layers.{}.mlp.dense_4h_to_h.bias", L,
+                    _asnp),
+            },
+        },
+        "ln1": {
+            "scale": _stack(
+                sd, "gpt_neox.layers.{}.input_layernorm.weight", L, _asnp),
+            "bias": _stack(
+                sd, "gpt_neox.layers.{}.input_layernorm.bias", L, _asnp),
+        },
+        "ln2": {
+            "scale": _stack(
+                sd, "gpt_neox.layers.{}.post_attention_layernorm.weight",
+                L, _asnp),
+            "bias": _stack(
+                sd, "gpt_neox.layers.{}.post_attention_layernorm.bias", L,
+                _asnp),
+        },
+    }
+    return {"params": {
+        "embed": {"embedding": sd["gpt_neox.embed_in.weight"]},
+        "layers": {"layer": layers},
+        "final_norm": {"scale": sd["gpt_neox.final_layer_norm.weight"],
+                       "bias": sd["gpt_neox.final_layer_norm.bias"]},
+        "lm_head": {"kernel": _t(sd["embed_out.weight"])},
+    }}
+
+
+def convert_hf_bert_to_nxd(state_dict: Dict[str, Any], cfg) -> Dict:
+    """HF BertForMaskedLM state dict → our param tree
+    (``BertForPreTraining`` with ``mlm_transform=True``)."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L = cfg.num_layers
+    pre = "bert.encoder.layer.{}."
+
+    def attn(part, what):
+        return _stack(sd, pre + f"attention.self.{part}.{what}", L,
+                      _t if what == "weight" else _asnp)
+
+    layers = {
+        "qkv": {
+            "q_kernel": attn("query", "weight"),
+            "k_kernel": attn("key", "weight"),
+            "v_kernel": attn("value", "weight"),
+            "q_bias": attn("query", "bias"),
+            "k_bias": attn("key", "bias"),
+            "v_bias": attn("value", "bias"),
+        },
+        "o_proj": {
+            "kernel": _stack(sd, pre + "attention.output.dense.weight", L),
+            "bias": _stack(sd, pre + "attention.output.dense.bias", L,
+                           _asnp),
+        },
+        "ln_attn": {
+            "scale": _stack(sd, pre + "attention.output.LayerNorm.weight",
+                            L, _asnp),
+            "bias": _stack(sd, pre + "attention.output.LayerNorm.bias", L,
+                           _asnp),
+        },
+        "up": {
+            "kernel": _stack(sd, pre + "intermediate.dense.weight", L),
+            "bias": _stack(sd, pre + "intermediate.dense.bias", L, _asnp),
+        },
+        "down": {
+            "kernel": _stack(sd, pre + "output.dense.weight", L),
+            "bias": _stack(sd, pre + "output.dense.bias", L, _asnp),
+        },
+        "ln_mlp": {
+            "scale": _stack(sd, pre + "output.LayerNorm.weight", L, _asnp),
+            "bias": _stack(sd, pre + "output.LayerNorm.bias", L, _asnp),
+        },
+    }
+    return {"params": {
+        "embed": {
+            "embedding": sd["bert.embeddings.word_embeddings.weight"]},
+        "position_embedding":
+            sd["bert.embeddings.position_embeddings.weight"],
+        "type_embedding":
+            sd["bert.embeddings.token_type_embeddings.weight"],
+        "embed_norm": {"scale": sd["bert.embeddings.LayerNorm.weight"],
+                       "bias": sd["bert.embeddings.LayerNorm.bias"]},
+        "layers": {"layer": layers},
+        "mlm_transform": {
+            "kernel": _t(sd["cls.predictions.transform.dense.weight"]),
+            "bias": sd["cls.predictions.transform.dense.bias"],
+        },
+        "mlm_norm": {
+            "scale": sd["cls.predictions.transform.LayerNorm.weight"],
+            "bias": sd["cls.predictions.transform.LayerNorm.bias"],
+        },
+        "mlm_bias": sd["cls.predictions.bias"],
+    }}
+
+
 def main(argv=None) -> None:
     """CLI (reference: the ``CheckpointConverterBase`` argparse driver)."""
     import argparse

@@ -1,0 +1,88 @@
+"""YAML → framework config conversion.
+
+Analogue of the reference's ``scripts/yaml_converter.py:19`` (training
+launchers driven by YAML config files). A YAML document maps one-to-one
+onto :func:`..config.neuronx_distributed_config`:
+
+.. code-block:: yaml
+
+    tensor_parallel_size: 8
+    pipeline_parallel_size: 2
+    sequence_parallel: true
+    optimizer:
+      zero_one_enabled: true
+      max_grad_norm: 1.0
+    pipeline:
+      num_microbatches: 8
+      schedule: 1f1b
+    activation_checkpoint:
+      mode: full
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict
+
+from .. import config as _cfg
+
+_SECTIONS = {
+    "optimizer": ("optimizer_config", _cfg.OptimizerConfig),
+    "mixed_precision": ("mixed_precision_config",
+                        _cfg.MixedPrecisionConfig),
+    "activation_checkpoint": ("activation_checkpoint_config",
+                              _cfg.ActivationCheckpointConfig),
+    "pipeline": ("pipeline_config", _cfg.PipelineConfig),
+    "checkpoint": ("checkpoint_config", _cfg.CheckpointConfig),
+}
+
+
+def dict_to_config_kwargs(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Validate + convert a parsed YAML dict into
+    ``neuronx_distributed_config`` kwargs (unknown keys raise — the
+    reference's converter is strict the same way)."""
+    kwargs: Dict[str, Any] = {}
+    for key, value in doc.items():
+        if key in _SECTIONS:
+            name, cls = _SECTIONS[key]
+            fields = {f.name for f in dataclasses.fields(cls)}
+            unknown = set(value) - fields
+            if unknown:
+                raise ValueError(
+                    f"unknown {key} option(s) {sorted(unknown)}; "
+                    f"valid: {sorted(fields)}")
+            kwargs[name] = cls(**value)
+        elif key in ("tensor_parallel_size", "pipeline_parallel_size",
+                     "context_parallel_size", "expert_parallel_size",
+                     "sequence_parallel", "seed"):
+            kwargs[key] = value
+        else:
+            raise ValueError(f"unknown config key {key!r}")
+    return kwargs
+
+
+def load_yaml_config(path: str, init_mesh: bool = False):
+    """Parse a YAML file into an :class:`..config.NxDConfig`."""
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    return _cfg.neuronx_distributed_config(init_mesh=init_mesh,
+                                           **dict_to_config_kwargs(doc))
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Validate a YAML training config and print the "
+                    "resolved framework config")
+    ap.add_argument("yaml_path")
+    args = ap.parse_args(argv)
+    cfg = load_yaml_config(args.yaml_path)
+    print(json.dumps(dataclasses.asdict(cfg), indent=2, default=str))
+
+
+if __name__ == "__main__":
+    main()

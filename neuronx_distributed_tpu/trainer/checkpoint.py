@@ -39,22 +39,43 @@ STATE_DIR = "state"
 USER_CONTENT_FILE = "user_content.json"
 
 
+class CheckpointSaveError(RuntimeError):
+    """An async checkpoint commit failed (raised at the next
+    save/finalize/wait, never swallowed — reference propagates at
+    ``wait_save``, ``checkpoint.py:198``)."""
+
+
 class CheckpointIOState:
     """Tracks in-flight async saves (reference ``CheckpointIOState:110``)."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._pending: List[Tuple[str, threading.Thread]] = []
+        self._errors: List[Tuple[str, BaseException]] = []
 
     def add(self, tag: str, thread: threading.Thread) -> None:
         with self._lock:
             self._pending.append((tag, thread))
+
+    def record_error(self, tag: str, exc: BaseException) -> None:
+        with self._lock:
+            self._errors.append((tag, exc))
+
+    def raise_pending_errors(self) -> None:
+        with self._lock:
+            errors, self._errors = self._errors, []
+        if errors:
+            tags = ", ".join(t for t, _ in errors)
+            raise CheckpointSaveError(
+                f"async checkpoint commit failed for tag(s) {tags}: "
+                f"{errors[0][1]!r}") from errors[0][1]
 
     def wait_all(self) -> None:
         with self._lock:
             pending, self._pending = self._pending, []
         for _, t in pending:
             t.join()
+        self.raise_pending_errors()
 
     def wait_tag(self, tag: str) -> None:
         """Join in-flight saves of one tag (overwrite must not race the
@@ -135,6 +156,9 @@ def save_checkpoint(
     """
     tag = str(tag)
     path = _normalize_path(path)
+    # surface any earlier async-commit failure instead of training on
+    # believing those checkpoints exist
+    _IO_STATE.raise_pending_errors()
     storage = create_checkpoint_storage(path)
     tdir = _tag_dir(path, tag)
     storage.create_dir(tdir)
@@ -171,8 +195,15 @@ def save_checkpoint(
             _apply_retention(storage, path, num_kept)
         logger.info("checkpoint %s committed", tdir)
 
+    def commit_async():
+        try:
+            commit()
+        except BaseException as e:  # re-raised at next save/finalize
+            logger.exception("async commit of checkpoint %s failed", tdir)
+            _IO_STATE.record_error(tag, e)
+
     if async_save:
-        t = threading.Thread(target=commit, daemon=False,
+        t = threading.Thread(target=commit_async, daemon=False,
                              name=f"ckpt-commit-{tag}")
         t.start()
         _IO_STATE.add(tag, t)

@@ -13,11 +13,53 @@ behind one abstraction so the engine never touches ``os.path`` directly.
 
 from __future__ import annotations
 
+import functools
 import json
+import logging
 import os
+import random
 import shutil
+import time
 from abc import ABC, abstractmethod
 from typing import Any, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def retry_with_backoff(max_attempts: int = 5, base_delay: float = 0.5,
+                       max_delay: float = 8.0):
+    """Retry transient storage errors with exponential backoff and
+    *decrementing* jitter (reference ``checkpoint_storage.py:236-286``:
+    tenacity retry tuned for S3 503 slow-down — early attempts spread out
+    randomly, later attempts converge to the full deterministic delay).
+    """
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            last = None
+            for attempt in range(max_attempts):
+                try:
+                    return fn(*args, **kwargs)
+                except FileNotFoundError:
+                    raise  # deterministic, not transient
+                except Exception as e:  # transient object-store errors
+                    last = e
+                    if attempt == max_attempts - 1:
+                        break
+                    delay = min(base_delay * 2 ** attempt, max_delay)
+                    # decrementing jitter: subtract up to (remaining
+                    # fraction) of the delay, so retries decorrelate early
+                    # and back off fully late
+                    frac = 1.0 - attempt / max(max_attempts - 1, 1)
+                    delay -= random.uniform(0, delay * 0.5 * frac)
+                    logger.warning(
+                        "storage op %s failed (%r), retry %d/%d in %.2fs",
+                        fn.__name__, e, attempt + 1, max_attempts - 1,
+                        delay)
+                    time.sleep(delay)
+            raise last
+        return wrapper
+    return deco
 
 
 class BaseCheckpointStorage(ABC):
@@ -120,24 +162,35 @@ class ObjectStoreCheckpointStorage(BaseCheckpointStorage):
                 f"object-store checkpoint dir {dirname!r} requires fsspec "
                 f"with the matching driver: {e}") from e
 
+    @retry_with_backoff()
     def dir_exists(self, dirname: str) -> bool:
         return self._fs.isdir(dirname)
 
+    @retry_with_backoff()
     def file_exists(self, filename: str) -> bool:
         return self._fs.isfile(filename)
 
+    @retry_with_backoff()
     def create_dir(self, dirname: str) -> None:
         self._fs.makedirs(dirname, exist_ok=True)
 
+    @retry_with_backoff()
     def list_dirs(self, dirname: str) -> List[str]:
         if not self._fs.isdir(dirname):
             return []
         return [os.path.basename(p.rstrip("/")) for p in self._fs.ls(dirname)
                 if self._fs.isdir(p)]
 
+    @retry_with_backoff()
     def remove_dir(self, dirname: str) -> None:
-        self._fs.rm(dirname, recursive=True)
+        # a retry after a partially-completed delete legitimately finds
+        # nothing left — that is success, not an error
+        try:
+            self._fs.rm(dirname, recursive=True)
+        except FileNotFoundError:
+            pass
 
+    @retry_with_backoff()
     def remove_file(self, filename: str) -> None:
         # try/except rather than isfile-then-rm: fsspec dircaches can
         # report a stale False and silently skip the delete
@@ -146,10 +199,12 @@ class ObjectStoreCheckpointStorage(BaseCheckpointStorage):
         except FileNotFoundError:
             pass
 
+    @retry_with_backoff()
     def save_text(self, text: str, filename: str) -> None:
         with self._fs.open(filename, "w") as f:
             f.write(text)
 
+    @retry_with_backoff()
     def load_text(self, filename: str) -> str:
         with self._fs.open(filename, "r") as f:
             return f.read()

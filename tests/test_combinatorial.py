@@ -64,3 +64,134 @@ def test_config_matrix_one_step(tp, pp, sp, zero1, remat):
     step = make_train_step(pm, tx, sh, grad_fn=grad_fn)
     state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"])), (tp, pp, sp, zero1, remat)
+
+
+# ---------------------------------------------------------------------------
+# cp / ep columns (r2: the reference's matrix style exists to catch
+# cross-dimension interactions — cp x zero1, ep x cp, 1f1b x sp, ...)
+# ---------------------------------------------------------------------------
+
+def _cp_grad_fn(model, pm):
+    """shard_map grad fn slicing the batch over dp x cp (the ring-attention
+    training path, cf. __graft_entry__ phase 2)."""
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_tpu.parallel import grads as grads_mod
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.pipeline import spmd_engine as eng
+
+    def grad_fn(params, batch):
+        def inner(p, i, lb):
+            def local_loss(p):
+                return eng.data_parallel_mean(
+                    model.apply(p, i, lb, method="loss"))
+
+            loss, g = jax.value_and_grad(local_loss)(p)
+            return loss, grads_mod.allreduce_gradients(g,
+                                                       specs=pm.param_specs)
+
+        return ps.shard_map(
+            inner, ps.get_mesh(),
+            in_specs=(pm.param_specs, P("dp", "cp"), P("dp", "cp")),
+            out_specs=(P(), pm.param_specs))(
+                params, batch["input_ids"], batch["labels"])
+
+    return grad_fn
+
+
+CP_MATRIX = [
+    # (tp, cp, zero1, remat)
+    (1, 2, True, False),   # cp x zero1 (opt state over dp x cp)
+    (2, 2, True, True),
+    (1, 4, False, False),
+    (2, 4, False, False),
+]
+
+
+@pytest.mark.parametrize("tp,cp,zero1,remat", CP_MATRIX)
+def test_cp_matrix_one_step(tp, cp, zero1, remat):
+    from jax.sharding import PartitionSpec as P
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=tp, context_parallel_size=cp,
+        optimizer_config=nxd.OptimizerConfig(zero_one_enabled=zero1),
+        activation_checkpoint_config=nxd.ActivationCheckpointConfig(
+            mode="full" if remat else "none"))
+    mcfg = nxd.configure_model(cfg, tiny_config(
+        dtype=jnp.float32, param_dtype=jnp.float32, num_layers=2))
+    model = LlamaForCausalLM(mcfg)
+    dp = 8 // (tp * cp)
+    ids = jax.random.randint(jax.random.key(0), (max(2, 2 * dp), 33), 0,
+                             mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           batch["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 1e-3)
+    step = make_train_step(pm, tx, sh, grad_fn=_cp_grad_fn(model, pm),
+                           batch_spec=P("dp", "cp"))
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"])), (tp, cp, zero1, remat)
+
+
+EP_MATRIX = [
+    # (tp, ep, zero1, dispatch)
+    (2, 2, False, "capacity"),
+    (1, 2, True, "capacity"),   # ep x zero1
+    (1, 4, False, "capacity"),
+    (2, 2, False, "blockwise"),  # ep(GSPMD) x dropless
+]
+
+
+@pytest.mark.parametrize("tp,ep,zero1,dispatch", EP_MATRIX)
+def test_ep_matrix_one_step(tp, ep, zero1, dispatch):
+    from neuronx_distributed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                        tiny_moe_config)
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=tp, expert_parallel_size=ep,
+        optimizer_config=nxd.OptimizerConfig(zero_one_enabled=zero1))
+    mcfg = nxd.configure_model(cfg, tiny_moe_config(
+        dtype=jnp.float32, param_dtype=jnp.float32,
+        moe_dispatch=dispatch, moe_block_size=16))
+    model = MixtralForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (8, 33), 0,
+                             mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           batch["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 1e-3)
+    step = make_train_step(pm, tx, sh)
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"])), (tp, ep, zero1, dispatch)
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "interleaved"])
+def test_pp_schedule_matrix(schedule):
+    """1F1B / interleaved x sp x zero1 x remat one-step smoke."""
+    from neuronx_distributed_tpu.models.llama_pipeline import (
+        interleave_pipeline_params)
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=2, pipeline_parallel_size=2,
+        optimizer_config=nxd.OptimizerConfig(zero_one_enabled=True),
+        activation_checkpoint_config=nxd.ActivationCheckpointConfig(
+            mode="full"),
+        sequence_parallel=True)
+    mcfg = nxd.configure_model(cfg, tiny_config(
+        dtype=jnp.float32, param_dtype=jnp.float32, num_layers=4))
+    model = LlamaForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (8, 33), 0, mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(
+        cfg, model, jax.random.key(1), batch["input_ids"],
+        logical_axis_rules=lpp.PIPELINE_LOGICAL_RULES)
+    chunks = 2 if schedule == "interleaved" else 1
+    if schedule == "interleaved":
+        params = interleave_pipeline_params(params, mcfg, 2, 2)
+    grad_fn = lpp.make_pipeline_grad_fn(
+        mcfg, num_microbatches=4, param_specs=pm.param_specs,
+        schedule=schedule, num_chunks=chunks)
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 1e-3)
+    step = make_train_step(pm, tx, sh, grad_fn=grad_fn)
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"])), schedule

@@ -1,0 +1,30 @@
+import pytest
+
+
+def test_yaml_converter(tmp_path):
+    from neuronx_distributed_tpu.scripts.yaml_converter import (
+        dict_to_config_kwargs, load_yaml_config)
+
+    doc = {
+        "tensor_parallel_size": 4,
+        "sequence_parallel": True,
+        "optimizer": {"zero_one_enabled": True, "max_grad_norm": 0.5},
+        "pipeline": {"num_microbatches": 8, "schedule": "1f1b"},
+        "activation_checkpoint": {"mode": "full"},
+    }
+    import yaml
+
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml.safe_dump(doc))
+    cfg = load_yaml_config(str(p))
+    assert cfg.parallel.tensor_parallel_size == 4
+    assert cfg.optimizer.zero_one_enabled
+    assert cfg.optimizer.max_grad_norm == 0.5
+    assert cfg.pipeline.schedule == "1f1b"
+    assert cfg.activation_checkpoint.mode == "full"
+    assert cfg.sequence_parallel
+
+    with pytest.raises(ValueError, match="unknown config key"):
+        dict_to_config_kwargs({"nope": 1})
+    with pytest.raises(ValueError, match="unknown optimizer option"):
+        dict_to_config_kwargs({"optimizer": {"typo": True}})

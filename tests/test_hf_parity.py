@@ -58,3 +58,112 @@ def test_hf_roundtrip(hf_model_and_cfg):
           if "rotary" not in k}
     for k, v in sd.items():
         np.testing.assert_allclose(back[k], v, rtol=1e-6, err_msg=k)
+
+
+def test_hf_neox_logits_parity():
+    """GPT-NeoX HF logits parity (fused head-major qkv split, partial
+    rotary, parallel residual)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from neuronx_distributed_tpu.models.gpt_neox import (GPTNeoXConfig,
+                                                         GPTNeoXForCausalLM)
+    from neuronx_distributed_tpu.scripts.checkpoint_converter import (
+        convert_hf_neox_to_nxd)
+
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25, rotary_emb_base=10000,
+        use_parallel_residual=True, layer_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(1)
+    hf = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+    cfg = GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=128, num_layers=2,
+        num_heads=4, max_seq_len=64, rotary_pct=0.25,
+        layernorm_eps=1e-5, dtype=jnp.float32, param_dtype=jnp.float32)
+
+    ps.initialize_model_parallel()
+    params = jax.tree_util.tree_map(
+        jnp.asarray, convert_hf_neox_to_nxd(
+            {k: v.numpy() for k, v in hf.state_dict().items()}, cfg))
+    ids = np.random.RandomState(2).randint(0, 128, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(GPTNeoXForCausalLM(cfg).apply(params,
+                                                    jnp.asarray(ids)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_mixtral_logits_parity():
+    """Mixtral HF logits parity (expert stacking w1/w3 -> gate_up, router
+    renorm semantics); dropless dispatch so no token is capacity-dropped."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from neuronx_distributed_tpu.models.mixtral import (MixtralConfig,
+                                                        MixtralForCausalLM)
+    from neuronx_distributed_tpu.scripts.checkpoint_converter import (
+        convert_hf_mixtral_to_nxd)
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False)
+    torch.manual_seed(3)
+    hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+    cfg = MixtralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=64, rms_eps=1e-5,
+        num_experts=4, top_k=2, moe_dispatch="blockwise", moe_block_size=8,
+        dtype=jnp.float32, param_dtype=jnp.float32)
+
+    ps.initialize_model_parallel()
+    params = jax.tree_util.tree_map(
+        jnp.asarray, convert_hf_mixtral_to_nxd(
+            {k: v.numpy() for k, v in hf.state_dict().items()}, cfg))
+    ids = np.random.RandomState(4).randint(0, 128, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours, _ = MixtralForCausalLM(cfg).apply(params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
+def test_hf_bert_logits_parity():
+    """BERT MLM HF logits parity (full cls.predictions head: transform +
+    LN + tied decoder + bias)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from neuronx_distributed_tpu.models.bert import (BertConfig,
+                                                     BertForPreTraining)
+    from neuronx_distributed_tpu.scripts.checkpoint_converter import (
+        convert_hf_bert_to_nxd)
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, type_vocab_size=2,
+        layer_norm_eps=1e-12, hidden_act="gelu")
+    torch.manual_seed(5)
+    hf = transformers.BertForMaskedLM(hf_cfg).eval()
+    cfg = BertConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, max_seq_len=64, mlm_transform=True,
+        dtype=jnp.float32, param_dtype=jnp.float32)
+
+    ps.initialize_model_parallel()
+    params = jax.tree_util.tree_map(
+        jnp.asarray, convert_hf_bert_to_nxd(
+            {k: v.numpy() for k, v in hf.state_dict().items()}, cfg))
+    ids = np.random.RandomState(6).randint(0, 128, (2, 12))
+    types = np.zeros((2, 12), np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids),
+                 token_type_ids=torch.tensor(types)).logits.numpy()
+    ours = np.asarray(BertForPreTraining(cfg).apply(
+        params, jnp.asarray(ids), jnp.asarray(types)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)

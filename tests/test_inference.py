@@ -317,3 +317,48 @@ print("TOKENS", np.asarray(toks).tolist())
     line = [ln for ln in r.stdout.splitlines() if ln.startswith("TOKENS")][0]
     got = np.array(eval(line[len("TOKENS "):]))
     np.testing.assert_array_equal(got, np.asarray(ref))
+
+
+def test_flash_decoding_kv_split_matches_dense():
+    """Flash decoding (reference num_cores_per_group + combine_kv_on_device,
+    parallel_state.py:1473, spmd.py:74): the KV cache's slot dim sharded
+    over tp with log-sum-exp partial combine == full-cache attention,
+    incl. GQA and pad-sentinel slots."""
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_tpu.inference.kv_cache import PAD_POSITION
+    from neuronx_distributed_tpu.ops.flash_decoding import (
+        flash_decode_attention)
+
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    b, s, n, kvh, d, L = 2, 2, 8, 4, 16, 32
+    ks = jax.random.split(jax.random.key(21), 3)
+    q = jax.random.normal(ks[0], (b, s, n, d))
+    k = jax.random.normal(ks[1], (b, L, kvh, d))
+    v = jax.random.normal(ks[2], (b, L, kvh, d))
+    # 20 filled slots in scrambled order, rest empty (pad sentinel)
+    perm = jax.random.permutation(jax.random.key(22), L)
+    slot_pos = jnp.where(perm < 20, perm, PAD_POSITION)[None].repeat(b, 0)
+    q_pos = jnp.asarray([[20, 21], [15, 16]])
+
+    dense = flash_decode_attention(q, k, v, slot_pos, q_pos)
+
+    split = jax.jit(ps.shard_map(
+        lambda q, k, v, sp, qp: flash_decode_attention(q, k, v, sp, qp),
+        mesh,
+        in_specs=(P(), P(None, "tp"), P(None, "tp"), P(None, "tp"), P()),
+        out_specs=P()))(q, k, v, slot_pos, q_pos)
+    np.testing.assert_allclose(np.asarray(split), np.asarray(dense),
+                               rtol=2e-5, atol=2e-5)
+
+    # reference check vs explicit softmax
+    scores = jnp.einsum(
+        "bsngd,blnd->bsngl",
+        q.reshape(b, s, kvh, 2, d) / np.sqrt(d).astype(np.float32),
+        k)
+    mask = slot_pos[:, None, None, None, :] <= q_pos[:, :, None, None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    ref = jnp.einsum("bsngl,blnd->bsngd",
+                     jax.nn.softmax(scores, axis=-1), v).reshape(b, s, n, d)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -260,3 +260,34 @@ def test_gspmd_path_column_row():
     dense = mlp.apply(jax.tree.map(np.asarray, params), x)
     np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_parallel_conv2d_pair_matches_dense():
+    """Output-channel x input-channel parallel conv pair (reference
+    layers.py:1309,1432) == a dense two-conv stack on a tp=4 mesh."""
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    x = jax.random.normal(jax.random.key(50), (2, 8, 8, 3))
+
+    col = L.OutputChannelParallelConv2d(features=16, dtype=jnp.float32,
+                                         param_dtype=jnp.float32)
+    row = L.InputChannelParallelConv2d(features=8, dtype=jnp.float32,
+                                        param_dtype=jnp.float32)
+
+    def net(cp, rp, x):
+        h = col.apply(cp, x)
+        return row.apply(rp, jax.nn.relu(h))
+
+    cparams = meta.unbox(col.init(jax.random.key(51), x))
+    h = col.apply(cparams, x)
+    rparams = meta.unbox(row.init(jax.random.key(52), jax.nn.relu(h)))
+    dense = net(cparams, rparams, x)
+
+    cspec = {"params": {"kernel": P(None, None, None, "tp"),
+                        "bias": P("tp")}}
+    rspec = {"params": {"kernel": P(None, None, "tp", None),
+                        "bias": P()}}
+    got = jax.jit(ps.shard_map(
+        net, mesh, in_specs=(cspec, rspec, P()), out_specs=P()))(
+            cparams, rparams, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(dense),
+                               rtol=2e-5, atol=2e-5)

@@ -88,3 +88,89 @@ def test_convert_param_tree():
     assert "kernel" not in qtree["layer"]
     assert qtree["layer"]["kernel_q"].dtype == jnp.int8
     np.testing.assert_array_equal(np.asarray(qtree["layer"]["bias"]), 0)
+
+
+def test_quantized_expert_mlps_close_to_float():
+    """Expert-fused quantized layers (reference quantization_layers.py:1013,
+    1215): int8 w8a16 expert bank tracks the fp bank within quant error,
+    and shards over tp like the float version."""
+    from neuronx_distributed_tpu.modules.moe.expert_mlps import ExpertMLPs
+    from neuronx_distributed_tpu.quantization.quantization_layers import (
+        QuantizedExpertMLPs, quantize_expert_params)
+
+    T, H, I, E, K = 16, 16, 32, 4, 2
+    x = jax.random.normal(jax.random.key(30), (T, H))
+    gates = jax.random.uniform(jax.random.key(31), (T, K))
+    idx = jax.random.randint(jax.random.key(32), (T, K), 0, E)
+    fp = ExpertMLPs(num_experts=E, hidden_size=H, intermediate_size=I,
+                    top_k=K, capacity_factor=float(T * K),
+                    dtype=jnp.float32)
+    fp_params = meta.unbox(fp.init(jax.random.key(33), x, gates, idx))
+    ref, _ = fp.apply(fp_params, x, gates, idx)
+
+    qm = QuantizedExpertMLPs(num_experts=E, hidden_size=H,
+                             intermediate_size=I, top_k=K,
+                             capacity_factor=float(T * K),
+                             dtype=jnp.float32)
+    qparams = {"params": quantize_expert_params(fp_params["params"])}
+    got, _ = qm.apply(qparams, x, gates, idx)
+    err = np.abs(np.asarray(got) - np.asarray(ref)).max()
+    assert err < 0.06, err  # int8 per-channel quantization error budget
+    assert float(jnp.mean(jnp.abs(ref))) > 0.01  # non-degenerate signal
+
+    # tp=2 shard_map parity with the unsharded quantized output
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=2)
+    pspec = {"params": {
+        "gate_up_q": P(None, None, None, "tp"),
+        "gate_up_scale": P(None, None, "tp"),
+        "down_q": P(None, "tp", None),
+        "down_scale": P(None, None)}}
+    y, _ = jax.jit(ps.shard_map(
+        lambda p, x, g, i: qm.apply(p, x, g, i), mesh,
+        in_specs=(pspec, P(), P(), P()), out_specs=(P(), P())))(
+            qparams, x, gates, idx)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(got),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_quantized_kv_cache_decode():
+    """int8 KV cache decode (reference kv_cache_quant,
+    quantization_config.py:72): logits track the fp cache within quant
+    error; resident slots don't drift across steps."""
+    from neuronx_distributed_tpu.inference.kv_cache import (
+        dequantize_kv, init_quantized_kv_cache, quantize_kv)
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaForCausalLM, llama_forward_with_cache, tiny_config)
+
+    # roundtrip: quantize-dequantize-quantize is a fixed point
+    x = jax.random.normal(jax.random.key(40), (2, 3, 4, 8))
+    q, s = quantize_kv(x)
+    x2 = dequantize_kv(q, s, jnp.float32)
+    q2, s2 = quantize_kv(x2)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q2))
+
+    ps.initialize_model_parallel()
+    cfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                      num_layers=2)
+    model = LlamaForCausalLM(cfg)
+    ids = jax.random.randint(jax.random.key(41), (1, 8), 0, cfg.vocab_size)
+    params = meta.unbox(model.init(jax.random.key(42), ids))
+
+    from neuronx_distributed_tpu.inference.kv_cache import init_kv_cache
+
+    fpc = init_kv_cache(cfg.num_layers, 1, 16, cfg.num_kv_heads,
+                        cfg.head_dim_, dtype=jnp.float32)
+    qc = init_quantized_kv_cache(cfg.num_layers, 1, 16, cfg.num_kv_heads,
+                                 cfg.head_dim_)
+    pos = jnp.arange(8)[None]
+    ref, fpc = llama_forward_with_cache(cfg, params, ids, pos, fpc)
+    got, qc = llama_forward_with_cache(cfg, params, ids, pos, qc)
+    assert np.abs(np.asarray(got) - np.asarray(ref)).max() < 0.15
+
+    # several decode steps: stays close, no drift blowup
+    for t in range(8, 12):
+        tok = jnp.argmax(ref[:, -1:], axis=-1)
+        p = jnp.full((1, 1), t, jnp.int32)
+        ref, fpc = llama_forward_with_cache(cfg, params, tok, p, fpc)
+        got, qc = llama_forward_with_cache(cfg, params, tok, p, qc)
+        assert np.abs(np.asarray(got) - np.asarray(ref)).max() < 0.2, t
