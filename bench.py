@@ -93,15 +93,24 @@ def main():
         tp = 8
         batch, seq = 4, 2048
         mcfg = llama.LlamaConfig(
-            **{**mcfg.__dict__, "max_seq_len": seq, "remat": True})
+            **{**mcfg.__dict__, "max_seq_len": seq, "remat": True,
+               "use_flash_attention": True})
     else:
-        # single-chip slice: ~350M params, bf16 compute
+        # single-chip slice: ~350M params, bf16 compute; head_dim 128 so
+        # the Pallas flash kernel path tiles (d % 128 == 0)
         mcfg = llama.LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_layers=16, num_heads=16, num_kv_heads=16, max_seq_len=2048,
-            remat=True)
+            num_layers=16, num_heads=8, num_kv_heads=8, max_seq_len=2048,
+            remat=True, use_flash_attention=True)
         tp = 1
         batch, seq = 8, 2048
+    if platform != "cpu":
+        # confirm the hand-tiled kernel path is eligible for these shapes
+        # (the dispatcher requires 128-aligned blocks and d % 128 == 0)
+        hd = mcfg.head_dim_
+        print(f"bench: flash_attention={mcfg.use_flash_attention} "
+              f"head_dim={hd} pallas_eligible={hd % 128 == 0}",
+              file=sys.stderr)
 
     cfg = nxd.neuronx_distributed_config(
         tensor_parallel_size=tp,

@@ -64,12 +64,18 @@ class MixtralDecoderLayer(nn.Module):
     cfg: MixtralConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions=None):
+    def __call__(self, x, cos, sin, positions=None, cache=None,
+                 cache_index=None):
         cfg = self.cfg
         h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                     sequence_parallel=cfg.sequence_parallel,
                     name="input_norm")(x)
-        x = x + LlamaAttention(cfg, name="attn")(h, cos, sin, positions)
+        attn_out = LlamaAttention(cfg, name="attn")(
+            h, cos, sin, positions, cache=cache, cache_index=cache_index)
+        new_cache = None
+        if cache is not None:
+            attn_out, new_cache = attn_out
+        x = x + attn_out
         h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                     sequence_parallel=cfg.sequence_parallel,
                     name="post_norm")(x)
@@ -97,6 +103,8 @@ class MixtralDecoderLayer(nn.Module):
                 moe_out, seq_dim=1)
         x = x + moe_out
         aux_vec = jnp.stack([aux["load_balance_loss"], aux["z_loss"]])
+        if cache is not None:
+            return x, aux_vec, new_cache
         return x, aux_vec
 
 
@@ -108,6 +116,23 @@ class _MoEScanBody(nn.Module):
         x, aux = MixtralDecoderLayer(self.cfg, name="layer")(
             x, cos, sin, positions)
         return x, aux
+
+
+class _MoEDecodeScanBody(nn.Module):
+    """Cached-decode scan body (the MoE analogue of llama's
+    ``_DecodeScanBody``; reference mixtral serving uses the same base
+    model_builder keys)."""
+
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, cache_kv, slot_pos, cos, sin, positions,
+                 cache_index):
+        k_l, v_l = cache_kv
+        x, _, new_cache = MixtralDecoderLayer(self.cfg, name="layer")(
+            x, cos, sin, positions, cache=(k_l, v_l, slot_pos),
+            cache_index=cache_index)
+        return x, new_cache
 
 
 class MixtralModel(nn.Module):
@@ -166,6 +191,10 @@ class MixtralForCausalLM(nn.Module):
     @nn.compact
     def __call__(self, input_ids, positions=None):
         cfg = self.cfg
+        if cfg.tie_embeddings:
+            raise ValueError(
+                "tie_embeddings is not supported for Mixtral (HF Mixtral "
+                "never ties); use an explicit lm_head")
         x, aux = MixtralModel(cfg, name="model")(input_ids, positions)
         logits = pl.ColumnParallelLinear(
             features=cfg.vocab_size, use_bias=False, gather_output=False,
@@ -179,3 +208,60 @@ class MixtralForCausalLM(nn.Module):
         ce = lf.causal_lm_loss(logits, labels, ignore_index=ignore_index)
         return (ce + cfg.router_aux_coef * aux[0]
                 + cfg.router_z_coef * aux[1])
+
+
+def mixtral_forward_with_cache(cfg: MixtralConfig, params,
+                               input_ids: jax.Array,
+                               positions: jax.Array, kv_cache):
+    """KV-cached forward for MoE serving ("context_encoding" /
+    "token_generation" keys) — the mixtral analogue of
+    :func:`.llama.llama_forward_with_cache` (the reference serves mixtral
+    through the same base model_builder keys,
+    ``examples/inference/modules/model_base.py``).
+
+    At decode the tiny token count makes the dropless blockwise dispatch
+    with a small block size the natural expert path
+    (``cfg.moe_dispatch='blockwise'``).
+    """
+    from ..inference.kv_cache import KVCache
+
+    if not cfg.scan_layers:
+        raise ValueError("cached decode requires scan_layers=True")
+    p = params["params"]
+    b, s = input_ids.shape
+    positions = jnp.asarray(positions, jnp.int32)
+
+    embed = pl.ParallelEmbedding(
+        num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+    x = embed.apply({"params": p["model"]["embed"]}, input_ids)
+    cos, sin = attn_mod.precompute_rope(
+        cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta,
+        use_scaled=cfg.rope_scaling)
+
+    slot_pos = jax.lax.dynamic_update_slice_in_dim(
+        kv_cache.pos, positions, kv_cache.index, axis=1)
+    rope_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
+
+    scanned = nn.scan(
+        _MoEDecodeScanBody,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+        in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast,
+                 nn.broadcast),
+        out_axes=0,
+        length=cfg.num_layers,
+    )(cfg)
+    x, (new_k, new_v) = scanned.apply(
+        {"params": p["model"]["layers"]}, x, (kv_cache.k, kv_cache.v),
+        slot_pos, cos, sin, rope_pos, kv_cache.index)
+
+    x = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype).apply(
+        {"params": p["model"]["norm"]}, x)
+    head = pl.ColumnParallelLinear(
+        features=cfg.vocab_size, use_bias=False, gather_output=True,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+    logits = head.apply({"params": p["lm_head"]}, x)
+    new_cache = KVCache(k=new_k, v=new_v, pos=slot_pos,
+                        index=kv_cache.index + s)
+    return logits, new_cache

@@ -1,0 +1,99 @@
+"""MX (microscaling) weight formats: fp4/fp8 elements with per-block
+power-of-two scales.
+
+Analogue of the reference's ``quantization/microscaling/transform_weights.py``
+(OCP MX spec: blocks of 32 elements share one E8M0 exponent scale; elements
+are FP4 E2M1 or FP8). TPU-native mapping: MX is a *storage* format — weights
+live in HBM packed (fp4: two codes per byte), and dequantization is a gather
++ multiply XLA fuses into the consuming matmul, so decode reads 1/4 the
+weight bytes. Compute stays bf16 on the MXU (TPU has no fp4 ALU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MX_BLOCK = 32
+
+# E2M1 magnitude grid (sign handled separately): 1 sign + 2 exp + 1 mantissa
+_FP4_GRID = np.array([0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0],
+                     dtype=np.float32)
+_FP4_MAX = 6.0
+
+
+def mx_quantize_fp4(w, block_size: int = MX_BLOCK
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Quantize along the LAST dim into packed fp4 + E8M0 block scales.
+
+    Returns ``(packed uint8 [..., n/2], scales float32 [..., n/block])``
+    where scales are exact powers of two (E8M0).
+    """
+    w = np.asarray(w, np.float32)
+    n = w.shape[-1]
+    if n % block_size != 0 or (n // 1) % 2 != 0:
+        raise ValueError(f"last dim {n} must be divisible by {block_size}")
+    blocks = w.reshape(*w.shape[:-1], n // block_size, block_size)
+    amax = np.abs(blocks).max(axis=-1, keepdims=True)
+    # E8M0: power-of-two scale so the block max lands within the grid
+    exp = np.where(amax > 0, np.ceil(np.log2(amax / _FP4_MAX)), 0.0)
+    scale = np.exp2(exp)
+    scaled = blocks / scale
+    # round magnitudes to the nearest grid point
+    mag = np.abs(scaled)[..., None]                    # [..., B, 1]
+    code = np.argmin(np.abs(mag - _FP4_GRID), axis=-1).astype(np.uint8)
+    sign = (scaled < 0).astype(np.uint8)
+    nibble = (sign << 3) | code                        # [..., nb, B]
+    flat = nibble.reshape(*w.shape[:-1], n)
+    packed = ((flat[..., 1::2] << 4) | flat[..., 0::2]).astype(np.uint8)
+    return packed, scale[..., 0].astype(np.float32)
+
+
+def mx_dequantize_fp4(packed: jax.Array, scales: jax.Array,
+                      block_size: int = MX_BLOCK,
+                      dtype: Any = jnp.bfloat16) -> jax.Array:
+    """Inverse of :func:`mx_quantize_fp4` (jittable; the gather+multiply
+    fuses into the consuming matmul)."""
+    packed = jnp.asarray(packed)
+    lo = packed & 0xF
+    hi = packed >> 4
+    flat = jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1],
+                                                packed.shape[-1] * 2)
+    grid = jnp.asarray(_FP4_GRID)
+    mag = grid[(flat & 0x7).astype(jnp.int32)]
+    sign = jnp.where((flat >> 3) == 1, -1.0, 1.0)
+    vals = (sign * mag).reshape(*flat.shape[:-1],
+                                flat.shape[-1] // block_size, block_size)
+    out = vals * scales[..., None]
+    return out.reshape(*flat.shape).astype(dtype)
+
+
+def mx_quantize_fp8(w, block_size: int = MX_BLOCK
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """MXFP8 (E4M3 elements + E8M0 block scales)."""
+    import ml_dtypes
+
+    w = np.asarray(w, np.float32)
+    n = w.shape[-1]
+    if n % block_size != 0:
+        raise ValueError(f"last dim {n} must be divisible by {block_size}")
+    blocks = w.reshape(*w.shape[:-1], n // block_size, block_size)
+    amax = np.abs(blocks).max(axis=-1, keepdims=True)
+    exp = np.where(amax > 0, np.ceil(np.log2(amax / 448.0)), 0.0)
+    scale = np.exp2(exp)
+    q = (blocks / scale).astype(ml_dtypes.float8_e4m3fn)
+    return (q.reshape(*w.shape[:-1], n),
+            scale[..., 0].astype(np.float32))
+
+
+def mx_dequantize_fp8(q: jax.Array, scales: jax.Array,
+                      block_size: int = MX_BLOCK,
+                      dtype: Any = jnp.bfloat16) -> jax.Array:
+    q = jnp.asarray(q)
+    vals = q.astype(jnp.float32).reshape(*q.shape[:-1],
+                                         q.shape[-1] // block_size,
+                                         block_size)
+    return (vals * scales[..., None]).reshape(*q.shape).astype(dtype)

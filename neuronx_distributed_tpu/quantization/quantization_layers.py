@@ -179,17 +179,27 @@ class QuantizedExpertMLPs(nn.Module):
         gate_up = dequantize(gate_up_q, gate_up_scale[:, None], self.dtype)
         down = dequantize(down_q, down_scale[:, None], self.dtype)
 
+        from ..parallel import comm
+
+        ep = comm._axis_size(self.ep_axis)
         capacity = compute_capacity(t, self.num_experts, self.top_k,
                                     self.capacity_factor)
         dispatch, combine, dropped = build_dispatch_combine(
             gates, idx, self.num_experts, capacity)
         xin = jnp.einsum("tec,th->ech", dispatch.astype(self.dtype),
                          x.astype(self.dtype))
+        if ep is not None and ep > 1:
+            # same EP all-to-all pair as the float ExpertMLPs capacity path
+            xin = mappings.enter_expert_parallel_region(
+                xin, self.ep_axis, split_dim=0, concat_dim=1)
         xin = mappings.copy_to_tensor_parallel_region(xin, self.tp_axis)
         h = jnp.einsum("ech,ehki->ecki", xin, gate_up)
         h = nn.silu(h[..., 0, :]) * h[..., 1, :]
         out = jnp.einsum("eci,eih->ech", h, down)
         out = mappings.reduce_from_tensor_parallel_region(out, self.tp_axis)
+        if ep is not None and ep > 1:
+            out = mappings.exit_expert_parallel_region(
+                out, self.ep_axis, split_dim=1, concat_dim=0)
         y = jnp.einsum("tec,ech->th", combine.astype(self.dtype), out)
         return y.astype(self.dtype), {"dropped_fraction": dropped}
 

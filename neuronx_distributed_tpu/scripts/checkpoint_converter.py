@@ -189,18 +189,19 @@ def convert_hf_mixtral_to_nxd(state_dict: Dict[str, Any], cfg) -> Dict:
             sd, "model.layers.{}.post_attention_layernorm.weight", L,
             _asnp)},
     }
-    tree = {"params": {
+    # MixtralForCausalLM has no tied-head path (HF Mixtral never ties);
+    # always materialise lm_head (from embed_tokens when the HF checkpoint
+    # omits it)
+    lm_head = (sd["lm_head.weight"] if "lm_head.weight" in sd
+               else sd["model.embed_tokens.weight"])
+    return {"params": {
         "model": {
             "embed": {"embedding": sd["model.embed_tokens.weight"]},
             "layers": {"layer": layers},
             "norm": {"scale": sd["model.norm.weight"]},
         },
+        "lm_head": {"kernel": _t(lm_head)},
     }}
-    if not getattr(cfg, "tie_embeddings", False):
-        lm_head = (sd["lm_head.weight"] if "lm_head.weight" in sd
-                   else sd["model.embed_tokens.weight"])
-        tree["params"]["lm_head"] = {"kernel": _t(lm_head)}
-    return tree
 
 
 def convert_hf_neox_to_nxd(state_dict: Dict[str, Any], cfg) -> Dict:

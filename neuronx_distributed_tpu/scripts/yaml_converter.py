@@ -46,6 +46,10 @@ def dict_to_config_kwargs(doc: Dict[str, Any]) -> Dict[str, Any]:
     for key, value in doc.items():
         if key in _SECTIONS:
             name, cls = _SECTIONS[key]
+            if not isinstance(value, dict):
+                raise ValueError(
+                    f"config section {key!r} must be a mapping, got "
+                    f"{value!r} (an empty 'key:' line parses as null)")
             fields = {f.name for f in dataclasses.fields(cls)}
             unknown = set(value) - fields
             if unknown:

@@ -413,3 +413,31 @@ def test_blockwise_every_expert_owns_a_block():
                                    ** 2))(params)
     np.testing.assert_array_equal(
         np.asarray(g["params"]["gate_up"][1]), 0.0)
+
+
+def test_mixtral_cached_decode_matches_full_forward():
+    """MoE serving path: incremental cached decode reproduces the full
+    forward logits (the llama decode-parity gate, for mixtral)."""
+    from neuronx_distributed_tpu.inference.kv_cache import init_kv_cache
+    from neuronx_distributed_tpu.models.mixtral import (
+        MixtralForCausalLM, mixtral_forward_with_cache, tiny_moe_config)
+
+    nxd.neuronx_distributed_config()
+    cfg = tiny_moe_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                          moe_dispatch="blockwise", moe_block_size=8)
+    model = MixtralForCausalLM(cfg)
+    ids = jax.random.randint(jax.random.key(60), (1, 8), 0, cfg.vocab_size)
+    params = meta.unbox(model.init(jax.random.key(61), ids))
+    full, _ = model.apply(params, ids)  # [1, 8, V] (tp-sharded? no, tp=1)
+
+    cache = init_kv_cache(cfg.num_layers, 1, 16, cfg.num_kv_heads,
+                          cfg.head_dim_, dtype=jnp.float32)
+    outs = []
+    for t in range(8):
+        logits, cache = mixtral_forward_with_cache(
+            cfg, params, ids[:, t:t + 1], jnp.full((1, 1), t, jnp.int32),
+            cache)
+        outs.append(logits[:, 0])
+    dec = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(full),
+                               rtol=5e-4, atol=5e-4)

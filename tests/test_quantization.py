@@ -174,3 +174,32 @@ def test_quantized_kv_cache_decode():
         ref, fpc = llama_forward_with_cache(cfg, params, tok, p, fpc)
         got, qc = llama_forward_with_cache(cfg, params, tok, p, qc)
         assert np.abs(np.asarray(got) - np.asarray(ref)).max() < 0.2, t
+
+
+def test_mx_microscaling_roundtrip():
+    """MXFP4/MXFP8 (reference quantization/microscaling): fp4 packing is
+    2 codes/byte with exact power-of-two block scales; roundtrip error is
+    bounded by the element grid."""
+    from neuronx_distributed_tpu.quantization.microscaling import (
+        mx_dequantize_fp4, mx_dequantize_fp8, mx_quantize_fp4,
+        mx_quantize_fp8)
+
+    w = np.random.RandomState(0).randn(8, 64).astype(np.float32)
+    packed, scales = mx_quantize_fp4(w)
+    assert packed.shape == (8, 32) and packed.dtype == np.uint8  # 2x pack
+    assert scales.shape == (8, 2)
+    np.testing.assert_array_equal(np.log2(scales),
+                                  np.round(np.log2(scales)))  # E8M0
+    back = np.asarray(mx_dequantize_fp4(packed, scales, dtype=jnp.float32))
+    # fp4 e2m1 relative grid spacing is <= 25% within a block
+    assert np.abs(back - w).max() <= np.abs(w).max() * 0.26
+
+    # values already on the grid roundtrip exactly
+    exact = np.array([[0.5, -1.0, 1.5, 6.0] * 8], np.float32)
+    p2, s2 = mx_quantize_fp4(exact)
+    np.testing.assert_array_equal(
+        np.asarray(mx_dequantize_fp4(p2, s2, dtype=jnp.float32)), exact)
+
+    q8, s8 = mx_quantize_fp8(w)
+    back8 = np.asarray(mx_dequantize_fp8(q8, s8, dtype=jnp.float32))
+    assert np.abs(back8 - w).max() <= np.abs(w).max() * 0.05
