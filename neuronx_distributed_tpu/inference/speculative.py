@@ -13,10 +13,11 @@ vectorised accept/reject — no extra process groups needed.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from flax import linen as nn
 from jax import lax
 
 
@@ -121,6 +122,36 @@ def medusa_accept_longest(tree_logits: jax.Array,
 # slots are simply wasted capacity (bounded by K per round).
 # ---------------------------------------------------------------------------
 
+def _mask_rejected_slots(cache, start_index, num_slots, accepted):
+    """Mark slots ``start_index+j`` with ``j > accepted`` as never-attended
+    (the slot-masking rollback shared by draft and medusa speculation)."""
+    from .kv_cache import PAD_POSITION
+
+    jj = jnp.arange(num_slots)[None, :]
+    window = lax.dynamic_slice_in_dim(cache.pos, start_index, num_slots,
+                                      axis=1)
+    window = jnp.where(jj <= accepted[:, None], window, PAD_POSITION)
+    return cache.replace(pos=lax.dynamic_update_slice_in_dim(
+        cache.pos, window, start_index, axis=1))
+
+
+def _emit_and_scatter(out, filled, drafted, greedy, accepted,
+                      max_new_tokens: int):
+    """Write the accepted drafts + correction token at per-batch offsets;
+    overflow/invalid entries land in the sacrificial last column. Returns
+    ``(out, emit, new_filled)``."""
+    b, k = drafted.shape
+    jj = jnp.arange(k + 1)[None, :]
+    emit = jnp.where(jj < accepted[:, None],
+                     jnp.pad(drafted, ((0, 0), (0, 1))), greedy)
+    valid = jj <= accepted[:, None]
+    dest = jnp.where(valid & (filled[:, None] + jj < max_new_tokens),
+                     filled[:, None] + jj, out.shape[1] - 1)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], dest.shape)
+    out = out.at[rows, dest].set(emit)
+    return out, emit, jnp.minimum(filled + accepted + 1, max_new_tokens)
+
+
 def speculative_generate(cfg, params, draft_cfg, draft_params, input_ids,
                          prompt_len, max_new_tokens: int,
                          speculation_length: int = 4,
@@ -134,7 +165,7 @@ def speculative_generate(cfg, params, draft_cfg, draft_params, input_ids,
     """
     from ..models.llama import llama_forward_with_cache
     from .generation import _jit_prefill, pick_bucket
-    from .kv_cache import PAD_POSITION, init_kv_cache
+    from .kv_cache import init_kv_cache
 
     input_ids = jnp.asarray(input_ids)
     prompt_len = jnp.asarray(prompt_len)
@@ -161,16 +192,6 @@ def speculative_generate(cfg, params, draft_cfg, draft_params, input_ids,
     out0 = jnp.zeros((b, max_new_tokens + k + 1), jnp.int32)
     out0 = out0.at[:, 0].set(committed0)
 
-    def mask_rejected(cache, start_index, num_slots, accepted):
-        """Mark slots start_index+j (j in [0, num_slots)) with j > accepted
-        as never-attended."""
-        jj = jnp.arange(num_slots)[None, :]                # [1, n]
-        window = lax.dynamic_slice_in_dim(cache.pos, start_index, num_slots,
-                                          axis=1)
-        window = jnp.where(jj <= accepted[:, None], window, PAD_POSITION)
-        return cache.replace(pos=lax.dynamic_update_slice_in_dim(
-            cache.pos, window, start_index, axis=1))
-
     def run(carry, params, draft_params):
         def round_body(carry):
             (tcache, dcache, committed, pos, filled, out, acc_sum,
@@ -195,28 +216,16 @@ def speculative_generate(cfg, params, draft_cfg, draft_params, input_ids,
             logits, tcache = llama_forward_with_cache(cfg, params, block,
                                                       positions, tcache)
 
-            # 3. accept/reject
+            # 3. accept/reject, 4. slot-masking rollback, 5. emit
             accepted, greedy = verify_draft_greedy(logits, drafted)
-            jj = jnp.arange(k + 1)[None, :]
-            emit = jnp.where(jj < accepted[:, None],
-                             jnp.pad(drafted, ((0, 0), (0, 1))), greedy)
-
-            # 4. cache rollback by slot masking
-            tcache = mask_rejected(tcache, t_index, k + 1, accepted)
-            dcache = mask_rejected(dcache, dcache.index - k, k, accepted)
-
-            # 5. scatter emitted tokens at per-batch offsets (invalid or
-            # overflow entries land in the sacrificial last column)
-            valid = jj <= accepted[:, None]
-            dest = jnp.where(
-                valid & (filled[:, None] + jj < max_new_tokens),
-                filled[:, None] + jj, out.shape[1] - 1)
-            rows = jnp.broadcast_to(jnp.arange(b)[:, None], dest.shape)
-            out = out.at[rows, dest].set(emit)
+            tcache = _mask_rejected_slots(tcache, t_index, k + 1, accepted)
+            dcache = _mask_rejected_slots(dcache, dcache.index - k, k,
+                                          accepted)
+            out, _, filled = _emit_and_scatter(out, filled, drafted, greedy,
+                                               accepted, max_new_tokens)
 
             new_committed = jnp.take_along_axis(greedy, accepted[:, None],
                                                 axis=1)[:, 0]
-            filled = jnp.minimum(filled + accepted + 1, max_new_tokens)
             return (tcache, dcache, new_committed, pos + accepted + 1,
                     filled, out, acc_sum + jnp.sum(accepted), rounds + 1)
 
@@ -230,6 +239,132 @@ def speculative_generate(cfg, params, draft_cfg, draft_params, input_ids,
              jnp.zeros((), jnp.int32))
     (_, _, _, _, _, out, acc_sum, rounds) = jax.jit(run)(
         carry, params, draft_params)
+    stats = {"mean_accepted": acc_sum / jnp.maximum(rounds * b, 1),
+             "rounds": rounds}
+    return out[:, :max_new_tokens], stats
+
+
+# ---------------------------------------------------------------------------
+# Medusa: extra decode heads on the target model propose the draft
+# (reference medusa stack: heads in examples/inference/modules, buffers in
+# utils/medusa_utils.py). The top-1 path through the heads is a drafted
+# block verified exactly like draft-model speculation, sharing the
+# slot-masking rollback.
+# ---------------------------------------------------------------------------
+
+class MedusaHeads(nn.Module):
+    """K residual-MLP decode heads: head k predicts the token at offset
+    k+1 from the current hidden state (reference medusa head =
+    ResBlock + lm head)."""
+
+    hidden_size: int
+    vocab_size: int
+    num_heads: int = 4
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h: jax.Array) -> jax.Array:
+        """h: [B, H] -> logits [B, K, V]."""
+        from ..parallel import layers as pl
+
+        outs = []
+        for k in range(self.num_heads):
+            z = pl.ColumnParallelLinear(
+                features=self.hidden_size, use_bias=True,
+                gather_output=True, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=f"res_{k}")(h)
+            z = h + jax.nn.silu(z)
+            logits = pl.ColumnParallelLinear(
+                features=self.vocab_size, use_bias=False,
+                gather_output=True, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=f"head_{k}")(z)
+            outs.append(logits)
+        return jnp.stack(outs, axis=1)
+
+
+def medusa_generate(cfg, params, medusa_module: MedusaHeads, medusa_params,
+                    input_ids, prompt_len, max_new_tokens: int,
+                    buckets=(128, 512, 2048), kv_dtype=None):
+    """Greedy Medusa decoding (top-1 path through the heads).
+
+    Same exactness property as :func:`speculative_generate`: the output
+    equals target-only greedy decoding regardless of head quality; trained
+    heads raise the accepted-tokens-per-round. Returns
+    ``(tokens [B, max_new_tokens], stats)``.
+    """
+    from ..models.llama import llama_forward_with_cache
+    from .generation import pick_bucket
+    from .kv_cache import PAD_POSITION, init_kv_cache
+
+    input_ids = jnp.asarray(input_ids)
+    prompt_len = jnp.asarray(prompt_len)
+    b, s = input_ids.shape
+    k = medusa_module.num_heads
+    bucket = pick_bucket(s, buckets)
+    if bucket > s:
+        input_ids = jnp.pad(input_ids, ((0, 0), (0, bucket - s)))
+
+    slack = max_new_tokens * (k + 1) + k + 1
+    tcache = init_kv_cache(cfg.num_layers, b, bucket + slack,
+                           cfg.num_kv_heads, cfg.head_dim_,
+                           dtype=kv_dtype or cfg.dtype)
+
+    @jax.jit
+    def jit_prefill(params, input_ids, prompt_len, tcache):
+        ar = jnp.broadcast_to(jnp.arange(bucket), (b, bucket))
+        positions = jnp.where(ar < prompt_len[:, None], ar, PAD_POSITION)
+        tlogits, tcache, hidden = llama_forward_with_cache(
+            cfg, params, input_ids, positions, tcache, return_hidden=True)
+        last_idx = (prompt_len - 1)[:, None, None]
+        committed0 = jnp.argmax(
+            jnp.take_along_axis(tlogits, last_idx, axis=1)[:, 0], axis=-1)
+        h0 = jnp.take_along_axis(
+            hidden, last_idx.astype(jnp.int32), axis=1)[:, 0]
+        return committed0, h0, tcache
+
+    committed0, h0, tcache = jit_prefill(params, input_ids, prompt_len,
+                                         tcache)
+    out0 = jnp.zeros((b, max_new_tokens + k + 1), jnp.int32)
+    out0 = out0.at[:, 0].set(committed0)
+
+    def run(carry, params, medusa_params):
+        def round_body(carry):
+            tcache, committed, h, pos, filled, out, acc_sum, rounds = carry
+            # heads draft the top-1 path from the current hidden state
+            head_logits = medusa_module.apply(medusa_params, h)  # [B,K,V]
+            drafted = jnp.argmax(head_logits, axis=-1)           # [B,K]
+
+            block = jnp.concatenate([committed[:, None], drafted], axis=1)
+            positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+            t_index = tcache.index
+            logits, tcache, hid = llama_forward_with_cache(
+                cfg, params, block, positions, tcache, return_hidden=True)
+
+            accepted, greedy = verify_draft_greedy(logits, drafted)
+            tcache = _mask_rejected_slots(tcache, t_index, k + 1, accepted)
+            out, _, filled = _emit_and_scatter(out, filled, drafted, greedy,
+                                               accepted, max_new_tokens)
+
+            new_committed = jnp.take_along_axis(greedy, accepted[:, None],
+                                                axis=1)[:, 0]
+            # hidden at the last ACCEPTED position feeds the next round's
+            # heads (it conditions on everything accepted so far)
+            new_h = jnp.take_along_axis(
+                hid, accepted[:, None, None], axis=1)[:, 0]
+            return (tcache, new_committed, new_h, pos + accepted + 1,
+                    filled, out, acc_sum + jnp.sum(accepted), rounds + 1)
+
+        def cond(carry):
+            return jnp.any(carry[4] < max_new_tokens)
+
+        return lax.while_loop(cond, round_body, carry)
+
+    carry = (tcache, committed0, h0, prompt_len,
+             jnp.ones((b,), jnp.int32), out0, jnp.zeros((), jnp.int32),
+             jnp.zeros((), jnp.int32))
+    (_, _, _, _, _, out, acc_sum, rounds) = jax.jit(run)(
+        carry, params, medusa_params)
     stats = {"mean_accepted": acc_sum / jnp.maximum(rounds * b, 1),
              "rounds": rounds}
     return out[:, :max_new_tokens], stats

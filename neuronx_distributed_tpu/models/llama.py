@@ -66,9 +66,18 @@ class LlamaConfig:
     remat: bool = False
     scan_layers: bool = True
     use_flash_attention: bool = False
+    # context-parallel attention: "ring" (ppermute KV rotation) or
+    # "ulysses" (all-to-all seq<->head resharding; needs heads % cp == 0)
+    cp_attn_impl: str = "ring"
     tp_size: Optional[int] = None
     # LoRA adapters (see neuronx_distributed_tpu.lora); None = disabled
     lora: Optional["LoraConfig"] = None
+
+    def __post_init__(self) -> None:
+        if self.cp_attn_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"cp_attn_impl must be 'ring' or 'ulysses', got "
+                f"{self.cp_attn_impl!r}")
 
     @property
     def head_dim_(self) -> int:
@@ -153,23 +162,32 @@ class LlamaAttention(nn.Module):
             out = jnp.einsum("bnqk,bknd->bqnd", probs,
                              v_full.astype(jnp.float32)).astype(cfg.dtype)
         else:
-            k = attn_mod.repeat_kv(k, n_q_local // n_kv_local)
-            v = attn_mod.repeat_kv(v, n_q_local // n_kv_local)
             from ..parallel import comm
 
             cp = comm._axis_size(ps.CP_AXIS)
-            if cp is not None and cp > 1:
-                # context parallel: sequence sliced over cp; ring attention
-                # rotates KV around the cp ring (reference:
-                # kernels/ring_attention_kernel.py)
+            if cp is not None and cp > 1 and cfg.cp_attn_impl == "ulysses":
+                # Ulysses moves the raw GQA kv heads through its
+                # all-to-alls and expands after the reshard
+                from ..ops.ulysses import ulysses_attention
+
+                out = ulysses_attention(q, k, v, causal=True)
+            elif cp is not None and cp > 1:
+                # context parallel: KV rotates around the cp ring
+                # (reference kernels/ring_attention_kernel.py)
                 from ..ops.ring_attention import ring_attention
 
+                k = attn_mod.repeat_kv(k, n_q_local // n_kv_local)
+                v = attn_mod.repeat_kv(v, n_q_local // n_kv_local)
                 out = ring_attention(q, k, v, causal=True)
             elif cfg.use_flash_attention:
                 from ..ops.flash_attention import flash_attention
 
+                k = attn_mod.repeat_kv(k, n_q_local // n_kv_local)
+                v = attn_mod.repeat_kv(v, n_q_local // n_kv_local)
                 out = flash_attention(q, k, v, causal=True)
             else:
+                k = attn_mod.repeat_kv(k, n_q_local // n_kv_local)
+                v = attn_mod.repeat_kv(v, n_q_local // n_kv_local)
                 out = attn_mod.sdpa_reference(q, k, v, causal=True)
         out = out.reshape(b, s, n_q_local * head_dim)
         out = pl.RowParallelLinear(
@@ -421,7 +439,8 @@ class LlamaForCausalLM(nn.Module):
 
 
 def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
-                             positions: jax.Array, kv_cache):
+                             positions: jax.Array, kv_cache,
+                             return_hidden: bool = False):
     """KV-cached forward for prefill ("context_encoding") and decode
     ("token_generation") — the two compiled graphs of the reference's
     serving path (``trace/model_builder.py:495`` keys).
@@ -496,4 +515,7 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
         new_k, new_v = new_kv
         new_cache = KVCache(k=new_k, v=new_v, pos=slot_pos,
                             index=kv_cache.index + s)
+    if return_hidden:
+        # post-norm hidden states — the medusa heads' input
+        return logits, new_cache, x
     return logits, new_cache

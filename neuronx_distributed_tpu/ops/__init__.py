@@ -6,10 +6,17 @@ reference's torch golden fallbacks (``moe/blockwise.py:326``).
 """
 
 from . import flash_attention
+from . import flash_decoding
 from . import operators
 from . import ring_attention
+from . import ulysses
 from .flash_attention import flash_attention as flash_attention_fn
+from .flash_decoding import flash_decode_attention
 from .ring_attention import ring_attention as ring_attention_fn
+from .ring_attention import ring_attention_pallas
+from .ulysses import ulysses_attention
 
-__all__ = ["flash_attention", "operators", "ring_attention", "flash_attention_fn",
-           "ring_attention_fn"]
+__all__ = ["flash_attention", "flash_decoding", "operators",
+           "ring_attention", "ulysses", "flash_attention_fn",
+           "flash_decode_attention", "ring_attention_fn",
+           "ring_attention_pallas", "ulysses_attention"]

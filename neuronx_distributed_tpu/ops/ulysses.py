@@ -1,0 +1,80 @@
+"""Ulysses-style all-to-all sequence parallelism for long context.
+
+The second context-parallel attention formulation next to ring attention
+(the reference exposes both strategies for its CP degree — ring via
+``kernels/ring_attention_kernel.py``, all-to-all head-sharding via the same
+``context_parallel_size`` machinery; cf. DeepSpeed-Ulysses): each cp rank
+holds a sequence slice; one all-to-all converts seq-sharding into
+head-sharding, attention runs over the FULL sequence for this rank's head
+group (the Pallas flash kernel applies unchanged — no cross-step online
+merge needed), and a second all-to-all converts back.
+
+Trade-off vs ring: two all-to-alls of activation size instead of cp-1
+ppermutes of KV size, but no bubble and the plain flash kernel; preferable
+when heads >= cp and KV is large (GQA-expanded). Causality is trivial —
+each head group sees the whole sequence.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+
+from ..parallel import comm, mappings
+from ..parallel import mesh as ps
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis: str = ps.CP_AXIS, causal: bool = True,
+                      scale: Optional[float] = None) -> jax.Array:
+    """All-to-all context-parallel attention.
+
+    ``q: [B, S_local, N, D]``; ``k/v: [B, S_local, KV, D]`` may carry the
+    *raw* GQA kv heads — when ``KV % cp == 0`` the all-to-alls move the
+    unexpanded kv (group-factor less traffic) and expansion happens after
+    the reshard; otherwise kv is expanded first. Requires ``N % cp == 0``.
+    Must be called with ``axis`` bound; falls back to plain attention when
+    cp is absent/1. Differentiable (the all-to-alls are the custom_vjp
+    expert-region pair, whose transpose is the reverse all-to-all).
+    """
+    from ..modules.attention import repeat_kv
+
+    cp = comm._axis_size(axis)
+    n = q.shape[2]
+    if cp is None or cp == 1:
+        from ..modules.attention import sdpa_reference
+
+        rep = n // k.shape[2]
+        return sdpa_reference(q, repeat_kv(k, rep), repeat_kv(v, rep),
+                              causal=causal, scale=scale)
+    if n % cp != 0:
+        raise ValueError(
+            f"ulysses attention requires heads {n} divisible by cp {cp}")
+    if k.shape[2] % cp != 0:
+        # kv heads don't split over cp: expand to q heads before the a2a
+        rep = n // k.shape[2]
+        k, v = repeat_kv(k, rep), repeat_kv(v, rep)
+
+    def seq_to_heads(x):
+        # [B, s_local, N, D] -> [B, S, N/cp, D]
+        return mappings.enter_expert_parallel_region(
+            x, axis, split_dim=2, concat_dim=1)
+
+    def heads_to_seq(x):
+        return mappings.exit_expert_parallel_region(
+            x, axis, split_dim=1, concat_dim=2)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if kh.shape[2] != qh.shape[2]:
+        # expand after the reshard: repeat_kv is adjacent (kv head j
+        # serves q heads [j*rep, (j+1)*rep)), so a contiguous q-head block
+        # matches the contiguous kv-head block of its rank
+        rep = qh.shape[2] // kh.shape[2]
+        kh, vh = repeat_kv(kh, rep), repeat_kv(vh, rep)
+    from .flash_attention import flash_attention
+
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    out = flash_attention(qh, kh, vh, causal=causal, scale=scale_)
+    return heads_to_seq(out)

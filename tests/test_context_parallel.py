@@ -161,3 +161,84 @@ def test_ring_attention_pallas_matches_xla():
         # pick up ~2e-5 fp32 noise through the chunked exp/log path
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=1e-4, atol=5e-5)
+
+
+def test_ulysses_attention_matches_dense():
+    """All-to-all (Ulysses) context parallelism: fwd + grads == dense
+    (the second CP strategy next to ring attention)."""
+    from neuronx_distributed_tpu.ops.ulysses import ulysses_attention
+
+    mesh = ps.initialize_model_parallel(context_parallel_size=4)
+    b, s, n, d = 2, 32, 4, 8
+    ks = jax.random.split(jax.random.key(70), 3)
+    q = jax.random.normal(ks[0], (b, s, n, d))
+    k = jax.random.normal(ks[1], (b, s, n, d))
+    v = jax.random.normal(ks[2], (b, s, n, d))
+    ref = sdpa_reference(q, k, v, causal=True)
+
+    out = jax.jit(ps.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v), mesh,
+        in_specs=(P(None, "cp", None, None),) * 3,
+        out_specs=P(None, "cp", None, None)))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    dense_g = jax.grad(lambda q, k, v: jnp.sum(
+        sdpa_reference(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(
+            q, k, v)
+
+    def inner(q, k, v):
+        return jax.grad(lambda q, k, v: jax.lax.pmean(jnp.sum(
+            ulysses_attention(q, k, v) ** 2), "cp"),
+            argnums=(0, 1, 2))(q, k, v)
+
+    g = jax.jit(ps.shard_map(
+        inner, mesh, in_specs=(P(None, "cp", None, None),) * 3,
+        out_specs=(P(None, "cp", None, None),) * 3))(q, k, v)
+    for a, r in zip(g, dense_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_llama_cp_ulysses_training_matches_dense():
+    """Full-model CP training with cp_attn_impl='ulysses' matches dense."""
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+    from neuronx_distributed_tpu.parallel import grads as grads_mod
+    from neuronx_distributed_tpu.pipeline import spmd_engine as eng
+    from neuronx_distributed_tpu.trainer import initialize_parallel_model
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=2, context_parallel_size=2)
+    mesh = ps.get_mesh()
+    mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                       num_layers=2, tp_size=2, cp_attn_impl="ulysses")
+    model = LlamaForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(71), (4, 33), 0,
+                             mcfg.vocab_size)
+    batch_ids, labels = ids[:, :-1], ids[:, 1:]
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(72),
+                                           batch_ids)
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+    dense_loss, dense_grads = jax.value_and_grad(
+        lambda p: model.apply(p, batch_ids, labels, method="loss"))(
+            host_params)
+
+    def inner(p, i, lb):
+        def local_loss(p):
+            return eng.data_parallel_mean(
+                model.apply(p, i, lb, method="loss"))
+
+        loss, g = jax.value_and_grad(local_loss)(p)
+        return loss, grads_mod.allreduce_gradients(g, specs=pm.param_specs)
+
+    loss, grads = jax.jit(ps.shard_map(
+        inner, mesh,
+        in_specs=(pm.param_specs, P("dp", "cp"), P("dp", "cp")),
+        out_specs=(P(), pm.param_specs)))(params, batch_ids, labels)
+    np.testing.assert_allclose(float(loss), float(dense_loss), rtol=2e-4)
+    flat_ref = dict(jax.tree_util.tree_leaves_with_path(dense_grads))
+    for path, g in jax.tree_util.tree_leaves_with_path(grads):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(flat_ref[path]), rtol=5e-3,
+            atol=3e-5, err_msg=jax.tree_util.keystr(path))

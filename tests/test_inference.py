@@ -362,3 +362,27 @@ def test_flash_decoding_kv_split_matches_dense():
                      jax.nn.softmax(scores, axis=-1), v).reshape(b, s, n, d)
     np.testing.assert_allclose(np.asarray(dense), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_medusa_generate_exact(tiny_model):
+    """Medusa end-to-end: decode heads draft the block, verified exactly
+    like draft speculation — greedy output equals target-only decode
+    regardless of head quality (untrained heads here)."""
+    from neuronx_distributed_tpu.inference.generation import generate
+    from neuronx_distributed_tpu.inference.speculative import (
+        MedusaHeads, medusa_generate)
+
+    cfg, model, params = tiny_model
+    heads = MedusaHeads(hidden_size=cfg.hidden_size,
+                        vocab_size=cfg.vocab_size, num_heads=3,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+    hparams = meta.unbox(heads.init(jax.random.key(80),
+                                    jnp.zeros((1, cfg.hidden_size))))
+    ids = jax.random.randint(jax.random.key(81), (2, 12), 0,
+                             cfg.vocab_size)
+    plen = jnp.asarray([12, 9])
+    ref = generate(cfg, params, ids, plen, 10, buckets=(16,))
+    toks, stats = medusa_generate(cfg, params, heads, hparams, ids, plen,
+                                  10, buckets=(16,))
+    assert (np.asarray(toks) == np.asarray(ref)).all()
+    assert int(stats["rounds"]) >= 1
