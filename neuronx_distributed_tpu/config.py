@@ -56,6 +56,9 @@ class ParallelConfig:
     data_parallel_size: Optional[int] = None
     # Virtual pipeline (interleaved 1F1B) model chunks per pp rank.
     virtual_pipeline_size: int = 1
+    # Multi-slice: this many dp groups placed across slices (DCN); None/1
+    # keeps everything within one ICI domain.
+    dcn_data_parallel_size: Optional[int] = None
 
     def __post_init__(self) -> None:
         for f in ("tensor_parallel_size", "pipeline_parallel_size",
@@ -64,6 +67,11 @@ class ParallelConfig:
             v = getattr(self, f)
             if not isinstance(v, int) or v < 1:
                 raise ValueError(f"{f} must be a positive int, got {v!r}")
+        d = self.dcn_data_parallel_size
+        if d is not None and (not isinstance(d, int) or d < 1):
+            raise ValueError(
+                f"dcn_data_parallel_size must be a positive int or None, "
+                f"got {d!r}")
 
     @property
     def model_parallel_size(self) -> int:
@@ -162,6 +170,7 @@ def neuronx_distributed_config(
     seed: int = 0,
     init_mesh: bool = True,
     devices: Optional[Sequence[Any]] = None,
+    dcn_data_parallel_size: Optional[int] = None,
 ) -> NxDConfig:
     """Build an :class:`NxDConfig` and (by default) initialise the global mesh.
 
@@ -175,6 +184,7 @@ def neuronx_distributed_config(
             pipeline_parallel_size=pipeline_parallel_size,
             context_parallel_size=context_parallel_size,
             expert_parallel_size=expert_parallel_size,
+            dcn_data_parallel_size=dcn_data_parallel_size,
         ),
         optimizer=optimizer_config or OptimizerConfig(),
         mixed_precision=mixed_precision_config or MixedPrecisionConfig(),
@@ -194,5 +204,6 @@ def neuronx_distributed_config(
             context_parallel_size=context_parallel_size,
             expert_model_parallel_size=expert_parallel_size,
             devices=devices,
+            dcn_data_parallel_size=dcn_data_parallel_size,
         )
     return cfg

@@ -15,6 +15,7 @@ from .layers import (
 )
 from .loss_functions import parallel_cross_entropy
 from .mesh import (
+    initialize_distributed,
     initialize_model_parallel,
     model_parallel_is_initialized,
     destroy_model_parallel,
@@ -32,6 +33,7 @@ __all__ = [
     "mesh",
     "comm",
     "mappings",
+    "initialize_distributed",
     "initialize_model_parallel",
     "model_parallel_is_initialized",
     "destroy_model_parallel",

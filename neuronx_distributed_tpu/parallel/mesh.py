@@ -90,6 +90,56 @@ def _topology_device_order(devices: Sequence[Any], shape: Tuple[int, ...]) -> np
     return np.asarray(devs, dtype=object).reshape(shape)
 
 
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap: one JAX process per host.
+
+    The analogue of the reference's torchrun/MPI world initialisation
+    (SURVEY §5 "comm backend"): after this, ``jax.devices()`` spans every
+    host and XLA collectives ride ICI within a slice and DCN across
+    slices. Arguments default to the TPU metadata / environment discovery
+    built into ``jax.distributed.initialize`` (``JAX_COORDINATOR_ADDRESS``
+    etc.); pass them explicitly on non-TPU clusters.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def _hybrid_device_order(devices: Sequence[Any], shape: Tuple[int, ...],
+                         dcn_dp: int) -> np.ndarray:
+    """Multi-slice layout: the dp axis factors as (dcn outer, ici inner) so
+    only data-parallel collectives cross DCN."""
+    pp, dp, cp, tp = shape
+    if dp % dcn_dp != 0:
+        raise ValueError(
+            f"dp {dp} not divisible by dcn_data_parallel_size {dcn_dp}")
+    devs = sorted(devices, key=lambda d: (getattr(d, "process_index", 0),
+                                          d.id))
+    plat = getattr(devs[0], "platform", "cpu")
+    if plat == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+
+            return np.asarray(mesh_utils.create_hybrid_device_mesh(
+                (pp, dp // dcn_dp, cp, tp), (1, dcn_dp, 1, 1),
+                devices=devs))
+        except Exception as e:  # pragma: no cover - solver fallback
+            logger.warning("create_hybrid_device_mesh failed (%s); "
+                           "process-blocked fallback", e)
+    # virtual/CPU fallback: contiguous per-slice blocks stacked on dp
+    per = len(devs) // dcn_dp
+    blocks = [np.asarray(devs[i * per:(i + 1) * per], dtype=object)
+              .reshape(pp, dp // dcn_dp, cp, tp) for i in range(dcn_dp)]
+    return np.concatenate(blocks, axis=1)
+
+
 def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
@@ -97,6 +147,7 @@ def initialize_model_parallel(
     expert_model_parallel_size: int = 1,
     devices: Optional[Sequence[Any]] = None,
     data_parallel_size: Optional[int] = None,
+    dcn_data_parallel_size: Optional[int] = None,
 ) -> Mesh:
     """Build the global meshes.
 
@@ -104,6 +155,12 @@ def initialize_model_parallel(
     (``parallel_state.py:391``). Degree validation and the ``[PP, DP, CP, TP]``
     factorisation follow ``parallel_state.py:560-636``. There is no collective
     warm-up (``:647-657``) — XLA initialises collectives at first compile.
+
+    ``dcn_data_parallel_size``: multi-slice/multi-host layouts — that many
+    data-parallel groups are placed *across* slices (DCN), everything else
+    stays within a slice (ICI). The standard TPU recipe: only DP gradients
+    cross the slow links (the reference's multi-node analogue is its
+    EFA/NCCL DP process groups over torchrun nodes).
     """
     if devices is None:
         devices = jax.devices()
@@ -124,7 +181,11 @@ def initialize_model_parallel(
             f"dp*cp = {dp * cp} not divisible by expert parallel size {ep}")
     dp_exp = dp * cp // ep
 
-    arr = _topology_device_order(devices, (pp, dp, cp, tp))
+    if dcn_data_parallel_size and dcn_data_parallel_size > 1:
+        arr = _hybrid_device_order(devices, (pp, dp, cp, tp),
+                                   dcn_data_parallel_size)
+    else:
+        arr = _topology_device_order(devices, (pp, dp, cp, tp))
     _STATE.device_array = arr
     _STATE.mesh = Mesh(arr, MESH_AXES)
     _STATE.expert_mesh = Mesh(arr.reshape(pp, dp_exp, ep, tp), EXPERT_MESH_AXES)

@@ -59,7 +59,7 @@ def dict_to_config_kwargs(doc: Dict[str, Any]) -> Dict[str, Any]:
             kwargs[name] = cls(**value)
         elif key in ("tensor_parallel_size", "pipeline_parallel_size",
                      "context_parallel_size", "expert_parallel_size",
-                     "sequence_parallel", "seed"):
+                     "dcn_data_parallel_size", "sequence_parallel", "seed"):
             kwargs[key] = value
         else:
             raise ValueError(f"unknown config key {key!r}")

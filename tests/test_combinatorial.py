@@ -195,3 +195,33 @@ def test_pp_schedule_matrix(schedule):
     step = make_train_step(pm, tx, sh, grad_fn=grad_fn)
     state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"])), schedule
+
+
+def test_dcn_hybrid_mesh_layout_and_step():
+    """Multi-slice layout: dp factors (dcn outer, ici inner) so only DP
+    crosses the slow links; the train step runs unchanged (multi-host
+    analogue of the reference's torchrun+EFA DP groups)."""
+    from neuronx_distributed_tpu.parallel import mesh as ps
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=2,
+                                         dcn_data_parallel_size=2)
+    arr = ps._STATE.device_array  # [pp=1, dp=4, cp=1, tp=2]
+    assert arr.shape == (1, 4, 1, 2)
+    # the first two dp rows form "slice 0" (devices 0..3 on the virtual
+    # mesh), the last two "slice 1" — only dp spans slices
+    first = {d.id for d in arr[0, :2].flatten()}
+    second = {d.id for d in arr[0, 2:].flatten()}
+    assert first == {0, 1, 2, 3} and second == {4, 5, 6, 7}
+
+    mcfg = nxd.configure_model(cfg, tiny_config(
+        dtype=jnp.float32, param_dtype=jnp.float32, num_layers=2))
+    model = LlamaForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (8, 33), 0,
+                             mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           batch["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 1e-3)
+    step = make_train_step(pm, tx, sh)
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
