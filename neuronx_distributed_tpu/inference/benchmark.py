@@ -50,3 +50,41 @@ def benchmark(fn: Callable[[], Any], n_runs: int = 20,
         jax.block_until_ready(fn())
         col.record(time.perf_counter() - t0)
     return col.report()
+
+
+def decode_benchmark_suite(cfg, params, draft_cfg=None, draft_params=None,
+                           batch: int = 1, prompt_len: int = 128,
+                           new_tokens: int = 64, n_runs: int = 5,
+                           buckets=(128, 512, 2048)) -> Dict[str, Dict]:
+    """Benchmark the decode paths against each other: plain greedy and
+    (when a draft model is given) speculative decoding (reference
+    benchmarks its serving keys the same way). Each entry reports latency
+    percentiles plus ``tokens_per_sec``."""
+    import jax.numpy as jnp
+
+    from .generation import generate
+    from .speculative import speculative_generate
+
+    if (draft_cfg is None) != (draft_params is None):
+        raise ValueError(
+            "draft_cfg and draft_params must be passed together")
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, prompt_len)))
+    plen = jnp.full((batch,), prompt_len, jnp.int32)
+    out: Dict[str, Dict] = {}
+
+    def with_tps(report):
+        report["tokens_per_sec"] = (batch * new_tokens
+                                    / (report["mean_ms"] / 1e3))
+        return report
+
+    out["greedy"] = with_tps(benchmark(
+        lambda: generate(cfg, params, ids, plen, new_tokens,
+                         buckets=buckets), n_runs=n_runs))
+    if draft_cfg is not None:
+        out["speculative"] = with_tps(benchmark(
+            lambda: speculative_generate(cfg, params, draft_cfg,
+                                         draft_params, ids, plen,
+                                         new_tokens, buckets=buckets)[0],
+            n_runs=n_runs))
+    return out

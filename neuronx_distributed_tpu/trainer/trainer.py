@@ -166,6 +166,7 @@ def make_train_step(
     grad_fn: Optional[Callable] = None,
     batch_spec: PartitionSpec = PartitionSpec(ps.DP_AXIS),
     donate: bool = True,
+    grad_accum_steps: int = 1,
 ):
     """Build the jitted SPMD train step.
 
@@ -175,6 +176,14 @@ def make_train_step(
     gradients themselves (e.g. the shard_map pipeline engine, whose gradients
     may not cross the shard_map boundary as cotangents — see
     ``parallel/grads.py``).
+
+    ``grad_accum_steps``: split the batch's leading dim into that many
+    microbatches, accumulating grads in a ``lax.scan`` before the single
+    optimizer update (the reference trainer's gradient_accumulation_steps;
+    activations live for one microbatch at a time). Composes with either
+    loss_fn or grad_fn. Note: the result is the *mean over microbatch
+    means* — identical to the full-batch step when microbatches carry equal
+    valid-token counts (the reference accumulates the same way).
     """
     mesh = ps.get_mesh()
 
@@ -182,17 +191,57 @@ def make_train_step(
         raise ValueError(
             "pass either loss_fn (differentiated here) or grad_fn "
             "(self-differentiating, e.g. the pipeline engine), not both")
+    if grad_accum_steps < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got "
+                         f"{grad_accum_steps}")
     if loss_fn is None and grad_fn is None:
         def loss_fn(module, params, batch):
             input_ids, labels = batch["input_ids"], batch["labels"]
             return module.apply(params, input_ids, labels, method="loss")
 
-    def step_fn(state: TrainState, batch) -> Tuple[TrainState, dict]:
+    def one_grad(params, batch):
         if grad_fn is not None:
-            loss, grads = grad_fn(state.params, batch)
+            return grad_fn(params, batch)
+        return jax.value_and_grad(
+            lambda p: loss_fn(pm.module, p, batch))(params)
+
+    def accum_grad(params, batch):
+        a = grad_accum_steps
+
+        def slice_mb(x):
+            if x.shape[0] % a != 0:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} not divisible by "
+                    f"grad_accum_steps {a}")
+            return x.reshape(a, x.shape[0] // a, *x.shape[1:])
+
+        mbs = jax.tree_util.tree_map(slice_mb, batch)
+        # keep every microbatch spread over the full dp axis — without the
+        # constraint GSPMD may localize the new leading dim and serialize
+        # data parallelism inside the scan
+        mb_sharding = NamedSharding(mesh, PartitionSpec(None, *batch_spec))
+        mbs = jax.tree_util.tree_map(
+            lambda x: jax.lax.with_sharding_constraint(x, mb_sharding), mbs)
+
+        def body(carry, mb):
+            loss_sum, gacc = carry
+            loss, g = one_grad(params, mb)
+            gacc = jax.tree_util.tree_map(jnp.add, gacc, g)
+            return (loss_sum + loss, gacc), None
+
+        zero = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.result_type(p)), params)
+        (loss_sum, gsum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zero), mbs)
+        scale = 1.0 / a
+        return loss_sum * scale, jax.tree_util.tree_map(
+            lambda g: g * scale, gsum)
+
+    def step_fn(state: TrainState, batch) -> Tuple[TrainState, dict]:
+        if grad_accum_steps > 1:
+            loss, grads = accum_grad(state.params, batch)
         else:
-            loss, grads = jax.value_and_grad(
-                lambda p: loss_fn(pm.module, p, batch))(state.params)
+            loss, grads = one_grad(state.params, batch)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {

@@ -386,3 +386,16 @@ def test_medusa_generate_exact(tiny_model):
                                   10, buckets=(16,))
     assert (np.asarray(toks) == np.asarray(ref)).all()
     assert int(stats["rounds"]) >= 1
+
+
+def test_decode_benchmark_suite_smoke(tiny_model):
+    from neuronx_distributed_tpu.inference.benchmark import (
+        decode_benchmark_suite)
+
+    cfg, model, params = tiny_model
+    rep = decode_benchmark_suite(cfg, params, draft_cfg=cfg,
+                                 draft_params=params, batch=1,
+                                 prompt_len=8, new_tokens=4, n_runs=1,
+                                 buckets=(8,))
+    assert set(rep) == {"greedy", "speculative"}
+    assert rep["greedy"]["tokens_per_sec"] > 0

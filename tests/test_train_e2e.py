@@ -117,3 +117,38 @@ def test_sequence_parallel_shard_map_matches_gspmd():
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(rg), rtol=5e-3, atol=1e-5,
             err_msg=jax.tree_util.keystr(path))
+
+
+def test_grad_accumulation_matches_full_batch():
+    """grad_accum_steps=4 produces the same update as the full-batch step
+    (mean-of-microbatch-means == full mean for equal microbatches)."""
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model, initialize_parallel_optimizer,
+        make_train_step)
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=2)
+    mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                       num_layers=2)
+    model = LlamaForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (8, 33), 0, mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           batch["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 1e-3)
+    full = make_train_step(pm, tx, sh, donate=False)
+    accum = make_train_step(pm, tx, sh, donate=False, grad_accum_steps=4)
+
+    s1, m1 = full(state, batch)
+    s2, m2 = accum(state, batch)
+    np.testing.assert_allclose(float(m2["loss"]), float(m1["loss"]),
+                               rtol=1e-5)
+    for (p1, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(s1.params),
+            jax.tree_util.tree_leaves_with_path(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6,
+                                   err_msg=jax.tree_util.keystr(p1))
