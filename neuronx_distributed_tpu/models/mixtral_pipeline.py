@@ -1,0 +1,148 @@
+"""Pipeline-parallel Mixtral (MoE) training path.
+
+MoE × PP composition (the reference's mixtral example runs under
+``NxDPPModel`` the same way its llama one does): the MoE decoder stack is
+partitioned over the ``pp`` mesh axis exactly like
+:mod:`.llama_pipeline`, with the router auxiliary losses accumulated
+per-stage inside the scanned GPipe engine (``pipeline_spmd(with_aux=True)``)
+and psum'd over pp into the loss — the analogue of the reference
+broadcasting/averaging user outputs across the pipeline
+(``pipeline/model.py`` loss reduction).
+
+Params are byte-compatible with :class:`.mixtral.MixtralForCausalLM`
+(``scan_layers=True``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..modules import attention as attn_mod
+from ..modules.norms import RMSNorm
+from ..parallel import layers as pl
+from ..parallel import loss_functions as lf
+from ..parallel import mappings
+from ..parallel import mesh as ps
+from ..pipeline import spmd_engine as eng
+from .llama_pipeline import PIPELINE_LOGICAL_RULES  # noqa: F401 (re-export)
+from .mixtral import MixtralConfig, _MoEScanBody
+
+
+def pipelined_moe_loss_fn(cfg: MixtralConfig, num_microbatches: int,
+                          ignore_index: int = -100):
+    """Build ``pp_loss(params, ids, labels) -> scalar`` (GPipe engine) for
+    the MoE decoder; includes the router aux losses."""
+    if not cfg.scan_layers:
+        raise ValueError("pipeline path requires scan_layers=True")
+    if cfg.sequence_parallel:
+        raise NotImplementedError(
+            "sequence_parallel under the MoE pipeline path is not yet "
+            "supported (the MoE block regathers full sequences)")
+
+    embed_mod = pl.ParallelEmbedding(
+        num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+    norm_mod = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype)
+    head_mod = pl.ColumnParallelLinear(
+        features=cfg.vocab_size, use_bias=False, gather_output=False,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+
+    def pp_loss(params, ids, labels):
+        p = params["params"]
+        S = ps.get_pipeline_model_parallel_size()
+        M = num_microbatches
+        if cfg.num_layers % S != 0:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by pp {S}")
+        l_local = cfg.num_layers // S
+
+        cos, sin = attn_mod.precompute_rope(
+            cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta,
+            use_scaled=cfg.rope_scaling)
+
+        embed_p = jax.tree_util.tree_map(eng.stage_replicated_param,
+                                         p["model"]["embed"])
+        x = embed_mod.apply({"params": embed_p}, ids)
+        x_mb = eng.microbatch(x, M)
+
+        body = nn.scan(
+            _MoEScanBody,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+            length=l_local,
+        )(cfg)
+
+        def stage_fn(act):
+            out, aux = body.apply({"params": p["model"]["layers"]}, act,
+                                  cos, sin, None)
+            # aux: [l_local, 2] per-layer (load_balance, z) — sum layers
+            return out, jnp.sum(aux, axis=0)
+
+        if cfg.remat:
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+        outs, aux_local = eng.pipeline_spmd(stage_fn, x_mb, S, M,
+                                            with_aux=True)
+        # global router aux: sum over stages with the fwd-psum/bwd-identity
+        # mapping (raw psum would transpose to psum and hand every stage
+        # S copies of the cotangent), then mean over microbatches
+        aux_total = mappings.reduce_from_tensor_parallel_region(
+            aux_local, ps.PP_AXIS) / M
+
+        norm_p = jax.tree_util.tree_map(eng.stage_replicated_param,
+                                        p["model"]["norm"])
+        head_p = jax.tree_util.tree_map(eng.stage_replicated_param,
+                                        p["lm_head"])
+        labels_mb = eng.microbatch(labels, M)
+
+        def mb_loss(carry, om):
+            o, lb = om
+            h = norm_mod.apply({"params": norm_p}, o)
+            logits = head_mod.apply({"params": head_p}, h)
+            per_tok = lf.parallel_cross_entropy(logits, lb,
+                                                ignore_index=ignore_index)
+            n_valid = jnp.sum((lb != ignore_index).astype(jnp.float32))
+            return (carry[0] + jnp.sum(per_tok), carry[1] + n_valid), None
+
+        (loss_sum, denom), _ = jax.lax.scan(
+            mb_loss,
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (outs, labels_mb))
+        ce = eng.last_stage_value(loss_sum / jnp.maximum(denom, 1.0))
+        loss = (ce + cfg.router_aux_coef * aux_total[0]
+                + cfg.router_z_coef * aux_total[1])
+        return eng.data_parallel_mean(loss)
+
+    return pp_loss
+
+
+def make_moe_pipeline_grad_fn(cfg: MixtralConfig, num_microbatches: int,
+                              param_specs: Any, ignore_index: int = -100):
+    """``grad_fn(params, batch) -> (loss, grads)`` for
+    :func:`..trainer.make_train_step` (GPipe schedule; cf.
+    :func:`.llama_pipeline.make_pipeline_grad_fn`)."""
+    from ..parallel import grads as grads_mod
+
+    pp_loss = pipelined_moe_loss_fn(cfg, num_microbatches, ignore_index)
+
+    def inner(params, ids, labels):
+        loss, g = jax.value_and_grad(pp_loss)(params, ids, labels)
+        g = grads_mod.allreduce_gradients(g, specs=param_specs)
+        return loss, g
+
+    def grad_fn(params, batch):
+        mesh = ps.get_mesh()
+        return ps.shard_map(
+            inner, mesh,
+            in_specs=(param_specs, P(ps.DP_AXIS, None), P(ps.DP_AXIS, None)),
+            out_specs=(P(), param_specs))(
+                params, batch["input_ids"], batch["labels"])
+
+    return grad_fn

@@ -164,9 +164,14 @@ class ExpertMLPs(nn.Module):
         ys = bw.grouped_glu(xs, gate_up.astype(self.dtype),
                             down.astype(self.dtype), be, self.block_size,
                             bi, interpret)
+        # combining shard-partial expert outputs is forward-equivalent to
+        # combining the tp-reduced ones, but the gates' (hence router's)
+        # gradient d y/d gate = expert output must be tp-complete: enter
+        # the gates through copy_to (fwd identity, bwd psum of the tiny
+        # [T, K] gate cotangent), then reduce the combined [T, H] — the
+        # cheapest placement (r2 bug found via the MoE x PP parity test)
+        gates = mappings.copy_to_tensor_parallel_region(gates, self.tp_axis)
         y = bw.combine_from_blocks(ys, gates, order, src, dest, t)
-        # expert-fused row-parallel exit: partial sums over the tp shard of
-        # the intermediate dim
         y = mappings.reduce_from_tensor_parallel_region(y, self.tp_axis)
         aux = {"dropped_fraction": jnp.zeros((), jnp.float32)}
         return y.astype(self.dtype), aux

@@ -61,17 +61,28 @@ def pipeline_spmd(
     num_stages: int,
     num_microbatches: int,
     axis: str = ps.PP_AXIS,
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Run the scanned GPipe pipeline. Must be called with ``axis`` bound
     (inside shard_map).
 
     Args:
       stage_fn: this stage's computation, applied to one microbatch of
-        activations (closing over this stage's local params).
+        activations (closing over this stage's local params). With
+        ``with_aux`` it returns ``(act, aux)`` where ``aux`` is a pytree of
+        per-stage scalars (e.g. MoE router losses).
       x_mb: ``[M, mb, ...]`` stage-0 input microbatches (replicated over pp).
 
     Returns ``[M, mb, ...]`` outputs, **valid on the last pp rank only**
-    (other ranks carry bubble garbage; mask before use).
+    (other ranks carry bubble garbage; mask before use). With ``with_aux``
+    returns ``(outputs, aux_sum)`` where ``aux_sum`` is this stage's aux
+    summed over its M *valid* ticks (stage s computes microbatch m at tick
+    ``s + m``; bubble ticks are masked out) — still per-stage-local. For
+    the differentiated global total use
+    ``mappings.reduce_from_tensor_parallel_region(aux_sum, PP_AXIS)``
+    (fwd psum, bwd identity); raw ``lax.psum`` transposes to psum under
+    check_vma=False and would hand every stage S copies of the cotangent
+    (see the module invariants above).
     """
     S, M = num_stages, num_microbatches
     bound = comm._axis_size(axis)
@@ -90,13 +101,24 @@ def pipeline_spmd(
         inp = lax.dynamic_index_in_dim(x_mb, jnp.minimum(t, M - 1), 0,
                                        keepdims=False)
         act_in = jnp.where(my == 0, inp, act)
-        out = stage_fn(act_in)
+        if with_aux:
+            out, aux = stage_fn(act_in)
+            # this stage's valid ticks are [my, my + M)
+            valid = ((t >= my) & (t < my + M)).astype(jnp.float32)
+            aux = jax.tree_util.tree_map(lambda a: a * valid, aux)
+        else:
+            out = stage_fn(act_in)
+            aux = None
         act_next = comm.ppermute(out, axis, perm)
-        return act_next, out
+        return act_next, (out, aux) if with_aux else out
 
     act0 = jnp.zeros_like(x_mb[0])
     _, ys = lax.scan(tick, act0, jnp.arange(ticks))
     # microbatch m finishes on the last stage at tick m + S - 1
+    if with_aux:
+        outs, auxs = ys
+        aux_sum = jax.tree_util.tree_map(lambda a: jnp.sum(a, axis=0), auxs)
+        return outs[S - 1:], aux_sum
     return ys[S - 1:]
 
 
