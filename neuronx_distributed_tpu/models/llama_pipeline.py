@@ -244,6 +244,9 @@ def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
     :func:`interleave_pipeline_params` (and back with
     :func:`deinterleave_pipeline_params` before checkpoint export); passing
     a canonical-order tree would silently train a layer-permuted model.
+
+    NOTE: :func:`.mixtral_pipeline.make_moe_1f1b_grad_fn` mirrors this
+    scaffolding (adding router-aux seeding); keep the two in sync.
     """
     from ..parallel import grads as grads_mod
     from ..pipeline import engine_1f1b as e1

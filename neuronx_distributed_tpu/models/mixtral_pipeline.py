@@ -146,3 +146,114 @@ def make_moe_pipeline_grad_fn(cfg: MixtralConfig, num_microbatches: int,
                 params, batch["input_ids"], batch["labels"])
 
     return grad_fn
+
+
+def make_moe_1f1b_grad_fn(cfg: MixtralConfig, num_microbatches: int,
+                          param_specs: Any, num_chunks: int = 1,
+                          ignore_index: int = -100):
+    """Explicit 1F1B / interleaved executor for the MoE decoder
+    (:mod:`..pipeline.engine_1f1b` with ``aux_weight`` seeding the router
+    aux cotangents) — the memory profile DBRX-scale MoE needs under pp.
+
+    For ``num_chunks > 1`` the layer-stack params must already be in
+    *interleaved* order — convert with
+    :func:`.llama_pipeline.interleave_pipeline_params` (generic over the
+    scanned ``model/layers`` subtree); a canonical-order tree would
+    silently train a layer-permuted model.
+
+    NOTE: mirrors :func:`.llama_pipeline.make_1f1b_grad_fn` (which adds
+    sequence-parallel + tied embeddings but no aux); keep the scaffolding
+    of the two in sync."""
+    from ..parallel import grads as grads_mod
+    from ..pipeline import engine_1f1b as e1
+
+    if not cfg.scan_layers:
+        raise ValueError("pipeline path requires scan_layers=True")
+    if cfg.sequence_parallel:
+        raise NotImplementedError(
+            "sequence_parallel under the MoE pipeline path is not yet "
+            "supported")
+    C = num_chunks
+
+    embed_mod = pl.ParallelEmbedding(
+        num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+    norm_mod = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype)
+    head_mod = pl.ColumnParallelLinear(
+        features=cfg.vocab_size, use_bias=False, gather_output=False,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+
+    def inner(params, ids, labels):
+        p = params["params"]
+        S = ps.get_pipeline_model_parallel_size()
+        M = num_microbatches
+        if cfg.num_layers % (S * C) != 0:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by "
+                f"stages*chunks {S * C}")
+        lv = cfg.num_layers // (S * C)
+        denom = jnp.maximum(
+            jnp.sum(labels != ignore_index).astype(jnp.float32), 1.0)
+        cos, sin = attn_mod.precompute_rope(
+            cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta,
+            use_scaled=cfg.rope_scaling)
+
+        def embed_fn(ep, ids_):
+            return embed_mod.apply({"params": ep}, ids_)
+
+        body = nn.scan(
+            _MoEScanBody,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+            length=lv,
+        )(cfg)
+
+        def stage_fn(chunk_p, act):
+            out, aux = body.apply({"params": chunk_p}, act, cos, sin, None)
+            return out, jnp.sum(aux, axis=0).astype(jnp.float32)
+
+        if cfg.remat:
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+        def head_loss_fn(hp, act, lb):
+            h = norm_mod.apply({"params": hp["norm"]}, act)
+            logits = head_mod.apply({"params": hp["lm_head"]}, h)
+            per_tok = lf.parallel_cross_entropy(logits, lb,
+                                                ignore_index=ignore_index)
+            return jnp.sum(per_tok) / denom
+
+        layers_c = jax.tree_util.tree_map(
+            lambda x: x.reshape((C, lv) + x.shape[1:]), p["model"]["layers"])
+        eng_params = {"embed": p["model"]["embed"], "layers": layers_c,
+                      "head": {"norm": p["model"]["norm"],
+                               "lm_head": p["lm_head"]}}
+        ids_mb = eng.microbatch(ids, M)
+        labels_mb = eng.microbatch(labels, M)
+        aux_weight = jnp.asarray(
+            [cfg.router_aux_coef, cfg.router_z_coef], jnp.float32) / M
+
+        loss, g = e1.pipeline_1f1b_grads(
+            embed_fn, stage_fn, head_loss_fn, eng_params, ids_mb, labels_mb,
+            num_stages=S, num_microbatches=M, num_chunks=C,
+            aux_weight=aux_weight)
+
+        g_layers = jax.tree_util.tree_map(
+            lambda x: x.reshape((C * lv,) + x.shape[2:]), g["layers"])
+        grads = {"params": {
+            "model": {"embed": g["embed"], "layers": g_layers,
+                      "norm": g["head"]["norm"]},
+            "lm_head": g["head"]["lm_head"]}}
+        grads = grads_mod.allreduce_gradients(grads, specs=param_specs)
+        return eng.data_parallel_mean(loss), grads
+
+    def grad_fn(params, batch):
+        mesh = ps.get_mesh()
+        return ps.shard_map(
+            inner, mesh,
+            in_specs=(param_specs, P(ps.DP_AXIS, None), P(ps.DP_AXIS, None)),
+            out_specs=(P(), param_specs))(
+                params, batch["input_ids"], batch["labels"])
+
+    return grad_fn

@@ -53,7 +53,7 @@ psum'd over pp here so every rank returns identical values.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -102,6 +102,7 @@ def pipeline_1f1b_grads(
     num_microbatches: int,
     num_chunks: int = 1,
     axis: str = ps.PP_AXIS,
+    aux_weight: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Dict[str, Any]]:
     """Run the full 1F1B (or interleaved, ``num_chunks>1``) fwd+bwd pipeline.
 
@@ -112,6 +113,8 @@ def pipeline_1f1b_grads(
         prologue (embedding (+ SP scatter)).
       stage_fn: ``(chunk_params, act) -> act`` — one chunk of this stage's
         layer stack; ``chunk_params`` has the chunk dim already selected.
+        With ``aux_weight`` it returns ``(act, aux [A])`` — per-chunk
+        auxiliary scalars (MoE router losses).
       head_loss_fn: ``(head_params, act, labels [mb, seq]) -> scalar`` —
         last-stage epilogue returning this microbatch's *contribution to the
         local mean loss* (i.e. already divided by the local batch token
@@ -120,6 +123,11 @@ def pipeline_1f1b_grads(
         ``layers`` leads with a ``[C, lv, ...]`` chunk dim (``C=1`` for plain
         1F1B).
       ids_mb / labels_mb: ``[M, mb, seq]``.
+      aux_weight: ``[A]`` — d(loss)/d(aux element) per forward invocation
+        (e.g. router coefficients already divided by M). The aux total joins
+        the loss as a primal, and every backward sub-slot seeds the aux
+        cotangent with ``aux_weight`` explicitly, so aux gradients are
+        exact without any cross-stage cotangent plumbing.
 
     Returns ``(local_loss, grads)`` with ``grads`` shaped like ``params``
     (pp-replicated leaves already psum'd over pp; data-axis sync is the
@@ -167,8 +175,15 @@ def pipeline_1f1b_grads(
         c, j = r // S, r % S
         return valid, g * S + j, c
 
+    has_aux = aux_weight is not None
+
+    def stage_call(chunk_p, act):
+        res = stage_fn(chunk_p, act)
+        return res if has_aux else (res, jnp.zeros((0,), jnp.float32))
+
     def tick(carry, t):
-        (buf, act_recv, grad_recv, g_layers, g_embed, g_head, loss_acc) = carry
+        (buf, act_recv, grad_recv, g_layers, g_embed, g_head, loss_acc,
+         aux_acc) = carry
 
         # ---- forward sub-slot -------------------------------------------
         fvalid, f, c_f = slot_decode(t - my)
@@ -181,7 +196,9 @@ def pipeline_1f1b_grads(
             lambda ep, i: zero_act,
             embed_p, ids_f)
         inp = jnp.where((my == 0) & (c_f == 0), x_emb, act_recv)
-        out = stage_fn(pick_chunk(c_f), inp)
+        out, aux_f = stage_call(pick_chunk(c_f), inp)
+        aux_acc = aux_acc + aux_f.astype(jnp.float32) * fvalid.astype(
+            jnp.float32)
         prev_in_slot = lax.dynamic_index_in_dim(buf, sigma_f % W, 0,
                                                 keepdims=False)
         buf = lax.dynamic_update_index_in_dim(
@@ -217,9 +234,11 @@ def pipeline_1f1b_grads(
         # input, vjp into (chunk params, input activation) ----------------
         saved_in = lax.dynamic_index_in_dim(buf, sigma_b % W, 0,
                                             keepdims=False)
-        _, s_vjp = jax.vjp(stage_fn, pick_chunk(c_b), saved_in)
-        dchunk, dact_in = s_vjp(dout.astype(act_shape.dtype))
         bmask = bvalid.astype(jnp.float32)
+        _, s_vjp = jax.vjp(stage_call, pick_chunk(c_b), saved_in)
+        aux_ct = (aux_weight.astype(jnp.float32) * bmask if has_aux
+                  else jnp.zeros((0,), jnp.float32))
+        dchunk, dact_in = s_vjp((dout.astype(act_shape.dtype), aux_ct))
         g_layers = jax.tree_util.tree_map(
             lambda acc, g: lax.dynamic_update_index_in_dim(
                 acc,
@@ -247,7 +266,7 @@ def pipeline_1f1b_grads(
         act_next = comm.ppermute(out, axis, fwd_perm)
         grad_next = comm.ppermute(dact_in, axis, bwd_perm)
         return (buf, act_next, grad_next, g_layers, g_embed, g_head,
-                loss_acc), None
+                loss_acc, aux_acc), None
 
     carry0 = (
         jnp.zeros((W,) + tuple(act_shape.shape), act_shape.dtype),
@@ -257,14 +276,16 @@ def pipeline_1f1b_grads(
         f32(embed_p),
         f32(head_p),
         jnp.zeros((), jnp.float32),
+        jnp.zeros((aux_weight.shape[0] if has_aux else 0,), jnp.float32),
     )
-    (_, _, _, g_layers, g_embed, g_head, loss_acc), _ = lax.scan(
+    (_, _, _, g_layers, g_embed, g_head, loss_acc, aux_acc), _ = lax.scan(
         tick, carry0, jnp.arange(T))
 
     # loss lives on the last stage; replicate over pp (primal psum is safe —
     # no cotangent crosses here, grads are already explicit)
     if bound is not None and bound > 1:
         loss = lax.psum(jnp.where(my == S - 1, loss_acc, 0.0), axis)
+        aux_acc = lax.psum(aux_acc, axis)
         g_embed = jax.tree_util.tree_map(
             lambda g: lax.psum(jnp.where(my == 0, g, jnp.zeros_like(g)),
                                axis), g_embed)
@@ -273,4 +294,6 @@ def pipeline_1f1b_grads(
                                axis), g_head)
     else:
         loss = loss_acc
+    if has_aux:
+        loss = loss + jnp.dot(aux_acc, aux_weight.astype(jnp.float32))
     return loss, {"embed": g_embed, "layers": g_layers, "head": g_head}
