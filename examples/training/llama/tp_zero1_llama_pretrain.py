@@ -65,6 +65,8 @@ def main(argv=None):
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--warmup-steps", type=int, default=0,
+                    help="linear warmup + cosine decay over --steps")
     ap.add_argument("--data", default=None)
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument("--no-zero1", action="store_true")
@@ -86,7 +88,14 @@ def main(argv=None):
     sample = next(data)
     pm, params = initialize_parallel_model(cfg, model, jax.random.key(0),
                                            sample["input_ids"])
-    tx, state, sh = initialize_parallel_optimizer(pm, params, args.lr)
+    lr = args.lr
+    if args.warmup_steps > 0:
+        from neuronx_distributed_tpu.trainer import (
+            linear_warmup_cosine_decay)
+
+        lr = linear_warmup_cosine_decay(args.lr, args.warmup_steps,
+                                        args.steps)
+    tx, state, sh = initialize_parallel_optimizer(pm, params, lr)
     step = make_train_step(pm, tx, sh)
 
     callbacks = [MetricsLogger(every=10)]

@@ -1,7 +1,12 @@
 """Training orchestration (reference: ``trainer/`` + ``optimizer/``)."""
 
 from . import optimizer
+from . import schedules
 from . import trainer
+from .schedules import (
+    linear_warmup_cosine_decay,
+    linear_warmup_linear_decay,
+)
 from .trainer import (
     TrainState,
     ParallelModel,
@@ -12,10 +17,13 @@ from .trainer import (
 
 __all__ = [
     "optimizer",
+    "schedules",
     "trainer",
     "TrainState",
     "ParallelModel",
     "initialize_parallel_model",
     "initialize_parallel_optimizer",
     "make_train_step",
+    "linear_warmup_cosine_decay",
+    "linear_warmup_linear_decay",
 ]

@@ -1,0 +1,35 @@
+"""Learning-rate schedules.
+
+The reference's launchers drive ``get_linear_schedule_with_warmup`` /
+cosine variants from ``transformers.optimization``
+(``examples/training/llama/tp_zero1_llama_hf_pretrain/tp_zero1_llama_hf_pretrain.py:38``).
+Here they are optax schedules, passed directly as the ``learning_rate`` of
+:func:`.trainer.initialize_parallel_optimizer` (optax treats a callable lr
+as a per-step schedule; the count lives in the optimizer state, so resume
+restores it).
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def linear_warmup_linear_decay(peak_lr: float, warmup_steps: int,
+                               total_steps: int,
+                               end_lr: float = 0.0) -> optax.Schedule:
+    """The reference's default pretraining schedule
+    (``get_linear_schedule_with_warmup``)."""
+    return optax.join_schedules([
+        optax.linear_schedule(0.0, peak_lr, max(warmup_steps, 1)),
+        optax.linear_schedule(peak_lr, end_lr,
+                              max(total_steps - warmup_steps, 1)),
+    ], boundaries=[warmup_steps])
+
+
+def linear_warmup_cosine_decay(peak_lr: float, warmup_steps: int,
+                               total_steps: int,
+                               end_lr_ratio: float = 0.1) -> optax.Schedule:
+    """Warmup + cosine decay (the reference's cosine variant)."""
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=peak_lr, warmup_steps=warmup_steps,
+        decay_steps=total_steps, end_value=peak_lr * end_lr_ratio)

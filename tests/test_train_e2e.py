@@ -152,3 +152,42 @@ def test_grad_accumulation_matches_full_batch():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=1e-6,
                                    err_msg=jax.tree_util.keystr(p1))
+
+
+def test_lr_schedules():
+    """Reference-style warmup schedules drive the optimizer via optax's
+    callable learning_rate; training runs with a schedule."""
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model, initialize_parallel_optimizer,
+        make_train_step)
+    from neuronx_distributed_tpu.trainer.schedules import (
+        linear_warmup_cosine_decay, linear_warmup_linear_decay)
+
+    s = linear_warmup_linear_decay(1e-3, warmup_steps=10, total_steps=110)
+    assert float(s(0)) == 0.0
+    np.testing.assert_allclose(float(s(10)), 1e-3, rtol=1e-6)
+    np.testing.assert_allclose(float(s(60)), 5e-4, rtol=1e-2)
+    c = linear_warmup_cosine_decay(1e-3, warmup_steps=10, total_steps=110)
+    np.testing.assert_allclose(float(c(10)), 1e-3, rtol=1e-2)
+    assert float(c(110)) < 2e-4
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=2)
+    mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                       num_layers=1)
+    model = LlamaForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (4, 17), 0,
+                             mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           batch["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(
+        pm, params, learning_rate=linear_warmup_cosine_decay(3e-3, 2, 20))
+    step = make_train_step(pm, tx, sh)
+    losses = []
+    for _ in range(6):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
