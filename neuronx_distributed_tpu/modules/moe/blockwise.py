@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ...ops.flash_attention import _compiler_params  # shared Mosaic config
+
 
 def round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
@@ -240,6 +242,7 @@ def _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
         out_shape=jax.ShapeDtypeStruct((p, h), xs.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
     )(block_expert, xs, gate_up, down)
 
 
@@ -271,6 +274,7 @@ def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,
                                    lambda b, ib, be: (b, 0)),
         ),
         interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
     )(block_expert, xs, gate_up, down, dy)
 
     dgu, ddn = pl.pallas_call(
@@ -296,6 +300,7 @@ def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,
             ],
         ),
         interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
     )(block_expert, xs, gate_up, down, dy)
     return dx, dgu.astype(gate_up.dtype), ddn.astype(down.dtype)
 

@@ -25,6 +25,15 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _compiler_params():
+    """Mosaic params for the compiled TPU path. The default 16 MiB scoped
+    VMEM limit rejects 7B-scale tiles (fp32 staging of one (h, 2, block_i)
+    weight tile is already ~8 MiB); v5e has 128 MiB physical VMEM."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+
+
 def _block_attention(q, k_blk, v_blk, q_pos, k_pos_start, block_k, causal,
                      scale):
     """Scores and partial PV for one KV block. q: [B,N,Sq,D],
@@ -144,8 +153,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     def _finalize():
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)[:, None]
                     ).astype(o_ref.dtype)
-        # log-sum-exp per query row (softmax stats for the flash backward)
-        lse_ref[0] = jnp.where(
+        # log-sum-exp per query row (softmax stats for the flash backward).
+        # lse block is (1, 1, block_q): 3D so the sublane dim (=1) equals the
+        # array dim — Mosaic's (8, 128) tiling rule for 2D blocks would
+        # reject a (1, block_q) block on a (b*n, sq) array.
+        lse_ref[0, 0] = jnp.where(
             l_ref[:] > 0, m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30)),
             -jnp.inf)
 
@@ -182,7 +194,7 @@ def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
                           block_k=block_k, num_kb=num_kb, causal=causal,
                           scale=scale),
         out_shape=[jax.ShapeDtypeStruct((b * n, sq, d), q.dtype),
-                   jax.ShapeDtypeStruct((b * n, sq), jnp.float32)],
+                   jax.ShapeDtypeStruct((b * n, 1, sq), jnp.float32)],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
@@ -190,11 +202,13 @@ def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
             pl.BlockSpec((1, block_k, d), kv_index),
         ],
         out_specs=[pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
-                   pl.BlockSpec((1, block_q), lambda i, j, kb: (i, j))],
+                   pl.BlockSpec((1, 1, block_q),
+                                lambda i, j, kb: (i, 0, j))],
         scratch_shapes=[pltpu.VMEM((block_q,), jnp.float32),
                         pltpu.VMEM((block_q,), jnp.float32),
                         pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
     )(qt, kt, vt)
     return (jnp.swapaxes(out.reshape(b, n, sq, d), 1, 2),
             lse.reshape(b, n, sq))
@@ -279,8 +293,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         k_blk = k_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
         g = g_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -320,8 +334,8 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref,
         k_blk = k_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
         g = g_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -357,8 +371,11 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k, scale,
     vt = jnp.swapaxes(v, 1, 2).reshape(b * n, sk, d)
     gt = jnp.swapaxes(g, 1, 2).reshape(b * n, sq, d)
     ot = jnp.swapaxes(out, 1, 2).reshape(b * n, sq, d)
-    lse_t = lse.reshape(b * n, sq)
-    delta = jnp.sum(gt.astype(jnp.float32) * ot.astype(jnp.float32), -1)
+    # stats carried 3D (b*n, 1, sq) so their (1, 1, block_q) blocks satisfy
+    # Mosaic's sublane tiling rule (see _flash_pallas_fwd)
+    lse_t = lse.reshape(b * n, 1, sq)
+    delta = jnp.sum(gt.astype(jnp.float32) * ot.astype(jnp.float32), -1,
+                    keepdims=True).reshape(b * n, 1, sq)
     num_qb, num_kb = sq // block_q, sk // block_k
 
     kv_index = _causal_kv_index(causal, block_q, block_k)
@@ -368,13 +385,13 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k, scale,
             return (i, jnp.maximum(j, (kb * block_k) // block_q), 0)
 
         def qrow_index(i, kb, j):
-            return (i, jnp.maximum(j, (kb * block_k) // block_q))
+            return (i, 0, jnp.maximum(j, (kb * block_k) // block_q))
     else:
         def q_index(i, kb, j):
             return (i, j, 0)
 
         def qrow_index(i, kb, j):
-            return (i, j)
+            return (i, 0, j)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
@@ -387,12 +404,13 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k, scale,
             pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j, kb: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j, kb: (i, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
     )(qt, kt, vt, gt, lse_t, delta)
 
     dk, dv = pl.pallas_call(
@@ -407,8 +425,8 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k, scale,
             pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
             pl.BlockSpec((1, block_q, d), q_index),
             pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, block_q), qrow_index),
-            pl.BlockSpec((1, block_q), qrow_index),
+            pl.BlockSpec((1, 1, block_q), qrow_index),
+            pl.BlockSpec((1, 1, block_q), qrow_index),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
@@ -417,6 +435,7 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k, scale,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
     )(kt, vt, qt, gt, lse_t, delta)
 
     return (jnp.swapaxes(dq.reshape(b, n, sq, d), 1, 2),
@@ -450,8 +469,8 @@ _flash_pallas.defvjp(_flash_pallas_vjp_fwd, _flash_pallas_vjp_bwd)
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "scale", "force_pallas"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True, block_q: int = 256,
-                    block_k: int = 256,
+                    causal: bool = True, block_q: int = 512,
+                    block_k: int = 512,
                     scale: Optional[float] = None,
                     force_pallas: Optional[bool] = None) -> jax.Array:
     """Flash attention entry point: Pallas kernel on TPU when the shapes
@@ -460,9 +479,16 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     b, sq, n, d = q.shape
     sk = k.shape[1]
     scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
-    # clamp block sizes to the sequence before any divisibility decision
+    # clamp block sizes to the sequence before any divisibility decision,
+    # then shrink (in 128-steps) to a size that divides the sequence — so a
+    # seq divisible by 256 but not 512 still takes the Pallas path with
+    # 256-blocks instead of silently demoting to the XLA fallback
     bq = min(block_q, sq)
     bk = min(block_k, sk)
+    while bq > 128 and bq % 128 == 0 and sq % bq != 0:
+        bq -= 128
+    while bk > 128 and bk % 128 == 0 and sk % bk != 0:
+        bk -= 128
     # Mosaic tiling: d and (because the lse output's lane dim is block_q)
     # the block sizes must be 128-aligned for the compiled TPU path; the
     # force path accepts 8-aligned blocks (interpret mode / expert use)

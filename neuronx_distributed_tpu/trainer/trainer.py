@@ -167,6 +167,7 @@ def make_train_step(
     batch_spec: PartitionSpec = PartitionSpec(ps.DP_AXIS),
     donate: bool = True,
     grad_accum_steps: int = 1,
+    scan_steps: int = 1,
 ):
     """Build the jitted SPMD train step.
 
@@ -194,6 +195,8 @@ def make_train_step(
     if grad_accum_steps < 1:
         raise ValueError(f"grad_accum_steps must be >= 1, got "
                          f"{grad_accum_steps}")
+    if scan_steps < 1:
+        raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")
     if loss_fn is None and grad_fn is None:
         def loss_fn(module, params, batch):
             input_ids, labels = batch["input_ids"], batch["labels"]
@@ -252,6 +255,27 @@ def make_train_step(
                           opt_state=new_opt), metrics
 
     batch_shardings = NamedSharding(mesh, batch_spec)
+    if scan_steps > 1:
+        # run `scan_steps` optimizer steps in ONE dispatch: batch leaves gain
+        # a leading scan dim. Keeps host round-trips (and, through remote
+        # tunnels, dispatch latency) out of the training loop — the XLA
+        # program is the same per-step program, iterated on device.
+        def multi_step_fn(state: TrainState, batches):
+            def body(s, mb):
+                s2, metrics = step_fn(s, mb)
+                return s2, metrics
+            state, ms = jax.lax.scan(body, state, batches)
+            last = jax.tree_util.tree_map(lambda x: x[-1], ms)
+            return state, last
+
+        multi_batch_shardings = NamedSharding(
+            mesh, PartitionSpec(None, *batch_spec))
+        return jax.jit(
+            multi_step_fn,
+            in_shardings=(state_shardings, multi_batch_shardings),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,) if donate else (),
+        )
     return jax.jit(
         step_fn,
         in_shardings=(state_shardings, batch_shardings),

@@ -1,0 +1,158 @@
+"""Per-kernel TPU microbenchmarks: Pallas vs XLA formulations.
+
+Dev harness; writes a markdown table to stdout for BASELINE.md.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+_RTT_MS = None
+
+
+def _tunnel_rtt_ms():
+    """One-scalar fetch round-trip through the axon tunnel; subtracted from
+    chained timings (the tunnel's ``block_until_ready`` is a NO-OP — only a
+    host fetch synchronizes, observed 2026-07-29)."""
+    global _RTT_MS
+    if _RTT_MS is None:
+        x = jnp.float32(1.0) + 1
+        float(x)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            float(jnp.float32(1.0) + 1)
+        _RTT_MS = (time.perf_counter() - t0) / 5 * 1e3
+    return _RTT_MS
+
+
+def timeit(fn, *args, iters=20):
+    """Times fn with a data dependency chained through iterations (the first
+    arg is perturbed by the previous output's first leaf) and ONE host fetch
+    at the end — block_until_ready does not synchronize through the axon
+    tunnel, so the fetch is the only trustworthy barrier. Returns ms/iter
+    with the single fetch's RTT share subtracted."""
+    args = list(args)
+
+    def step(a0, *rest):
+        out = fn(a0, *rest)
+        # keep EVERY output leaf alive (summing just one would let XLA
+        # dead-code-eliminate the rest of the computation inside run_n)
+        probe = sum(jnp.mean(leaf.astype(jnp.float32))
+                    for leaf in jax.tree_util.tree_leaves(out))
+        # genuinely perturb (tiny but nonzero) so no layer can value-cache
+        return a0 + (probe * 1e-12).astype(a0.dtype)
+
+    from jax import lax
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def run_n(n, a0, rest):
+        def body(a0, _):
+            return step(a0, *rest), None
+        a0, _ = lax.scan(body, a0, None, length=n)
+        return jnp.sum(a0.astype(jnp.float32))
+
+    def run(n):
+        t0 = time.perf_counter()
+        float(run_n(n, args[0], args[1:]))  # host fetch = the true barrier
+        return (time.perf_counter() - t0) * 1e3
+
+    run(1)  # compile n=1
+    run(iters)  # compile n=iters (hits both executables)
+    run(2 * iters)
+    # slope timing: the loop lives inside jit (ONE tunnel dispatch per run);
+    # (t(2N) - t(N)) / N cancels dispatch+fetch RTT entirely
+    t1 = min(run(iters), run(iters))
+    t2 = min(run(2 * iters), run(2 * iters))
+    return max(t2 - t1, 0.0) / iters
+
+
+def bench_flash():
+    from neuronx_distributed_tpu.ops.flash_attention import (
+        flash_attention, flash_attention_xla)
+    b, s, n, d = 8, 2048, 8, 128
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, n, d), jnp.bfloat16) for kk in ks)
+
+    rows = []
+    xla_t = timeit(lambda q, k, v: flash_attention_xla(q, k, v, causal=True),
+                   q, k, v)
+    rows.append(("flash fwd XLA-scan", xla_t))
+    for bq, bk in [(128, 128), (256, 256), (512, 512), (256, 512),
+                   (512, 256), (1024, 512)]:
+        f = jax.jit(functools.partial(flash_attention, causal=True,
+                                      block_q=bq, block_k=bk,
+                                      force_pallas=True))
+        rows.append((f"flash fwd Pallas bq={bq} bk={bk}", timeit(f, q, k, v)))
+
+    def g_xla(q, k, v):
+        return jax.grad(lambda *a: jnp.sum(
+            flash_attention_xla(*a, causal=True).astype(jnp.float32)),
+            argnums=(0, 1, 2))(q, k, v)
+
+    rows.append(("flash fwd+bwd XLA-scan", timeit(jax.jit(g_xla), q, k, v)))
+    for bq, bk in [(128, 128), (256, 256), (512, 512)]:
+        def g_p(q, k, v, bq=bq, bk=bk):
+            return jax.grad(lambda *a: jnp.sum(flash_attention(
+                *a, causal=True, block_q=bq, block_k=bk,
+                force_pallas=True).astype(jnp.float32)),
+                argnums=(0, 1, 2))(q, k, v)
+        rows.append((f"flash fwd+bwd Pallas bq={bq} bk={bk}",
+                     timeit(jax.jit(g_p), q, k, v)))
+    return rows
+
+
+def bench_glu():
+    from neuronx_distributed_tpu.modules.moe.blockwise import grouped_glu
+    E, h, I = 8, 1024, 2816
+    block_size, block_i = 256, 256
+    nb = 16
+    P = nb * block_size
+    kx, kg, kd = jax.random.split(jax.random.key(1), 3)
+    xs = jax.random.normal(kx, (P, h), jnp.bfloat16) * 0.1
+    gate_up = jax.random.normal(kg, (E, h, 2, I), jnp.bfloat16) * 0.05
+    down = jax.random.normal(kd, (E, I, h), jnp.bfloat16) * 0.05
+    block_expert = jnp.arange(nb, dtype=jnp.int32) % E
+
+    rows = []
+
+    def dense(xs, gate_up, down):
+        # capacity-style: every block through every expert then select
+        xb = xs.reshape(nb, block_size, h)
+        g = jnp.einsum("bph,ehi->bepi", xb, gate_up[:, :, 0])
+        u = jnp.einsum("bph,ehi->bepi", xb, gate_up[:, :, 1])
+        a = jax.nn.silu(g) * u
+        y = jnp.einsum("bepi,eih->beph", a, down)
+        sel = jax.nn.one_hot(block_expert, E, dtype=y.dtype)
+        return jnp.einsum("beph,be->bph", y, sel).reshape(P, h)
+
+    rows.append(("groupedGLU dense-all-experts einsum",
+                 timeit(jax.jit(dense), xs, gate_up, down)))
+    for bs, bi in [(128, 256), (256, 256), (256, 512), (512, 512)]:
+        if P % bs:
+            continue
+        nb2 = P // bs
+        be2 = jnp.arange(nb2, dtype=jnp.int32) % E
+        f = jax.jit(functools.partial(grouped_glu, block_size=bs, block_i=bi,
+                                      interpret=False))
+        rows.append((f"groupedGLU Pallas bs={bs} bi={bi}",
+                     timeit(lambda a, b_, c: f(a, b_, c, be2), xs, gate_up,
+                            down)))
+    return rows
+
+
+def bench_sanity():
+    # 8192^3 bf16 matmul = 1.1 TFLOP; v5e peak 197 TFLOP/s -> >=5.6 ms.
+    # If this row reads faster than that, the timing harness is broken.
+    a = jax.random.normal(jax.random.key(7), (8192, 8192), jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(8), (8192, 8192), jnp.bfloat16)
+    ms = timeit(lambda a, b: a @ b, a, b)
+    tf = 2 * 8192**3 / (ms / 1e3) / 1e12
+    return [(f"sanity matmul 8192^3 ({tf:.0f} TFLOP/s)", ms)]
+
+
+if __name__ == "__main__":
+    print(f"platform: {jax.devices()[0].platform} x{len(jax.devices())}")
+    for name, ms in bench_sanity() + bench_flash() + bench_glu():
+        print(f"| {name} | {ms:.2f} ms |")
