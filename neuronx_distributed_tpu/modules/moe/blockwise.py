@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...ops.flash_attention import _compiler_params  # shared Mosaic config
+from ...ops.pallas_utils import compiler_params as _compiler_params
 
 
 def round_up(x: int, m: int) -> int:
@@ -127,98 +127,113 @@ def _dsilu(x):
     return s * (1 + x * (1 - s))
 
 
-def _glu_fwd_kernel(be_ref, x_ref, gu_ref, dn_ref, y_ref, *, num_ib: int):
+def _glu_fwd_kernel(be_ref, x_ref, gu_ref, dn_ref, y_ref, *, num_ib: int,
+                    num_real: int):
     from jax.experimental import pallas as pl
 
+    b = pl.program_id(0)
     ib = pl.program_id(1)
 
     @pl.when(ib == 0)
     def _init():
+        # unconditional: sentinel blocks' outputs must be ZERO (their
+        # combine gates are zero, but 0 * uninitialized-HBM could be NaN)
         y_ref[...] = jnp.zeros_like(y_ref)
 
-    x = x_ref[...].astype(jnp.float32)                # [B, H]
-    gu = gu_ref[0].astype(jnp.float32)                # [H, 2, bI]
-    g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    a = _silu(g) * u                                  # [B, bI]
-    y_ref[...] = y_ref[...] + jax.lax.dot_general(
-        a, dn_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(y_ref.dtype)
+    @pl.when(be_ref[b] < num_real)
+    def _compute():
+        x = x_ref[...].astype(jnp.float32)            # [B, H]
+        gu = gu_ref[0].astype(jnp.float32)            # [H, 2, bI]
+        g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        a = _silu(g) * u                              # [B, bI]
+        y_ref[...] = y_ref[...] + jax.lax.dot_general(
+            a, dn_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(y_ref.dtype)
 
 
 def _glu_dx_kernel(be_ref, x_ref, gu_ref, dn_ref, dy_ref, dx_ref, *,
-                   num_ib: int):
+                   num_ib: int, num_real: int):
     from jax.experimental import pallas as pl
 
+    b = pl.program_id(0)
     ib = pl.program_id(1)
 
     @pl.when(ib == 0)
     def _init():
         dx_ref[...] = jnp.zeros_like(dx_ref)
 
-    x = x_ref[...].astype(jnp.float32)
-    dy = dy_ref[...].astype(jnp.float32)
-    gu = gu_ref[0].astype(jnp.float32)                # [H, 2, bI]
-    dn = dn_ref[0].astype(jnp.float32)                # [bI, H]
-    g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    da = jax.lax.dot_general(dy, dn, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)  # [B, bI]
-    dg = da * u * _dsilu(g)
-    du = da * _silu(g)
-    dx = jax.lax.dot_general(dg, gu[:, 0], (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    dx = dx + jax.lax.dot_general(du, gu[:, 1], (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-    dx_ref[...] = dx_ref[...] + dx.astype(dx_ref.dtype)
+    @pl.when(be_ref[b] < num_real)
+    def _compute():
+        x = x_ref[...].astype(jnp.float32)
+        dy = dy_ref[...].astype(jnp.float32)
+        gu = gu_ref[0].astype(jnp.float32)            # [H, 2, bI]
+        dn = dn_ref[0].astype(jnp.float32)            # [bI, H]
+        g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        da = jax.lax.dot_general(dy, dn, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dg = da * u * _dsilu(g)
+        du = da * _silu(g)
+        dx = jax.lax.dot_general(dg, gu[:, 0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dx = dx + jax.lax.dot_general(du, gu[:, 1], (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dx_ref[...] = dx_ref[...] + dx.astype(dx_ref.dtype)
 
 
 def _glu_dw_kernel(be_ref, x_ref, gu_ref, dn_ref, dy_ref, dgu_ref, ddn_ref,
-                   *, num_ib: int):
+                   *, num_ib: int, num_real: int):
     """Grid (ib, b): consecutive b of one expert revisit the same dW output
     block, accumulating in VMEM; zero it on the expert's first block."""
     from jax.experimental import pallas as pl
 
     b = pl.program_id(1)
-    first_of_expert = jnp.logical_or(
-        b == 0, be_ref[jnp.maximum(b, 1) - 1] != be_ref[b])
+    # boundaries on the CLAMPED expert id (what the out index_map uses):
+    # sentinel blocks share the last real expert's tile, so the real->
+    # sentinel transition must NOT re-zero that expert's accumulated dW
+    cur = jnp.minimum(be_ref[b], num_real - 1)
+    prev = jnp.minimum(be_ref[jnp.maximum(b, 1) - 1], num_real - 1)
+    first_of_expert = jnp.logical_or(b == 0, prev != cur)
 
     @pl.when(first_of_expert)
     def _init():
         dgu_ref[...] = jnp.zeros_like(dgu_ref)
         ddn_ref[...] = jnp.zeros_like(ddn_ref)
 
-    x = x_ref[...].astype(jnp.float32)
-    dy = dy_ref[...].astype(jnp.float32)
-    gu = gu_ref[0].astype(jnp.float32)
-    dn = dn_ref[0].astype(jnp.float32)
-    g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    a = _silu(g) * u
-    da = jax.lax.dot_general(dy, dn, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    dg = da * u * _dsilu(g)
-    du = da * _silu(g)
-    # ddown[e, ib] += a^T @ dy ; dgu[e, :, 0/1, ib] += x^T @ dg/du
-    ddn_ref[0] = ddn_ref[0] + jax.lax.dot_general(
-        a, dy, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(ddn_ref.dtype)
-    dgw = jax.lax.dot_general(x, dg, (((0,), (0,)), ((), ())),
-                              preferred_element_type=jnp.float32)
-    duw = jax.lax.dot_general(x, du, (((0,), (0,)), ((), ())),
-                              preferred_element_type=jnp.float32)
-    dgu_ref[0] = dgu_ref[0] + jnp.stack([dgw, duw], axis=1).astype(
-        dgu_ref.dtype)
+    @pl.when(be_ref[b] < num_real)
+    def _compute():
+        x = x_ref[...].astype(jnp.float32)
+        dy = dy_ref[...].astype(jnp.float32)
+        gu = gu_ref[0].astype(jnp.float32)
+        dn = dn_ref[0].astype(jnp.float32)
+        g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        a = _silu(g) * u
+        da = jax.lax.dot_general(dy, dn, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dg = da * u * _dsilu(g)
+        du = da * _silu(g)
+        # ddown[e, ib] += a^T @ dy ; dgu[e, :, 0/1, ib] += x^T @ dg/du
+        ddn_ref[0] = ddn_ref[0] + jax.lax.dot_general(
+            a, dy, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(ddn_ref.dtype)
+        dgw = jax.lax.dot_general(x, dg, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        duw = jax.lax.dot_general(x, du, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dgu_ref[0] = dgu_ref[0] + jnp.stack([dgw, duw], axis=1).astype(
+            dgu_ref.dtype)
 
 
 def _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
-                        block_i, interpret):
+                        block_i, interpret, num_real):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -226,19 +241,25 @@ def _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
     e, _, _, i = gate_up.shape
     nb = p // block_size
     num_ib = i // block_i
+    # sentinel blocks (be >= num_real) borrow the LAST real expert's weight
+    # tiles via this clamp — the DMA is elided across a run of sentinel
+    # blocks and the kernels' pl.when guards skip their compute entirely
+    we = functools.partial(jnp.minimum, num_real - 1)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nb, num_ib),
         in_specs=[
             pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
             pl.BlockSpec((1, h, 2, block_i),
-                         lambda b, ib, be: (be[b], 0, 0, ib)),
-            pl.BlockSpec((1, block_i, h), lambda b, ib, be: (be[b], ib, 0)),
+                         lambda b, ib, be: (we(be[b]), 0, 0, ib)),
+            pl.BlockSpec((1, block_i, h),
+                         lambda b, ib, be: (we(be[b]), ib, 0)),
         ],
         out_specs=pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
     )
     return pl.pallas_call(
-        functools.partial(_glu_fwd_kernel, num_ib=num_ib),
+        functools.partial(_glu_fwd_kernel, num_ib=num_ib,
+                          num_real=num_real),
         out_shape=jax.ShapeDtypeStruct((p, h), xs.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
@@ -247,7 +268,7 @@ def _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
 
 
 def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,
-                            block_i, interpret):
+                            block_i, interpret, num_real):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -255,9 +276,11 @@ def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,
     e, _, _, i = gate_up.shape
     nb = p // block_size
     num_ib = i // block_i
+    we = functools.partial(jnp.minimum, num_real - 1)
 
     dx = pl.pallas_call(
-        functools.partial(_glu_dx_kernel, num_ib=num_ib),
+        functools.partial(_glu_dx_kernel, num_ib=num_ib,
+                          num_real=num_real),
         out_shape=jax.ShapeDtypeStruct((p, h), xs.dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -265,9 +288,9 @@ def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,
             in_specs=[
                 pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
                 pl.BlockSpec((1, h, 2, block_i),
-                             lambda b, ib, be: (be[b], 0, 0, ib)),
+                             lambda b, ib, be: (we(be[b]), 0, 0, ib)),
                 pl.BlockSpec((1, block_i, h),
-                             lambda b, ib, be: (be[b], ib, 0)),
+                             lambda b, ib, be: (we(be[b]), ib, 0)),
                 pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
             ],
             out_specs=pl.BlockSpec((block_size, h),
@@ -278,7 +301,8 @@ def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,
     )(block_expert, xs, gate_up, down, dy)
 
     dgu, ddn = pl.pallas_call(
-        functools.partial(_glu_dw_kernel, num_ib=num_ib),
+        functools.partial(_glu_dw_kernel, num_ib=num_ib,
+                          num_real=num_real),
         out_shape=[jax.ShapeDtypeStruct(gate_up.shape, jnp.float32),
                    jax.ShapeDtypeStruct(down.shape, jnp.float32)],
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -287,16 +311,16 @@ def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,
             in_specs=[
                 pl.BlockSpec((block_size, h), lambda ib, b, be: (b, 0)),
                 pl.BlockSpec((1, h, 2, block_i),
-                             lambda ib, b, be: (be[b], 0, 0, ib)),
+                             lambda ib, b, be: (we(be[b]), 0, 0, ib)),
                 pl.BlockSpec((1, block_i, h),
-                             lambda ib, b, be: (be[b], ib, 0)),
+                             lambda ib, b, be: (we(be[b]), ib, 0)),
                 pl.BlockSpec((block_size, h), lambda ib, b, be: (b, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, h, 2, block_i),
-                             lambda ib, b, be: (be[b], 0, 0, ib)),
+                             lambda ib, b, be: (we(be[b]), 0, 0, ib)),
                 pl.BlockSpec((1, block_i, h),
-                             lambda ib, b, be: (be[b], ib, 0)),
+                             lambda ib, b, be: (we(be[b]), ib, 0)),
             ],
         ),
         interpret=interpret,
@@ -309,22 +333,29 @@ def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,
 def grouped_glu(xs, gate_up, down, block_expert, block_size, block_i,
                 interpret):
     """Block-sparse grouped GLU: ``ys[b] = silu(x_b@Wg_e)·(x_b@Wu_e) @ Wd_e``
-    with ``e = block_expert[b]`` (the dropless expert matmul)."""
+    with ``e = block_expert[b]`` (the dropless expert matmul).
+
+    Blocks whose ``block_expert[b] >= E`` (the weight arrays' expert count)
+    are *sentinels* (bound-EP non-local pairs): their compute is skipped
+    in-kernel and their output rows are zero. Deriving the sentinel
+    threshold from the array shape (rather than a parameter) guarantees
+    every real expert owns >= 1 block, so no dW tile is left unwritten."""
     return _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
-                               block_i, interpret)
+                               block_i, interpret, gate_up.shape[0])
 
 
 def _grouped_glu_fwd(xs, gate_up, down, block_expert, block_size, block_i,
                      interpret):
     ys = _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
-                             block_i, interpret)
+                             block_i, interpret, gate_up.shape[0])
     return ys, (xs, gate_up, down, block_expert)
 
 
 def _grouped_glu_bwd(block_size, block_i, interpret, res, dy):
     xs, gate_up, down, block_expert = res
     dx, dgu, ddn = _grouped_glu_pallas_bwd(
-        xs, gate_up, down, block_expert, dy, block_size, block_i, interpret)
+        xs, gate_up, down, block_expert, dy, block_size, block_i, interpret,
+        gate_up.shape[0])
     dbe = jnp.zeros(block_expert.shape, jax.dtypes.float0)
     return dx, dgu, ddn, dbe
 

@@ -104,9 +104,8 @@ class ExpertMLPs(nn.Module):
 
         if self.dispatch_mode == "blockwise":
             if ep is not None and ep > 1:
-                raise NotImplementedError(
-                    "blockwise dispatch under a bound ep axis is not yet "
-                    "supported; use dispatch_mode='capacity' with EP")
+                return self._forward_blockwise_ep(x, gates, idx, gate_up,
+                                                  down, i_local, e_local)
             return self._forward_blockwise(x, gates, idx, gate_up, down,
                                            i_local)
         if self.dispatch_mode != "capacity":
@@ -173,5 +172,68 @@ class ExpertMLPs(nn.Module):
         gates = mappings.copy_to_tensor_parallel_region(gates, self.tp_axis)
         y = bw.combine_from_blocks(ys, gates, order, src, dest, t)
         y = mappings.reduce_from_tensor_parallel_region(y, self.tp_axis)
+        aux = {"dropped_fraction": jnp.zeros((), jnp.float32)}
+        return y.astype(self.dtype), aux
+
+    def _forward_blockwise_ep(self, x, gates, idx, gate_up, down, i_local,
+                              e_local):
+        """Dropless blockwise under a *bound* ep axis (shard_map).
+
+        Reference-style (``expert_mlps_v2.py:779-817``): there is no
+        dispatch all-to-all — every EP rank sees every token (all-gather
+        over ep) and masks the routing to its LOCAL experts. Non-local
+        (token, k) pairs map to a *sentinel* expert sorted last, whose
+        gates are zeroed: the sentinel blocks borrow the last local
+        expert's weights, compute finite garbage, and contribute nothing —
+        forward (gate 0), backward dW/dx (their ``dy`` cotangent is 0).
+        Per-rank partial outputs reduce-scatter back to the token shards.
+
+        Collective cost per rank: all-gather [T_local, H] + reduce-scatter
+        [T_g, H] over ep — vs capacity-EP's two all-to-alls of the capacity
+        buffer. The gather rides ICI and is the standard TPU EP-dropless
+        layout (tokens replicated over the expert group).
+        """
+        from . import blockwise as bw
+
+        r = jax.lax.axis_index(self.ep_axis)
+        # gather with REDUCE-SCATTER backward (to_model_parallel=True): each
+        # rank produces partial cotangents for EVERY token (its experts'
+        # contributions), which must be summed across ranks then re-sharded —
+        # a slice-only gather backward would drop the off-rank contributions
+        x_g = mappings.gather_from_sequence_parallel_region(
+            x, self.ep_axis, seq_dim=0, to_model_parallel=True)
+        gates_g = mappings.gather_from_sequence_parallel_region(
+            gates, self.ep_axis, seq_dim=0, to_model_parallel=True)
+        idx_g = comm.all_gather(idx, self.ep_axis, dim=0)  # int: no grads
+        t_g = x_g.shape[0]
+
+        off = r * e_local
+        local = (idx_g >= off) & (idx_g < off + e_local)
+        idx_local = jnp.where(local, idx_g - off, e_local)  # sentinel last
+        gates_local = jnp.where(local, gates_g, 0.0).astype(gates_g.dtype)
+
+        order, src, dest, be, _, padded = bw.compute_block_metadata(
+            idx_local, e_local + 1, self.block_size)
+
+        xin = mappings.copy_to_tensor_parallel_region(x_g, self.tp_axis)
+        xs = bw.scatter_to_blocks(xin.astype(self.dtype), src, dest, padded)
+        bi = min(self.block_i, i_local)
+        if i_local % bi != 0:
+            bi = i_local
+        interpret = jax.default_backend() == "cpu"
+        # sentinel (block_expert == e_local >= E_local) blocks are compute-
+        # skipped in-kernel, so per-rank MXU work tracks the LOCAL routed
+        # load — EP shards FLOPs, not just weight memory
+        ys = bw.grouped_glu(xs, gate_up.astype(self.dtype),
+                            down.astype(self.dtype), be, self.block_size,
+                            bi, interpret)
+        # router-grad placement: see _forward_blockwise
+        gates_local = mappings.copy_to_tensor_parallel_region(
+            gates_local, self.tp_axis)
+        y = bw.combine_from_blocks(ys, gates_local, order, src, dest, t_g)
+        y = mappings.reduce_from_tensor_parallel_region(y, self.tp_axis)
+        # sum partial expert outputs over ep AND return to token shards
+        y = mappings.reduce_scatter_to_sequence_parallel_region(
+            y, self.ep_axis, seq_dim=0)
         aux = {"dropped_fraction": jnp.zeros((), jnp.float32)}
         return y.astype(self.dtype), aux

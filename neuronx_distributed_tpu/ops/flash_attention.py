@@ -24,14 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-
-def _compiler_params():
-    """Mosaic params for the compiled TPU path. The default 16 MiB scoped
-    VMEM limit rejects 7B-scale tiles (fp32 staging of one (h, 2, block_i)
-    weight tile is already ~8 MiB); v5e has 128 MiB physical VMEM."""
-    from jax.experimental.pallas import tpu as pltpu
-
-    return pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+from .pallas_utils import compiler_params as _compiler_params
 
 
 def _block_attention(q, k_blk, v_blk, q_pos, k_pos_start, block_k, causal,

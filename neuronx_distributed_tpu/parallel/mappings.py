@@ -21,6 +21,17 @@ Implemented as ``jax.custom_vjp`` functions over the named-axis collectives in
 :mod:`.comm`. When the axis is *not bound* (i.e. running under plain ``jit``
 with GSPMD sharding constraints rather than ``shard_map``), every mapping is
 an identity — GSPMD derives the collectives from sharding annotations instead.
+
+.. warning:: **Compute gradients INSIDE the shard_map region** (the
+   ``grad_fn`` convention in ``trainer.make_train_step``), or via GSPMD.
+   Differentiating *through* a ``check_vma=False`` shard_map boundary from
+   outside silently deflates the cotangents of axis-sharded inputs (e.g. TP-
+   sharded weights) by ``1/axis_size``: the boundary splits a replicated
+   output's cotangent evenly across ranks, and while replicated inputs
+   recover the full gradient (boundary sum composed with the ``copy_to``
+   psum), sharded-param cotangents cross no compensating collective.
+   Measured: weight grads exactly ``1/tp`` under ``jax.grad`` outside a
+   shard_map-wrapped MoE at tp=2/4 (x and gate grads exact).
 """
 
 from __future__ import annotations

@@ -139,6 +139,7 @@ EP_MATRIX = [
     (1, 2, True, "capacity"),   # ep x zero1
     (1, 4, False, "capacity"),
     (2, 2, False, "blockwise"),  # ep(GSPMD) x dropless
+    (2, 2, True, "blockwise"),   # ep(GSPMD) x dropless x zero1
 ]
 
 

@@ -602,3 +602,58 @@ def test_mixtral_1f1b_matches_dense(num_chunks):
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(flat_ref[path]), rtol=5e-3,
             atol=5e-5, err_msg=jax.tree_util.keystr(path))
+
+
+@pytest.mark.parametrize("tp,ep", [(1, 4), (2, 2)])
+def test_blockwise_bound_ep_parity_and_grads(tp, ep):
+    """Dropless blockwise under a BOUND ep axis (shard_map, optionally x tp)
+    must match the unsharded blockwise result exactly — forward, param
+    grads, x grads and router-gate grads (reference forward_blockwise EP
+    local-expert masking, expert_mlps_v2.py:779-817)."""
+    nxd.neuronx_distributed_config(tensor_parallel_size=tp,
+                                   expert_parallel_size=ep)
+    em = ps.get_expert_mesh()
+    cap, blk, params, x, gates, idx = _blockwise_pair()
+    dense, _ = blk.apply(params, x, gates, idx)
+
+    pspec = {"params": {"gate_up": P("ep", None, None, "tp"),
+                        "down": P("ep", "tp", None)}}
+    sharded = jax.jit(ps.shard_map(
+        lambda p, x, g, i: blk.apply(p, x, g, i), em,
+        in_specs=(pspec, P("ep", None), P("ep", None), P("ep", None)),
+        out_specs=(P("ep", None), P())))
+    y, aux = sharded(params, x, gates, idx)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+    assert float(aux["dropped_fraction"]) == 0.0
+
+    def loss_dense(p, x, g):
+        y, _ = blk.apply(p, x, g, idx)
+        return jnp.sum(y ** 2)
+
+    # gradients are computed INSIDE the shard_map (the framework's grad_fn
+    # convention, trainer.make_train_step): differentiating THROUGH a
+    # check_vma=False shard_map boundary from outside deflates sharded-param
+    # cotangents by 1/tp (replicated out_specs split the cotangent per rank;
+    # weight-grad paths cross no compensating psum) — see
+    # parallel/mappings.py docstring
+    def inner_grads(p, x, g, i):
+        def loss(p, x, g):
+            y, _ = blk.apply(p, x, g, i)
+            return jnp.sum(y ** 2)  # local token shard's partial loss
+        return jax.grad(loss, argnums=(0, 1, 2))(p, x, g)
+
+    ep_grads = jax.jit(ps.shard_map(
+        inner_grads, em,
+        in_specs=(pspec, P("ep", None), P("ep", None), P("ep", None)),
+        out_specs=(pspec, P("ep", None), P("ep", None))))
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(params, x, gates)
+    ge = ep_grads(params, x, gates, idx)
+    paths_d = jax.tree_util.tree_leaves_with_path(gd)
+    paths_e = jax.tree_util.tree_leaves_with_path(ge)
+    assert len(paths_d) == len(paths_e) == 4  # gate_up, down, dx, dgates
+    for (path, a), (_, b) in zip(paths_d, paths_e):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=5e-4, atol=5e-4,
+            err_msg=jax.tree_util.keystr(path))

@@ -9,30 +9,14 @@ import jax
 import jax.numpy as jnp
 
 
-_RTT_MS = None
-
-
-def _tunnel_rtt_ms():
-    """One-scalar fetch round-trip through the axon tunnel; subtracted from
-    chained timings (the tunnel's ``block_until_ready`` is a NO-OP — only a
-    host fetch synchronizes, observed 2026-07-29)."""
-    global _RTT_MS
-    if _RTT_MS is None:
-        x = jnp.float32(1.0) + 1
-        float(x)
-        t0 = time.perf_counter()
-        for _ in range(5):
-            float(jnp.float32(1.0) + 1)
-        _RTT_MS = (time.perf_counter() - t0) / 5 * 1e3
-    return _RTT_MS
-
-
 def timeit(fn, *args, iters=20):
-    """Times fn with a data dependency chained through iterations (the first
-    arg is perturbed by the previous output's first leaf) and ONE host fetch
-    at the end — block_until_ready does not synchronize through the axon
-    tunnel, so the fetch is the only trustworthy barrier. Returns ms/iter
-    with the single fetch's RTT share subtracted."""
+    """Times fn with the iteration loop ON DEVICE (lax.scan inside one jit)
+    and a host fetch as the barrier — block_until_ready does not synchronize
+    through the axon tunnel, and per-dispatch tunnel latency would swamp
+    sub-ms kernels. Slope timing ((t(2N) - t(N)) / N) cancels the constant
+    dispatch+fetch RTT. A data dependency chains iterations so nothing can
+    be value-cached, and every output leaf feeds the probe so XLA cannot
+    dead-code-eliminate part of the computation."""
     args = list(args)
 
     def step(a0, *rest):
