@@ -18,6 +18,7 @@ import json
 import logging
 import os
 import random
+import re
 import shutil
 import time
 from abc import ABC, abstractmethod
@@ -26,12 +27,45 @@ from typing import Any, List, Optional
 logger = logging.getLogger(__name__)
 
 
+# Deterministic failure modes: retrying these burns max_attempts x sleeps
+# before surfacing the same bug. JSONDecodeError is a ValueError subclass.
+_NON_RETRIABLE = (FileNotFoundError, PermissionError, IsADirectoryError,
+                  NotADirectoryError, FileExistsError, TypeError, ValueError,
+                  KeyError, AttributeError, NotImplementedError)
+
+# Fragments marking a throttling/transient server response even when the
+# fsspec driver surfaces it as a generic exception type. HTTP status codes
+# match as whole words only — a bare substring ('503' inside 'shard size
+# 5035') would turn a deterministic bug into 5 retries with sleeps.
+_TRANSIENT_MARKERS = ("slowdown", "slow down", "throttl", "timed out",
+                      "timeout", "connection reset", "connection aborted",
+                      "temporarily unavailable", "too many requests",
+                      "internal error")
+_TRANSIENT_STATUS_RE = re.compile(r"\b(?:429|500|502|503|504)\b")
+
+
+def _is_transient(e: Exception) -> bool:
+    if isinstance(e, _NON_RETRIABLE):
+        return False
+    if isinstance(e, (ConnectionError, TimeoutError, OSError)):
+        # network errors plus remaining OSErrors (EIO, ENETDOWN, stale NFS
+        # handles, ...) are environment hiccups worth retrying
+        return True
+    msg = str(e).lower()
+    return (any(m in msg for m in _TRANSIENT_MARKERS)
+            or _TRANSIENT_STATUS_RE.search(msg) is not None)
+
+
 def retry_with_backoff(max_attempts: int = 5, base_delay: float = 0.5,
                        max_delay: float = 8.0):
     """Retry transient storage errors with exponential backoff and
     *decrementing* jitter (reference ``checkpoint_storage.py:236-286``:
     tenacity retry tuned for S3 503 slow-down — early attempts spread out
     randomly, later attempts converge to the full deterministic delay).
+Only errors classified transient by :func:`_is_transient` are retried;
+    deterministic bugs (TypeError, JSON decode errors, missing files)
+    surface immediately (reference retries only classified slow-down
+    errors, ``checkpoint_storage.py:250``).
     """
     def deco(fn):
         @functools.wraps(fn)
@@ -40,9 +74,9 @@ def retry_with_backoff(max_attempts: int = 5, base_delay: float = 0.5,
             for attempt in range(max_attempts):
                 try:
                     return fn(*args, **kwargs)
-                except FileNotFoundError:
-                    raise  # deterministic, not transient
-                except Exception as e:  # transient object-store errors
+                except Exception as e:
+                    if not _is_transient(e):
+                        raise  # deterministic, not transient
                     last = e
                     if attempt == max_attempts - 1:
                         break

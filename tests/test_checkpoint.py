@@ -231,6 +231,59 @@ def test_retry_with_backoff(monkeypatch):
     assert calls["n"] == 1
 
 
+def test_retry_classifies_deterministic_errors(monkeypatch):
+    """Deterministic bugs surface immediately instead of burning retries
+    (reference retries only classified slow-down errors,
+    checkpoint_storage.py:250)."""
+    import json
+
+    from neuronx_distributed_tpu.trainer import checkpoint_storage as cs
+
+    monkeypatch.setattr(cs.time, "sleep", lambda s: None)
+    calls = {"n": 0}
+
+    @cs.retry_with_backoff(max_attempts=5)
+    def buggy():
+        calls["n"] += 1
+        raise TypeError("'NoneType' object is not subscriptable")
+
+    with pytest.raises(TypeError):
+        buggy()
+    assert calls["n"] == 1
+
+    @cs.retry_with_backoff(max_attempts=5)
+    def bad_json():
+        calls["n"] += 1
+        json.loads("{not json")
+
+    calls["n"] = 0
+    with pytest.raises(json.JSONDecodeError):
+        bad_json()
+    assert calls["n"] == 1
+
+    # a generic RuntimeError carrying a throttle marker IS retried
+    @cs.retry_with_backoff(max_attempts=3)
+    def throttled():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise RuntimeError("server responded: 503 SlowDown")
+        return "ok"
+
+    calls["n"] = 0
+    assert throttled() == "ok" and calls["n"] == 2
+
+    # ...but a generic RuntimeError with no marker is not
+    @cs.retry_with_backoff(max_attempts=3)
+    def opaque():
+        calls["n"] += 1
+        raise RuntimeError("assertion failed in layout pass")
+
+    calls["n"] = 0
+    with pytest.raises(RuntimeError):
+        opaque()
+    assert calls["n"] == 1
+
+
 def test_async_commit_failure_propagates(tmp_path, monkeypatch):
     """A failing async commit must raise at the next save/finalize instead
     of silently losing the checkpoint (VERDICT r1 weak #6)."""
