@@ -406,6 +406,34 @@ def named_sharding(*spec: Any) -> NamedSharding:
     return NamedSharding(get_mesh(), PartitionSpec(*spec))
 
 
+_EXPERT_ONLY_AXES = frozenset((EP_AXIS, EXP_DP_AXIS))
+
+
+def spec_uses_expert_axes(spec: PartitionSpec) -> bool:
+    """True when a PartitionSpec names an expert-view axis (``ep`` /
+    ``dp_exp``) — such specs must be placed on the expert mesh view."""
+    for p in spec:
+        if p is None:
+            continue
+        names = p if isinstance(p, tuple) else (p,)
+        if any(n in _EXPERT_ONLY_AXES for n in names):
+            return True
+    return False
+
+
+def named_sharding_for_spec(spec: PartitionSpec) -> NamedSharding:
+    """NamedSharding on the mesh view matching the spec's axis names.
+
+    Expert-view specs (naming ``ep``/``dp_exp``) land on the expert mesh,
+    everything else on the dense mesh. Both views are reshapes of the SAME
+    flat device order, so their NamedShardings are mutually compatible
+    inside one ``jit`` — the TPU analogue of the reference holding dense and
+    expert process groups side by side (``parallel_state.py:629``).
+    """
+    mesh = get_expert_mesh() if spec_uses_expert_axes(spec) else get_mesh()
+    return NamedSharding(mesh, spec)
+
+
 def with_sharding_constraint(x, *spec: Any):
     """``lax.with_sharding_constraint`` against the global mesh; no-op when
     the mesh is uninitialised (single-device eager use)."""

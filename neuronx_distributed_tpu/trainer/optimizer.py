@@ -44,14 +44,23 @@ def make_optimizer(cfg: NxDConfig, learning_rate: Any = 1e-4,
 def _zero1_extend_spec(spec: PartitionSpec, shape: Tuple[int, ...],
                        zero_axes: Tuple[str, ...]) -> PartitionSpec:
     """Extend a param PartitionSpec so the largest unsharded dim is also
-    partitioned over the ZeRO axes (dp×cp), if divisible."""
+    partitioned over the ZeRO axes (dp×cp), if divisible.
+
+    Expert-view specs (naming ``ep``/``dp_exp``) live on the expert mesh,
+    whose data-parallel dimension is ``dp_exp``: their optimizer state is
+    ZeRO-sharded over expert-DP instead (reference
+    ``NeuronEPZero1Optimizer``, ``zero_redundancy_optimizer.py:163``).
+    """
     if not shape:
         return spec
     parts = list(spec) + [None] * (len(shape) - len(spec))
     sizes = {**dict(zip(("pp", "dp", "cp", "tp"),
                         (1, 1, 1, 1)))}
+    expert_view = ps.spec_uses_expert_axes(spec)
+    if expert_view:
+        zero_axes = (ps.EXP_DP_AXIS,)
     if ps.model_parallel_is_initialized():
-        m = ps.get_mesh()
+        m = ps.get_expert_mesh() if expert_view else ps.get_mesh()
         sizes = {k: m.shape[k] for k in m.axis_names}
     zero_size = 1
     for a in zero_axes:

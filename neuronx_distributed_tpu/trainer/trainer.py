@@ -50,9 +50,8 @@ class ParallelModel:
     param_shapes: Any = struct.field(pytree_node=False)
 
     def param_shardings(self):
-        mesh = ps.get_mesh()
         return jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s), self.param_specs,
+            ps.named_sharding_for_spec, self.param_specs,
             is_leaf=lambda s: isinstance(s, PartitionSpec))
 
 
@@ -62,6 +61,11 @@ def _spec_tree(boxed_variables, logical_axis_rules=None) -> Any:
     ``{"layers": "pp"}`` for pipeline parallelism) and otherwise replicated."""
     specs = nn.get_partition_spec(boxed_variables)
     mesh_axes = set(ps.get_mesh().axis_names)
+    if ps.get_expert_model_parallel_size() > 1:
+        # expert-view axes stay in the spec: such params are placed on the
+        # expert mesh view (ps.named_sharding_for_spec), making GSPMD EP
+        # shard expert weights over ep instead of replicating them
+        mesh_axes |= set(ps.get_expert_mesh().axis_names)
     rules = logical_axis_rules or {}
 
     def map_axis(a):
@@ -103,15 +107,13 @@ def initialize_parallel_model(
 
     Returns ``(ParallelModel, params)``.
     """
-    mesh = ps.get_mesh()
-
     init_fn = functools.partial(module.init, method=method)
     boxed_shapes = jax.eval_shape(init_fn, rng, *sample_args)
     specs = _spec_tree(boxed_shapes, logical_axis_rules)
     shapes = jax.tree_util.tree_map(
         lambda x: tuple(x.shape), meta.unbox(boxed_shapes))
     shardings = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), specs,
+        ps.named_sharding_for_spec, specs,
         is_leaf=lambda s: isinstance(s, PartitionSpec))
 
     init_jit = jax.jit(
@@ -145,7 +147,7 @@ def initialize_parallel_optimizer(
         enabled=cfg.optimizer.zero_one_enabled)
     mesh = ps.get_mesh()
     to_shard = lambda tree: jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), tree,
+        ps.named_sharding_for_spec, tree,
         is_leaf=lambda s: isinstance(s, PartitionSpec))
     opt_shardings = to_shard(opt_specs)
     opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)

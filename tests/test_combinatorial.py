@@ -161,6 +161,25 @@ def test_ep_matrix_one_step(tp, ep, zero1, dispatch):
     pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
                                            batch["input_ids"])
     tx, state, sh = initialize_parallel_optimizer(pm, params, 1e-3)
+
+    # GSPMD EP is real: expert weights shard over ep on the expert mesh view
+    gate_up = state.params["params"]["model"]["layers"]["layer"]["moe"][
+        "experts"]["gate_up"]
+    assert "ep" in jax.tree_util.tree_leaves(
+        [list(gate_up.sharding.spec)]), gate_up.sharding
+    if zero1:
+        # expert optimizer state is ZeRO-sharded over expert-DP (reference
+        # NeuronEPZero1Optimizer, zero_redundancy_optimizer.py:163)
+        def find_mu(tree):
+            return [s for path, s in
+                    jax.tree_util.tree_leaves_with_path(tree)
+                    if "gate_up" in jax.tree_util.keystr(path)]
+        mu_shardings = find_mu(sh.opt_state)
+        assert mu_shardings and all(
+            "dp_exp" in [a for p in s.spec if p is not None
+                         for a in (p if isinstance(p, tuple) else (p,))]
+            for s in mu_shardings), mu_shardings
+
     step = make_train_step(pm, tx, sh)
     state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"])), (tp, ep, zero1, dispatch)
