@@ -181,6 +181,12 @@ def pipeline_1f1b_grads(
         res = stage_fn(chunk_p, act)
         return res if has_aux else (res, jnp.zeros((0,), jnp.float32))
 
+    # shape/dtype of one stage_call output, for the bubble-tick zero branch
+    chunk0_p = jax.tree_util.tree_map(lambda p: p[0], layers_p)
+    stage_out_sd = jax.eval_shape(stage_call, chunk0_p, zero_act)
+    zero_stage_out = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), stage_out_sd)
+
     def tick(carry, t):
         (buf, act_recv, grad_recv, g_layers, g_embed, g_head, loss_acc,
          aux_acc) = carry
@@ -196,9 +202,13 @@ def pipeline_1f1b_grads(
             lambda ep, i: zero_act,
             embed_p, ids_f)
         inp = jnp.where((my == 0) & (c_f == 0), x_emb, act_recv)
-        out, aux_f = stage_call(pick_chunk(c_f), inp)
-        aux_acc = aux_acc + aux_f.astype(jnp.float32) * fvalid.astype(
-            jnp.float32)
+        # bubble ticks (fvalid False) cost control flow, not a full forward
+        # (reference schedules simply emit no task; in the scanned SPMD
+        # program the tick exists but its compute is cond-skipped)
+        out, aux_f = lax.cond(
+            fvalid, stage_call, lambda cp, a: zero_stage_out,
+            pick_chunk(c_f), inp)
+        aux_acc = aux_acc + aux_f.astype(jnp.float32)
         prev_in_slot = lax.dynamic_index_in_dim(buf, sigma_f % W, 0,
                                                 keepdims=False)
         buf = lax.dynamic_update_index_in_dim(
@@ -234,16 +244,25 @@ def pipeline_1f1b_grads(
         # input, vjp into (chunk params, input activation) ----------------
         saved_in = lax.dynamic_index_in_dim(buf, sigma_b % W, 0,
                                             keepdims=False)
-        bmask = bvalid.astype(jnp.float32)
-        _, s_vjp = jax.vjp(stage_call, pick_chunk(c_b), saved_in)
-        aux_ct = (aux_weight.astype(jnp.float32) * bmask if has_aux
-                  else jnp.zeros((0,), jnp.float32))
-        dchunk, dact_in = s_vjp((dout.astype(act_shape.dtype), aux_ct))
+
+        def bwd_run(cp, saved, dout_):
+            _, s_vjp = jax.vjp(stage_call, cp, saved)
+            aux_ct = (aux_weight.astype(jnp.float32) if has_aux
+                      else jnp.zeros((0,), jnp.float32))
+            dchunk_, dact_ = s_vjp((dout_.astype(act_shape.dtype), aux_ct))
+            return (jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), dchunk_),
+                dact_.astype(zero_act.dtype))
+
+        # bubble ticks skip the recompute+vjp entirely (cond, not masking)
+        dchunk, dact_in = lax.cond(
+            bvalid, bwd_run,
+            lambda cp, saved, dout_: (f32(cp), jnp.zeros_like(saved)),
+            pick_chunk(c_b), saved_in, dout)
         g_layers = jax.tree_util.tree_map(
             lambda acc, g: lax.dynamic_update_index_in_dim(
                 acc,
-                lax.dynamic_index_in_dim(acc, c_b, 0, keepdims=False)
-                + bmask * g.astype(jnp.float32),
+                lax.dynamic_index_in_dim(acc, c_b, 0, keepdims=False) + g,
                 c_b, 0),
             g_layers, dchunk)
 
