@@ -33,6 +33,13 @@ class MixtralConfig(LlamaConfig):
     # "capacity" or "blockwise" (dropless; reference expert_mlps_v2.py:691)
     moe_dispatch: str = "capacity"
     moe_block_size: int = 512
+    # decode: DMA-elide unhit experts' weights (forward-only; the decode
+    # serving path enables this via dataclasses.replace — see
+    # mixtral_forward_with_cache)
+    moe_sentinel_empty: bool = False
+    # expert bank implementation: "float" | "mx_fp4" | "mx_fp8" (packed
+    # microscaling decode weights; convert with mx_pack_expert_params)
+    moe_expert_impl: str = "float"
     router_type: str = "top_k"
     shared_expert_intermediate: int = 0
     router_aux_coef: float = 0.02
@@ -93,6 +100,8 @@ class MixtralDecoderLayer(nn.Module):
             capacity_factor=cfg.capacity_factor,
             dispatch_mode=cfg.moe_dispatch,
             block_size=cfg.moe_block_size,
+            sentinel_empty=cfg.moe_sentinel_empty,
+            expert_impl=cfg.moe_expert_impl,
             router_type=cfg.router_type,
             shared_expert_intermediate=cfg.shared_expert_intermediate,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="moe")(h)
@@ -221,12 +230,25 @@ def mixtral_forward_with_cache(cfg: MixtralConfig, params,
 
     At decode the tiny token count makes the dropless blockwise dispatch
     with a small block size the natural expert path
-    (``cfg.moe_dispatch='blockwise'``).
+    (``cfg.moe_dispatch='blockwise'``); empty-block sentinels are enabled
+    here so each step reads only the experts its tokens hit — the
+    bandwidth-side equivalent of the reference's fused token-gen MoE
+    kernel (``moe_fused_tkg.py:85``; forward-only, so the training-side dW
+    constraint does not apply).
     """
+    import dataclasses
+
     from ..inference.kv_cache import KVCache
 
     if not cfg.scan_layers:
         raise ValueError("cached decode requires scan_layers=True")
+    # token-generation-sized calls only: at prefill (large s) most experts
+    # are hit anyway and the decode kernel's partial-sum layout would cost
+    # O(num_ib * s * H) HBM for nothing (measured crossover ~T=4,
+    # BASELINE.md r3 decode-MoE table)
+    if (cfg.moe_dispatch == "blockwise" and not cfg.moe_sentinel_empty
+            and input_ids.shape[1] * cfg.top_k <= cfg.num_experts):
+        cfg = dataclasses.replace(cfg, moe_sentinel_empty=True)
     p = params["params"]
     b, s = input_ids.shape
     positions = jnp.asarray(positions, jnp.int32)

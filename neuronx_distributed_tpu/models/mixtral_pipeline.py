@@ -39,17 +39,15 @@ def pipelined_moe_loss_fn(cfg: MixtralConfig, num_microbatches: int,
     the MoE decoder; includes the router aux losses."""
     if not cfg.scan_layers:
         raise ValueError("pipeline path requires scan_layers=True")
-    if cfg.sequence_parallel:
-        raise NotImplementedError(
-            "sequence_parallel under the MoE pipeline path is not yet "
-            "supported (the MoE block regathers full sequences)")
 
     embed_mod = pl.ParallelEmbedding(
         num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
         dtype=cfg.dtype, param_dtype=cfg.param_dtype)
-    norm_mod = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype)
+    norm_mod = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
+                       sequence_parallel=cfg.sequence_parallel)
     head_mod = pl.ColumnParallelLinear(
         features=cfg.vocab_size, use_bias=False, gather_output=False,
+        sequence_parallel=cfg.sequence_parallel,
         dtype=cfg.dtype, param_dtype=cfg.param_dtype)
 
     def pp_loss(params, ids, labels):
@@ -68,6 +66,12 @@ def pipelined_moe_loss_fn(cfg: MixtralConfig, num_microbatches: int,
         embed_p = jax.tree_util.tree_map(eng.stage_replicated_param,
                                          p["model"]["embed"])
         x = embed_mod.apply({"params": embed_p}, ids)
+        if cfg.sequence_parallel:
+            # stage activations ride the ring SP-sharded; the MoE block's
+            # own gather/scatter (MixtralDecoderLayer) handles the regather
+            # inside each stage (reference moe/model.py:154 delayed
+            # reduce-scatter inside NxDPPModel)
+            x = mappings.scatter_to_sequence_parallel_region(x, seq_dim=1)
         x_mb = eng.microbatch(x, M)
 
         body = nn.scan(
@@ -169,18 +173,16 @@ def make_moe_1f1b_grad_fn(cfg: MixtralConfig, num_microbatches: int,
 
     if not cfg.scan_layers:
         raise ValueError("pipeline path requires scan_layers=True")
-    if cfg.sequence_parallel:
-        raise NotImplementedError(
-            "sequence_parallel under the MoE pipeline path is not yet "
-            "supported")
     C = num_chunks
 
     embed_mod = pl.ParallelEmbedding(
         num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
         dtype=cfg.dtype, param_dtype=cfg.param_dtype)
-    norm_mod = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype)
+    norm_mod = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
+                       sequence_parallel=cfg.sequence_parallel)
     head_mod = pl.ColumnParallelLinear(
         features=cfg.vocab_size, use_bias=False, gather_output=False,
+        sequence_parallel=cfg.sequence_parallel,
         dtype=cfg.dtype, param_dtype=cfg.param_dtype)
 
     def inner(params, ids, labels):
@@ -199,7 +201,10 @@ def make_moe_1f1b_grad_fn(cfg: MixtralConfig, num_microbatches: int,
             use_scaled=cfg.rope_scaling)
 
         def embed_fn(ep, ids_):
-            return embed_mod.apply({"params": ep}, ids_)
+            x = embed_mod.apply({"params": ep}, ids_)
+            if cfg.sequence_parallel:
+                x = mappings.scatter_to_sequence_parallel_region(x, seq_dim=1)
+            return x
 
         body = nn.scan(
             _MoEScanBody,

@@ -46,7 +46,7 @@ def round_up(x: int, m: int) -> int:
 
 
 def compute_block_metadata(idx: jax.Array, num_experts: int,
-                           block_size: int):
+                           block_size: int, sentinel_empty: bool = False):
     """Routing metadata for the blockwise path.
 
     ``idx``: [T, K] expert assignment. Returns
@@ -60,6 +60,15 @@ def compute_block_metadata(idx: jax.Array, num_experts: int,
     * ``block_expert``: [num_blocks] expert id of each block,
     * ``num_blocks`` / ``padded`` (static): worst case ``(T·K + E·B) / B``
       blocks / slot count.
+
+    ``sentinel_empty`` (decode mode): blocks holding only padding get the
+    *sentinel* id ``num_experts`` instead of their owner — the grouped-GLU
+    kernel then skips their compute AND elides their weight-tile DMA, so a
+    decode step reads only the experts its few tokens actually hit (the HBM
+    property that makes MoE decode fast; the fused-decode analogue of
+    reference ``moe_fused_tkg.py:85``). Forward-only: with it, an expert
+    with no tokens gets no block, which would leave that expert's dW tile
+    unwritten in the backward kernel — training keeps the default.
     """
     t, k = idx.shape
     tk = t * k
@@ -85,9 +94,15 @@ def compute_block_metadata(idx: jax.Array, num_experts: int,
     # expert owning each block; blocks beyond the last expert's padded
     # region clamp to the last expert (they hold only zero slots)
     ends = jnp.cumsum(padded_counts)
-    block_expert = jnp.searchsorted(ends, block_start, side="right")
-    block_expert = jnp.minimum(block_expert, num_experts - 1).astype(
-        jnp.int32)
+    owner = jnp.searchsorted(ends, block_start, side="right")
+    block_expert = jnp.minimum(owner, num_experts - 1).astype(jnp.int32)
+    if sentinel_empty:
+        # block b is empty iff it starts at/after its owner's real rows end
+        safe = jnp.minimum(owner, num_experts - 1)
+        real_end = padded_starts[safe] + counts[safe]
+        has_real = (owner < num_experts) & (block_start < real_end)
+        block_expert = jnp.where(has_real, block_expert,
+                                 num_experts).astype(jnp.int32)
     return order, src, dest_slot, block_expert, num_blocks, padded
 
 
@@ -243,7 +258,11 @@ def _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
     num_ib = i // block_i
     # sentinel blocks (be >= num_real) borrow the LAST real expert's weight
     # tiles via this clamp — the DMA is elided across a run of sentinel
-    # blocks and the kernels' pl.when guards skip their compute entirely
+    # blocks and the kernels' pl.when guards skip their compute entirely.
+    # Grid order (b, ib): the y block accumulates over consecutive ib steps
+    # in VMEM (a non-consecutive revisit would not re-fetch); weight tiles
+    # are refetched per block — the layout that favours training, where
+    # nb ~ E. Decode uses :func:`_grouped_glu_pallas_decode` instead.
     we = functools.partial(jnp.minimum, num_real - 1)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -265,6 +284,77 @@ def _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
         interpret=interpret,
         compiler_params=None if interpret else _compiler_params(),
     )(block_expert, xs, gate_up, down)
+
+
+def _glu_fwd_decode_kernel(be_ref, x_ref, gu_ref, dn_ref, y_ref, *,
+                           num_real: int):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(1)
+
+    # each (ib, b) output block is written exactly once — no revisits
+    y_ref[...] = jnp.zeros_like(y_ref)
+
+    @pl.when(be_ref[b] < num_real)
+    def _compute():
+        x = x_ref[...].astype(jnp.float32)            # [B, H]
+        gu = gu_ref[0].astype(jnp.float32)            # [H, 2, bI]
+        g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        a = _silu(g) * u                              # [B, bI]
+        y_ref[...] = jax.lax.dot_general(
+            a, dn_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(y_ref.dtype)[None]
+
+
+def grouped_glu_decode(xs, gate_up, down, block_expert, block_size,
+                       block_i, interpret):
+    """Forward-only grouped GLU tuned for decode HBM traffic.
+
+    Grid order (ib, b) — token blocks INNERMOST — so consecutive blocks of
+    one (clamped) expert keep an identical weight-tile index and Pallas
+    elides the refetch: total weight traffic is (#hit experts) x weights
+    instead of (#blocks) x weights. With ``sentinel_empty`` metadata all
+    empty experts clamp into one shared sentinel run, so a T-token decode
+    step reads only the experts those tokens hit — the bandwidth property
+    the reference's fused token-gen kernel exists for
+    (``moe_fused_tkg.py:85``). Each (ib, b) output block is written exactly
+    once into a partial layout [num_ib, P, H] summed by XLA (an in-kernel
+    accumulation would need non-consecutive output revisits, which do not
+    re-fetch). The extra partial-sum traffic is O(num_ib·P·H) — trivial at
+    decode's tiny P, which is why training keeps :func:`grouped_glu`.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p, h = xs.shape
+    e, _, _, i = gate_up.shape
+    num_real = e
+    nb = p // block_size
+    num_ib = i // block_i
+    we = functools.partial(jnp.minimum, num_real - 1)
+    partial = pl.pallas_call(
+        functools.partial(_glu_fwd_decode_kernel, num_real=num_real),
+        out_shape=jax.ShapeDtypeStruct((num_ib, p, h), xs.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(num_ib, nb),
+            in_specs=[
+                pl.BlockSpec((block_size, h), lambda ib, b, be: (b, 0)),
+                pl.BlockSpec((1, h, 2, block_i),
+                             lambda ib, b, be: (we(be[b]), 0, 0, ib)),
+                pl.BlockSpec((1, block_i, h),
+                             lambda ib, b, be: (we(be[b]), ib, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_size, h),
+                                   lambda ib, b, be: (ib, b, 0)),
+        ),
+        interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
+    )(block_expert, xs, gate_up, down)
+    return jnp.sum(partial.astype(jnp.float32), axis=0).astype(xs.dtype)
 
 
 def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,

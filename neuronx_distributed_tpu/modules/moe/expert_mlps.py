@@ -77,6 +77,9 @@ class ExpertMLPs(nn.Module):
     dispatch_mode: str = "capacity"
     block_size: int = 512   # tokens per block (blockwise)
     block_i: int = 512      # intermediate-dim tile (blockwise)
+    # decode: skip + DMA-elide blocks of experts no token hit (forward-only;
+    # see blockwise.compute_block_metadata)
+    sentinel_empty: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     tp_axis: str = ps.TP_AXIS
@@ -145,6 +148,23 @@ class ExpertMLPs(nn.Module):
         aux = {"dropped_fraction": dropped}
         return y.astype(self.dtype), aux
 
+    def _run_grouped_glu(self, xs, gate_up, down, be, i_local):
+        """Shared kernel dispatch for both blockwise paths: bi-tile
+        fallback + training kernel vs forward-only decode kernel
+        (``sentinel_empty``: reads only hit experts' weights — token blocks
+        innermost, empty blocks sentinel'd)."""
+        from . import blockwise as bw
+
+        bi = min(self.block_i, i_local)
+        if i_local % bi != 0:
+            bi = i_local
+        interpret = jax.default_backend() == "cpu"
+        kernel = (bw.grouped_glu_decode if self.sentinel_empty
+                  else bw.grouped_glu)
+        return kernel(xs, gate_up.astype(self.dtype),
+                      down.astype(self.dtype), be, self.block_size, bi,
+                      interpret)
+
     def _forward_blockwise(self, x, gates, idx, gate_up, down, i_local):
         """Dropless path: sort-by-expert + Pallas block-sparse grouped GLU
         (:mod:`.blockwise`; reference ``forward_blockwise``,
@@ -153,16 +173,11 @@ class ExpertMLPs(nn.Module):
 
         t = x.shape[0]
         order, src, dest, be, _, padded = bw.compute_block_metadata(
-            idx, self.num_experts, self.block_size)
+            idx, self.num_experts, self.block_size,
+            sentinel_empty=self.sentinel_empty)
         xin = mappings.copy_to_tensor_parallel_region(x, self.tp_axis)
         xs = bw.scatter_to_blocks(xin.astype(self.dtype), src, dest, padded)
-        bi = min(self.block_i, i_local)
-        if i_local % bi != 0:
-            bi = i_local
-        interpret = jax.default_backend() == "cpu"
-        ys = bw.grouped_glu(xs, gate_up.astype(self.dtype),
-                            down.astype(self.dtype), be, self.block_size,
-                            bi, interpret)
+        ys = self._run_grouped_glu(xs, gate_up, down, be, i_local)
         # combining shard-partial expert outputs is forward-equivalent to
         # combining the tp-reduced ones, but the gates' (hence router's)
         # gradient d y/d gate = expert output must be tp-complete: enter
@@ -212,21 +227,20 @@ class ExpertMLPs(nn.Module):
         idx_local = jnp.where(local, idx_g - off, e_local)  # sentinel last
         gates_local = jnp.where(local, gates_g, 0.0).astype(gates_g.dtype)
 
+        # decode (sentinel_empty): additionally sentinel the blocks of
+        # LOCAL experts no token hit — both sentinel classes land >= e_local
+        # and the forward-only decode kernel skips them (the training path
+        # keeps every local expert's block for the dW zero-init contract)
         order, src, dest, be, _, padded = bw.compute_block_metadata(
-            idx_local, e_local + 1, self.block_size)
+            idx_local, e_local + 1, self.block_size,
+            sentinel_empty=self.sentinel_empty)
 
         xin = mappings.copy_to_tensor_parallel_region(x_g, self.tp_axis)
         xs = bw.scatter_to_blocks(xin.astype(self.dtype), src, dest, padded)
-        bi = min(self.block_i, i_local)
-        if i_local % bi != 0:
-            bi = i_local
-        interpret = jax.default_backend() == "cpu"
-        # sentinel (block_expert == e_local >= E_local) blocks are compute-
-        # skipped in-kernel, so per-rank MXU work tracks the LOCAL routed
-        # load — EP shards FLOPs, not just weight memory
-        ys = bw.grouped_glu(xs, gate_up.astype(self.dtype),
-                            down.astype(self.dtype), be, self.block_size,
-                            bi, interpret)
+        # sentinel (block_expert >= E_local) blocks are compute-skipped
+        # in-kernel, so per-rank MXU work tracks the LOCAL routed load —
+        # EP shards FLOPs, not just weight memory
+        ys = self._run_grouped_glu(xs, gate_up, down, be, i_local)
         # router-grad placement: see _forward_blockwise
         gates_local = mappings.copy_to_tensor_parallel_region(
             gates_local, self.tp_axis)

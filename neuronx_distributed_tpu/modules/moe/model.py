@@ -62,6 +62,10 @@ class MoE(nn.Module):
     capacity_factor: float = 2.0
     dispatch_mode: str = "capacity"  # or "blockwise" (dropless)
     block_size: int = 512
+    sentinel_empty: bool = False  # decode: DMA-elide unhit experts
+    # expert bank implementation: "float" (ExpertMLPs), "mx_fp4"/"mx_fp8"
+    # (packed microscaling weights, quantization.mx_layers.MXExpertMLPs)
+    expert_impl: str = "float"
     router_type: str = "top_k"
     shared_expert_intermediate: int = 0
     dtype: Any = jnp.bfloat16
@@ -80,12 +84,28 @@ class MoE(nn.Module):
             router_kw["top_k"] = self.top_k
         gates, idx, aux = router_cls(**router_kw)(flat)
 
-        experts = ExpertMLPs(
-            num_experts=self.num_experts, hidden_size=h,
-            intermediate_size=self.intermediate_size,
-            top_k=gates.shape[-1], capacity_factor=self.capacity_factor,
-            dispatch_mode=self.dispatch_mode, block_size=self.block_size,
-            dtype=self.dtype, param_dtype=self.param_dtype, name="experts")
+        if self.expert_impl.startswith("mx_"):
+            from ...quantization.mx_layers import MXExpertMLPs
+
+            experts = MXExpertMLPs(
+                num_experts=self.num_experts, hidden_size=h,
+                intermediate_size=self.intermediate_size,
+                top_k=gates.shape[-1], capacity_factor=self.capacity_factor,
+                mx_format=self.expert_impl[len("mx_"):],
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                name="experts")
+        elif self.expert_impl != "float":
+            raise ValueError(f"unknown expert_impl {self.expert_impl!r}")
+        else:
+            experts = ExpertMLPs(
+                num_experts=self.num_experts, hidden_size=h,
+                intermediate_size=self.intermediate_size,
+                top_k=gates.shape[-1], capacity_factor=self.capacity_factor,
+                dispatch_mode=self.dispatch_mode,
+                block_size=self.block_size,
+                sentinel_empty=self.sentinel_empty,
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                name="experts")
         y, eaux = experts(flat, gates, idx)
         aux.update(eaux)
 

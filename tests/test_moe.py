@@ -443,19 +443,23 @@ def test_mixtral_cached_decode_matches_full_forward():
                                rtol=5e-4, atol=5e-4)
 
 
-def test_mixtral_pipeline_matches_dense():
+@pytest.mark.parametrize("sp", [False, True])
+def test_mixtral_pipeline_matches_dense(sp):
     """MoE x PP: pipelined mixtral (GPipe engine, router aux accumulated
     across stages) matches the dense model's loss and every grad leaf —
-    dropless dispatch so per-microbatch grouping can't change drops."""
+    dropless dispatch so per-microbatch grouping can't change drops;
+    sp=True covers the SP scatter-after-embed + sp-aware head."""
     from neuronx_distributed_tpu.models.mixtral import (MixtralForCausalLM,
                                                         tiny_moe_config)
     from neuronx_distributed_tpu.models import mixtral_pipeline as mpp
     from neuronx_distributed_tpu.trainer import initialize_parallel_model
 
     cfg = nxd.neuronx_distributed_config(
-        tensor_parallel_size=2, pipeline_parallel_size=2)
+        tensor_parallel_size=2, pipeline_parallel_size=2,
+        sequence_parallel=sp)
     mcfg = tiny_moe_config(dtype=jnp.float32, param_dtype=jnp.float32,
-                           tp_size=2, moe_dispatch="blockwise",
+                           tp_size=2, sequence_parallel=sp,
+                           moe_dispatch="blockwise",
                            moe_block_size=16)
     model = MixtralForCausalLM(mcfg)
     ids = jax.random.randint(jax.random.key(90), (8, 17), 0,
@@ -500,6 +504,41 @@ def test_mixtral_pipeline_matches_dense():
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(flat_ref[path]), rtol=5e-3,
             atol=5e-5, err_msg=jax.tree_util.keystr(path))
+
+
+def test_blockwise_sentinel_empty_decode_parity():
+    """Decode mode (sentinel_empty): blocks of experts no token hit become
+    sentinels — compute skipped, weight DMA elided — and the forward is
+    bit-identical to the default metadata (the measured fused-decode path;
+    reference moe_fused_tkg.py:85)."""
+    from neuronx_distributed_tpu.modules.moe import blockwise as bw
+    from neuronx_distributed_tpu.modules.moe import ExpertMLPs
+
+    H, I, E, K, T = 16, 32, 8, 2, 4
+    x = jax.random.normal(jax.random.key(3), (T, H))
+    gates = jax.nn.softmax(
+        jax.random.normal(jax.random.key(4), (T, K)), axis=-1)
+    # routing concentrated on experts {1, 6}: most experts empty
+    idx = jnp.asarray([[1, 6], [6, 1], [1, 6], [1, 1]], jnp.int32)
+
+    # metadata: empty experts' blocks are sentinels (id == E)
+    *_, be_s, _, _ = bw.compute_block_metadata(idx, E, 4,
+                                               sentinel_empty=True)
+    *_, be_d, _, _ = bw.compute_block_metadata(idx, E, 4)
+    assert int(jnp.sum(be_s == E)) > 0          # some sentinel blocks
+    hit = {1, 6}
+    real = set(np.asarray(be_s[be_s < E]).tolist())
+    assert real == hit, (real, hit)             # only hit experts remain
+    assert int(jnp.sum(be_d == E)) == 0         # default keeps all owners
+
+    mk = lambda sent: ExpertMLPs(
+        num_experts=E, hidden_size=H, intermediate_size=I, top_k=K,
+        dispatch_mode="blockwise", block_size=4, sentinel_empty=sent,
+        dtype=jnp.float32, param_dtype=jnp.float32)
+    params = meta.unbox(mk(False).init(jax.random.key(5), x, gates, idx))
+    y_ref, _ = mk(False).apply(params, x, gates, idx)
+    y_dec, _ = mk(True).apply(params, x, gates, idx)
+    np.testing.assert_array_equal(np.asarray(y_dec), np.asarray(y_ref))
 
 
 def test_blockwise_router_grads_under_tp():
@@ -555,12 +594,15 @@ def _dense_moe_composite(model, mcfg, batch):
     return composite
 
 
-@pytest.mark.parametrize("num_chunks", [1, 2])
-def test_mixtral_1f1b_matches_dense(num_chunks):
+@pytest.mark.parametrize("num_chunks,sp", [(1, False), (2, False), (1, True),
+                                           (2, True)])
+def test_mixtral_1f1b_matches_dense(num_chunks, sp):
     """MoE x 1F1B (C=1) and interleaved VPP (C=2): the explicit executor
     with aux_weight-seeded router cotangents matches the dense composite
     exactly (C=2 also covers chunk selection in the reversed backward
-    drain)."""
+    drain; sp=True rides SP-sharded activations through the ring with the
+    MoE block's own gather/scatter inside each stage — reference
+    moe/model.py:154 under NxDPPModel)."""
     from neuronx_distributed_tpu.models.mixtral import (MixtralForCausalLM,
                                                         tiny_moe_config)
     from neuronx_distributed_tpu.models import mixtral_pipeline as mpp
@@ -569,9 +611,11 @@ def test_mixtral_1f1b_matches_dense(num_chunks):
     from neuronx_distributed_tpu.trainer import initialize_parallel_model
 
     cfg = nxd.neuronx_distributed_config(
-        tensor_parallel_size=2, pipeline_parallel_size=2)
+        tensor_parallel_size=2, pipeline_parallel_size=2,
+        sequence_parallel=sp)
     mcfg = tiny_moe_config(dtype=jnp.float32, param_dtype=jnp.float32,
                            num_layers=2 * num_chunks, tp_size=2,
+                           sequence_parallel=sp,
                            moe_dispatch="blockwise", moe_block_size=16)
     model = MixtralForCausalLM(mcfg)
     ids = jax.random.randint(jax.random.key(95), (8, 17), 0,

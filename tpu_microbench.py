@@ -42,7 +42,6 @@ def timeit(fn, *args, iters=20):
         float(run_n(n, args[0], args[1:]))  # host fetch = the true barrier
         return (time.perf_counter() - t0) * 1e3
 
-    run(1)  # compile n=1
     run(iters)  # compile n=iters (hits both executables)
     run(2 * iters)
     # slope timing: the loop lives inside jit (ONE tunnel dispatch per run);
@@ -126,6 +125,56 @@ def bench_glu():
     return rows
 
 
+def bench_decode_moe():
+    """Decode-MoE comparison (VERDICT r2 next #4): dense all-experts einsum
+    vs blockwise small-block with empty-block sentinels (weight DMA elided
+    for unhit experts) at Mixtral-8x7B layer dims, T = B*S decode tokens.
+    The claim under test: the separate-router blockwise form is already
+    HBM-bound-optimal, reading only hit experts' weights."""
+    from neuronx_distributed_tpu.modules.moe.blockwise import (
+        combine_from_blocks, compute_block_metadata, grouped_glu_decode,
+        scatter_to_blocks)
+
+    E, h, I, K = 8, 4096, 14336, 2
+    kg, kd, kr = jax.random.split(jax.random.key(2), 3)
+    gate_up = jax.random.normal(kg, (E, h, 2, I), jnp.bfloat16) * 0.02
+    down = jax.random.normal(kd, (E, I, h), jnp.bfloat16) * 0.02
+    router_w = jax.random.normal(kr, (h, E), jnp.bfloat16) * 0.02
+
+    rows = []
+    for T in (1, 4, 8):
+        x = jax.random.normal(jax.random.key(T), (T, h), jnp.bfloat16)
+
+        def dense_path(x, gate_up, down, router_w):
+            logits = (x @ router_w).astype(jnp.float32)
+            gates, idx = jax.lax.top_k(jax.nn.softmax(logits, axis=-1), K)
+            g = jnp.einsum("th,ehi->tei", x, gate_up[:, :, 0])
+            u = jnp.einsum("th,ehi->tei", x, gate_up[:, :, 1])
+            a = jax.nn.silu(g) * u
+            y = jnp.einsum("tei,eih->teh", a, down)
+            sel = jnp.sum(jax.nn.one_hot(idx, E, dtype=y.dtype)
+                          * gates[..., None].astype(y.dtype), axis=1)
+            return jnp.einsum("teh,te->th", y, sel)
+
+        def blockwise_path(x, gate_up, down, router_w, bs=32, bi=512):
+            logits = (x @ router_w).astype(jnp.float32)
+            gates, idx = jax.lax.top_k(jax.nn.softmax(logits, axis=-1), K)
+            order, src, dest, be, _, padded = compute_block_metadata(
+                idx, E, bs, sentinel_empty=True)
+            xs = scatter_to_blocks(x, src, dest, padded)
+            ys = grouped_glu_decode(xs, gate_up, down, be, bs, bi, False)
+            return combine_from_blocks(ys, gates.astype(x.dtype), order,
+                                       src, dest, T)
+
+        rows.append((f"decode-moe T={T} dense all-experts",
+                     timeit(jax.jit(dense_path), x, gate_up, down,
+                            router_w)))
+        rows.append((f"decode-moe T={T} blockwise+sentinel bs=32",
+                     timeit(jax.jit(blockwise_path), x, gate_up, down,
+                            router_w)))
+    return rows
+
+
 def bench_sanity():
     # 8192^3 bf16 matmul = 1.1 TFLOP; v5e peak 197 TFLOP/s -> >=5.6 ms.
     # If this row reads faster than that, the timing harness is broken.
@@ -137,6 +186,13 @@ def bench_sanity():
 
 
 if __name__ == "__main__":
+    import sys
+
     print(f"platform: {jax.devices()[0].platform} x{len(jax.devices())}")
-    for name, ms in bench_sanity() + bench_flash() + bench_glu():
-        print(f"| {name} | {ms:.2f} ms |")
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    benches = {"sanity": bench_sanity, "flash": bench_flash,
+               "glu": bench_glu, "decode_moe": bench_decode_moe}
+    names = benches if which == "all" else {which: benches[which]}
+    for bname, fn in names.items():
+        for name, ms in fn():
+            print(f"| {name} | {ms:.2f} ms |")
