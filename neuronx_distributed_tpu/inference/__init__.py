@@ -16,8 +16,10 @@ from . import sampling
 from . import speculative
 from .generation import decode_step, generate, pick_bucket, prefill
 from .kv_cache import KVCache, init_kv_cache
-from .model_builder import ModelBuilder, NxDModel, shard_checkpoint
+from .model_builder import (ModelBuilder, NxDModel, bundle_generate,
+                            bundle_speculative_generate, shard_checkpoint)
 from .sampling import SamplingConfig, sample
+from .speculative import make_speculation_round_fn
 
 __all__ = [
     "generation", "kv_cache", "model_builder", "sampling",
@@ -25,5 +27,7 @@ __all__ = [
     "decode_step", "generate", "pick_bucket", "prefill",
     "KVCache", "init_kv_cache",
     "ModelBuilder", "NxDModel", "shard_checkpoint",
+    "bundle_generate", "bundle_speculative_generate",
+    "make_speculation_round_fn",
     "SamplingConfig", "sample",
 ]

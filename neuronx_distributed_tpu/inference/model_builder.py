@@ -53,9 +53,23 @@ class TraceArtifacts:
 
 
 def _abstractify(tree):
-    return jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
-        if not isinstance(x, jax.ShapeDtypeStruct) else x, tree)
+    """Shape/dtype skeleton of an args tree, KEEPING NamedSharding placement:
+    a bucket built from mesh-sharded params compiles a program that *expects*
+    sharded inputs — the serving-at-scale contract (weights stream back from
+    the bundle store straight to their shards and feed the executable without
+    a resharding hop)."""
+    from jax.sharding import NamedSharding
+
+    def conv(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        sh = getattr(x, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x),
+                                        sharding=sh)
+        return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+    return jax.tree_util.tree_map(conv, tree)
 
 
 @dataclass
@@ -211,13 +225,17 @@ class NxDModel:
     # -- persistence (reference ``nxd_model.py:277-353,565,591``: the saved
     # archive carries the compiled programs AND the weights, state
     # initializer and generation config, so a fresh process can serve from
-    # the file alone; here a zip of jax.export payloads + raw tensors) ------
+    # the file alone; here a zip of jax.export payloads + compiled-PJRT
+    # payloads, with weights either inline (small bundles) or in a sibling
+    # Orbax/TensorStore store streamed shard-by-shard to devices) ----------
 
-    FORMAT_VERSION = 2
+    FORMAT_VERSION = 3
 
     def save(self, path: str, params: Any = None,
              state_spec: Optional[dict] = None,
-             generation_config: Optional[dict] = None) -> None:
+             generation_config: Optional[dict] = None,
+             param_specs: Any = None,
+             serialize_compiled: bool = True) -> None:
         """Write the full serving bundle.
 
         ``params``: pytree of arrays (nested dicts) packaged with the
@@ -225,6 +243,19 @@ class NxDModel:
         :func:`..inference.kv_cache.init_kv_cache` describing the KV state
         buffers (reference ``StateInitializer``). ``generation_config``:
         JSON-serializable dict (buckets, eos, sampling defaults).
+
+        ``param_specs``: PartitionSpec tree matching ``params``. When given,
+        weights go to a sibling Orbax/TensorStore store (``<path>.weights``)
+        written from the arrays' native (possibly sharded) placement, and
+        ``load`` streams each shard straight onto its device — the 70B path:
+        the full tree never materialises on one host (reference packages
+        per-rank shards, ``nxd_model.py:277-353``). Without it, weights are
+        inlined into the zip as whole-tensor blobs (fine for small models).
+
+        ``serialize_compiled``: also pack each compiled executable
+        (``jax.experimental.serialize_executable``) so a serving process on
+        matching topology/runtime skips XLA compilation entirely — the NEFF
+        analogue. Falls back silently per-artifact when the runtime refuses.
         """
         import numpy as np
 
@@ -234,10 +265,27 @@ class NxDModel:
                     sorted(self._artifacts.items(), key=lambda kv: kv[0])):
                 name = f"artifact_{i}.stablehlo"
                 z.writestr(name, art.exported.serialize())
-                manifest.append({"key": key, "bucket_index": bi,
-                                 "file": name})
-            weights = []
-            if params is not None:
+                entry = {"key": key, "bucket_index": bi, "file": name}
+                if serialize_compiled and art.compiled is not None:
+                    try:
+                        from jax.experimental import serialize_executable
+
+                        payload, in_tree, out_tree = (
+                            serialize_executable.serialize(art.compiled))
+                        z.writestr(f"artifact_{i}.pjrt", pickle.dumps(
+                            (payload, in_tree, out_tree)))
+                        entry["pjrt_file"] = f"artifact_{i}.pjrt"
+                    except Exception as e:  # runtime without AOT support
+                        logger.warning(
+                            "could not serialize compiled %s/%d (%s); "
+                            "bundle will lazily recompile", key, bi, e)
+                manifest.append(entry)
+            weights: List[dict] = []
+            weights_store = None
+            if params is not None and param_specs is not None:
+                weights_store = self._save_orbax_weights(
+                    path, params, param_specs, weights)
+            elif params is not None:
                 for j, (p, leaf) in enumerate(
                         jax.tree_util.tree_leaves_with_path(params)):
                     keypath = "/".join(_path_entry(e) for e in p)
@@ -247,23 +295,66 @@ class NxDModel:
                     weights.append({"path": keypath, "file": fname,
                                     "dtype": str(arr.dtype),
                                     "shape": list(arr.shape)})
+            mesh_sizes = None
+            from ..parallel import mesh as ps
+
+            if ps.model_parallel_is_initialized():
+                mesh_sizes = {
+                    "tp": ps.get_tensor_model_parallel_size(),
+                    "pp": ps.get_pipeline_model_parallel_size(),
+                    "cp": ps.get_context_parallel_size(),
+                    "ep": ps.get_expert_model_parallel_size(),
+                    "world": ps.get_world_size()}
             z.writestr("manifest.json", json.dumps(
                 {"version": self.FORMAT_VERSION,
                  "jax_version": jax.__version__,
                  "artifacts": manifest,
                  "weights": weights,
+                 "weights_store": weights_store,
+                 "mesh": mesh_sizes,
                  "state_spec": state_spec,
                  "generation_config": generation_config}))
         logger.info("saved NxDModel to %s", path)
 
+    @staticmethod
+    def _save_orbax_weights(path: str, params: Any, param_specs: Any,
+                            weights: List[dict]) -> dict:
+        """Write ``params`` to ``<path>.weights`` via Orbax/TensorStore,
+        recording per-leaf shape/dtype/spec in ``weights`` (the manifest) so
+        load can build the abstract restore target without reading data."""
+        import orbax.checkpoint as ocp
+        from jax.sharding import PartitionSpec
+
+        store_dir = os.path.abspath(path) + ".weights"
+        if os.path.exists(store_dir):
+            import shutil
+
+            shutil.rmtree(store_dir)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(store_dir, params)
+        ckptr.wait_until_finished()
+        specs_flat = {
+            "/".join(_path_entry(e) for e in p): s
+            for p, s in jax.tree_util.tree_leaves_with_path(
+                param_specs, is_leaf=lambda s: isinstance(s, PartitionSpec))}
+        for p, leaf in jax.tree_util.tree_leaves_with_path(params):
+            keypath = "/".join(_path_entry(e) for e in p)
+            weights.append({
+                "path": keypath,
+                "dtype": str(jnp.result_type(leaf)),
+                "shape": list(jnp.shape(leaf)),
+                "spec": _spec_to_json(specs_flat[keypath])})
+        return {"format": "orbax", "dir": os.path.basename(store_dir)}
+
     @classmethod
-    def load(cls, path: str) -> "NxDModel":
+    def load(cls, path: str, devices: Optional[Sequence] = None
+             ) -> "NxDModel":
         import numpy as np
 
         artifacts: Dict[Tuple[str, int], TraceArtifacts] = {}
         with zipfile.ZipFile(path) as z:
             manifest = json.loads(z.read("manifest.json"))
-            if manifest["version"] not in (1, cls.FORMAT_VERSION):
+            if manifest["version"] not in (1, 2, cls.FORMAT_VERSION):
                 raise ValueError(
                     f"unsupported NxDModel format {manifest['version']}")
             for item in manifest["artifacts"]:
@@ -273,11 +364,31 @@ class NxDModel:
                 # rebuild the exported calling convention's arg pytree
                 args, _ = jax.tree_util.tree_unflatten(exported.in_tree,
                                                        leaves)
-                artifacts[(item["key"], item["bucket_index"])] = (
-                    TraceArtifacts(key=item["key"], bucket=tuple(args),
-                                   exported=exported))
+                art = TraceArtifacts(key=item["key"], bucket=tuple(args),
+                                     exported=exported)
+                if item.get("pjrt_file"):
+                    # instant cold start: load the packaged executable; any
+                    # runtime/topology mismatch falls back to lazy recompile
+                    try:
+                        from jax.experimental import serialize_executable
+
+                        payload, in_tree, out_tree = pickle.loads(
+                            z.read(item["pjrt_file"]))
+                        art.compiled = (
+                            serialize_executable.deserialize_and_load(
+                                payload, in_tree, out_tree))
+                    except Exception as e:
+                        logger.warning(
+                            "packaged executable for %s unusable here (%s);"
+                            " will recompile lazily", item["key"], e)
+                artifacts[(item["key"], item["bucket_index"])] = art
             params = None
-            if manifest.get("weights"):
+            store = manifest.get("weights_store")
+            if store and store.get("format") == "orbax":
+                params = cls._load_orbax_weights(
+                    path, store, manifest["weights"], devices,
+                    manifest.get("mesh"))
+            elif manifest.get("weights"):
                 flat = {}
                 for w in manifest["weights"]:
                     arr = np.frombuffer(
@@ -293,6 +404,48 @@ class NxDModel:
         model.generation_config = manifest.get("generation_config")
         return model
 
+    @staticmethod
+    def _load_orbax_weights(path: str, store: dict, weights: List[dict],
+                            devices: Optional[Sequence],
+                            mesh_sizes: Optional[dict] = None) -> Any:
+        """Stream the Orbax store shard-by-shard onto the mesh.
+
+        Each leaf restores as a jax.Array already placed per its saved
+        PartitionSpec — TensorStore reads only the byte ranges each device
+        needs, so the full tree never exists on host (the property the 70B
+        target requires; reference per-rank shard loading,
+        ``nxd_model.py:277-353``)."""
+        import orbax.checkpoint as ocp
+
+        from ..parallel import mesh as ps
+
+        if not ps.model_parallel_is_initialized():
+            # serving-process bootstrap: rebuild the SAVING mesh shape so
+            # restored shards line up with what the compiled programs expect
+            kw = {}
+            if mesh_sizes:
+                kw = dict(tensor_model_parallel_size=mesh_sizes["tp"],
+                          pipeline_model_parallel_size=mesh_sizes["pp"],
+                          context_parallel_size=mesh_sizes["cp"],
+                          expert_model_parallel_size=mesh_sizes["ep"])
+                if devices is None:
+                    if len(jax.devices()) < mesh_sizes["world"]:
+                        raise RuntimeError(
+                            f"bundle was saved on {mesh_sizes['world']} "
+                            f"devices; only {len(jax.devices())} available")
+                    devices = jax.devices()[:mesh_sizes["world"]]
+            ps.initialize_model_parallel(devices=devices, **kw)
+        store_dir = os.path.join(os.path.dirname(os.path.abspath(path)),
+                                 store["dir"])
+        abstract = _unflatten_paths({
+            w["path"]: jax.ShapeDtypeStruct(
+                tuple(w["shape"]), jnp.dtype(w["dtype"]),
+                sharding=ps.named_sharding_for_spec(_spec_from_json(
+                    w["spec"])))
+            for w in weights})
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore(store_dir, abstract)
+
     def init_state(self):
         """Fresh KV state buffers from the packaged spec (reference
         ``StateInitializer``, ``base_nxd_model.py:11``)."""
@@ -303,6 +456,26 @@ class NxDModel:
         spec = dict(self.state_spec)
         spec["dtype"] = jnp.dtype(spec.get("dtype", "bfloat16"))
         return init_kv_cache(**spec)
+
+
+def _spec_to_json(spec) -> list:
+    """PartitionSpec -> JSON-stable list (entries: None | str | [str...])."""
+    out = []
+    for p in spec:
+        if p is None:
+            out.append(None)
+        elif isinstance(p, tuple):
+            out.append(list(p))
+        else:
+            out.append(str(p))
+    return out
+
+
+def _spec_from_json(items: list):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*[tuple(p) if isinstance(p, list) else p
+                           for p in items])
 
 
 def _path_entry(e) -> str:
@@ -370,16 +543,81 @@ def bundle_generate(model: "NxDModel", input_ids, prompt_len,
     return jnp.stack(toks, axis=1)
 
 
+def bundle_speculative_generate(model: "NxDModel", input_ids, prompt_len,
+                                max_new_tokens: int):
+    """Greedy draft-model speculative decoding driven purely from a loaded
+    bundle (the reference's "speculation" serving key,
+    ``examples/inference/modules/model_base.py:155``).
+
+    Bundle protocol (all keys registered at build time):
+
+    * ``"context_encoding"(target_params, ids, positions, tcache)`` and
+      ``"draft_context_encoding"(draft_params, ids, positions, dcache)`` —
+      prompt prefill for each model;
+    * ``"speculation"(target_params, draft_params, tcache, dcache,
+      committed, pos, filled, out) -> (tcache, dcache, committed, pos,
+      filled, out, accepted)`` — one compiled speculative round
+      (:func:`..inference.speculative.make_speculation_round_fn`);
+    * ``params`` saved as ``{"target": ..., "draft": ...}``;
+    * ``generation_config``: ``speculation_length``, ``buckets``,
+      ``draft_state_spec`` (init kwargs for the draft KV cache; the target's
+      comes from ``state_spec`` as usual).
+
+    Greedy-exactness carries over from the eager path: output equals the
+    target model's own greedy decoding.
+    """
+    import numpy as np
+
+    from .generation import pick_bucket
+    from .kv_cache import PAD_POSITION, init_kv_cache
+
+    if model.params is None or "target" not in model.params:
+        raise ValueError(
+            'speculative bundles carry params={"target":..., "draft":...}')
+    gc = model.generation_config or {}
+    k = int(gc["speculation_length"])
+    tp_, dp_ = model.params["target"], model.params["draft"]
+    input_ids = jnp.asarray(input_ids)
+    prompt_len = jnp.asarray(prompt_len)
+    b, s = input_ids.shape
+    bucket = pick_bucket(s, gc.get("buckets", (s,)))
+    if bucket > s:
+        input_ids = jnp.pad(input_ids, ((0, 0), (0, bucket - s)))
+
+    tcache = model.init_state()
+    dspec = dict(gc["draft_state_spec"])
+    dspec["dtype"] = jnp.dtype(dspec.get("dtype", "bfloat16"))
+    dcache = init_kv_cache(**dspec)
+
+    ar = jnp.broadcast_to(jnp.arange(bucket), (b, bucket))
+    positions = jnp.where(ar < prompt_len[:, None], ar, PAD_POSITION)
+    tlogits, tcache = model.forward("context_encoding", tp_, input_ids,
+                                    positions, tcache)
+    _, dcache = model.forward("draft_context_encoding", dp_, input_ids,
+                              positions, dcache)
+    committed = jnp.argmax(jnp.take_along_axis(
+        tlogits, (prompt_len - 1)[:, None, None], axis=1)[:, 0], axis=-1)
+
+    out = jnp.zeros((b, max_new_tokens + k + 1), jnp.int32)
+    out = out.at[:, 0].set(committed)
+    filled = jnp.ones((b,), jnp.int32)
+    pos = prompt_len
+    while int(np.min(np.asarray(filled))) < max_new_tokens:
+        (tcache, dcache, committed, pos, filled, out, _) = model.forward(
+            "speculation", tp_, dp_, tcache, dcache, committed, pos,
+            filled, out)
+    return out[:, :max_new_tokens]
+
+
 def shard_checkpoint(params: Any, param_specs: Any) -> Any:
     """Place a host/replicated param tree onto the mesh per its specs
     (reference ``shard_checkpoint:817`` produced per-rank weight dicts; with
     GSPMD the 'sharded checkpoint' IS the NamedSharding placement)."""
-    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.sharding import PartitionSpec
 
     from ..parallel import mesh as ps
 
-    mesh = ps.get_mesh()
     shardings = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), param_specs,
+        ps.named_sharding_for_spec, param_specs,
         is_leaf=lambda s: isinstance(s, PartitionSpec))
     return jax.device_put(params, shardings)

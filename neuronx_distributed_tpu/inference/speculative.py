@@ -152,6 +152,57 @@ def _emit_and_scatter(out, filled, drafted, greedy, accepted,
     return out, emit, jnp.minimum(filled + accepted + 1, max_new_tokens)
 
 
+def make_speculation_round_fn(cfg, draft_cfg, speculation_length: int,
+                              max_new_tokens: int):
+    """One full speculative ROUND as a jittable function — the unit the
+    serving bundle registers under the ``"speculation"`` key (reference
+    registers speculation as a first-class builder key,
+    ``examples/inference/modules/model_base.py:155``).
+
+    Signature: ``(params, draft_params, tcache, dcache, committed [B],
+    pos [B], filled [B], out [B, max_new+K+1]) -> (tcache, dcache,
+    committed, pos, filled, out, accepted [B])``. Static shapes; safe to
+    trace/export.
+    """
+    from ..models.llama import llama_forward_with_cache
+
+    k = speculation_length
+
+    def round_fn(params, draft_params, tcache, dcache, committed, pos,
+                 filled, out):
+        # 1. draft K tokens autoregressively
+        def draft_step(c, _):
+            dc, tok, p = c
+            logits, dc = llama_forward_with_cache(
+                draft_cfg, draft_params, tok[:, None], p[:, None], dc)
+            nxt = jnp.argmax(logits[:, 0], axis=-1)
+            return (dc, nxt, p + 1), nxt
+
+        (dcache, _, _), drafted = lax.scan(
+            draft_step, (dcache, committed, pos), None, length=k)
+        drafted = jnp.swapaxes(drafted, 0, 1)              # [B, K]
+
+        # 2. one target forward over [committed, drafts]
+        block = jnp.concatenate([committed[:, None], drafted], axis=1)
+        positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+        t_index = tcache.index
+        logits, tcache = llama_forward_with_cache(cfg, params, block,
+                                                  positions, tcache)
+
+        # 3. accept/reject, 4. slot-masking rollback, 5. emit
+        accepted, greedy = verify_draft_greedy(logits, drafted)
+        tcache = _mask_rejected_slots(tcache, t_index, k + 1, accepted)
+        dcache = _mask_rejected_slots(dcache, dcache.index - k, k, accepted)
+        out, _, filled = _emit_and_scatter(out, filled, drafted, greedy,
+                                           accepted, max_new_tokens)
+        new_committed = jnp.take_along_axis(greedy, accepted[:, None],
+                                            axis=1)[:, 0]
+        return (tcache, dcache, new_committed, pos + accepted + 1, filled,
+                out, accepted)
+
+    return round_fn
+
+
 def speculative_generate(cfg, params, draft_cfg, draft_params, input_ids,
                          prompt_len, max_new_tokens: int,
                          speculation_length: int = 4,
@@ -192,42 +243,17 @@ def speculative_generate(cfg, params, draft_cfg, draft_params, input_ids,
     out0 = jnp.zeros((b, max_new_tokens + k + 1), jnp.int32)
     out0 = out0.at[:, 0].set(committed0)
 
+    round_fn = make_speculation_round_fn(cfg, draft_cfg, k, max_new_tokens)
+
     def run(carry, params, draft_params):
         def round_body(carry):
             (tcache, dcache, committed, pos, filled, out, acc_sum,
              rounds) = carry
-
-            # 1. draft K tokens autoregressively
-            def draft_step(c, _):
-                dc, tok, p = c
-                logits, dc = llama_forward_with_cache(
-                    draft_cfg, draft_params, tok[:, None], p[:, None], dc)
-                nxt = jnp.argmax(logits[:, 0], axis=-1)
-                return (dc, nxt, p + 1), nxt
-
-            (dcache, _, _), drafted = lax.scan(
-                draft_step, (dcache, committed, pos), None, length=k)
-            drafted = jnp.swapaxes(drafted, 0, 1)          # [B, K]
-
-            # 2. one target forward over [committed, drafts]
-            block = jnp.concatenate([committed[:, None], drafted], axis=1)
-            positions = pos[:, None] + jnp.arange(k + 1)[None, :]
-            t_index = tcache.index
-            logits, tcache = llama_forward_with_cache(cfg, params, block,
-                                                      positions, tcache)
-
-            # 3. accept/reject, 4. slot-masking rollback, 5. emit
-            accepted, greedy = verify_draft_greedy(logits, drafted)
-            tcache = _mask_rejected_slots(tcache, t_index, k + 1, accepted)
-            dcache = _mask_rejected_slots(dcache, dcache.index - k, k,
-                                          accepted)
-            out, _, filled = _emit_and_scatter(out, filled, drafted, greedy,
-                                               accepted, max_new_tokens)
-
-            new_committed = jnp.take_along_axis(greedy, accepted[:, None],
-                                                axis=1)[:, 0]
-            return (tcache, dcache, new_committed, pos + accepted + 1,
-                    filled, out, acc_sum + jnp.sum(accepted), rounds + 1)
+            (tcache, dcache, committed, pos, filled, out,
+             accepted) = round_fn(params, draft_params, tcache, dcache,
+                                  committed, pos, filled, out)
+            return (tcache, dcache, committed, pos, filled, out,
+                    acc_sum + jnp.sum(accepted), rounds + 1)
 
         def cond(carry):
             return jnp.any(carry[4] < max_new_tokens)
