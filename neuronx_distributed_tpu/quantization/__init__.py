@@ -1,17 +1,29 @@
 """Quantization (reference: ``quantization/``)."""
 
+from . import microscaling
+from . import mx_layers
 from . import quantization_layers
 from . import quantization_utils
 from . import quantize as quantize_api
+from .mx_layers import (MXExpertMLPs, MXQuantizedColumnParallel,
+                        MXQuantizedRowParallel, mx_pack_expert_params,
+                        mx_pack_linear)
 from .quantization_layers import QuantizedColumnParallel, QuantizedRowParallel
 from .quantization_utils import (QuantizationType, QuantizedDtype,
                                  dequantize, direct_cast_quantize, quantize)
 from .quantize import convert
 
 __all__ = [
+    "microscaling",
+    "mx_layers",
     "quantization_layers",
     "quantization_utils",
     "quantize_api",
+    "MXExpertMLPs",
+    "MXQuantizedColumnParallel",
+    "MXQuantizedRowParallel",
+    "mx_pack_expert_params",
+    "mx_pack_linear",
     "QuantizedColumnParallel",
     "QuantizedRowParallel",
     "QuantizationType",
