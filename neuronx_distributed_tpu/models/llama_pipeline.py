@@ -24,6 +24,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..modules import attention as attn_mod
 from ..modules.norms import RMSNorm
+from ..parallel import comm as comm_mod
 from ..parallel import layers as pl
 from ..parallel import loss_functions as lf
 from ..parallel import mappings
@@ -142,7 +143,8 @@ def make_pipeline_grad_fn(cfg: LlamaConfig, num_microbatches: int,
                           param_specs: Any,
                           ignore_index: int = -100,
                           schedule: str = "gpipe",
-                          num_chunks: int = 1):
+                          num_chunks: int = 1,
+                          vocab_pp: bool = False):
     """Build ``grad_fn(params, batch) -> (loss, grads)`` for
     :func:`..trainer.make_train_step`.
 
@@ -167,9 +169,11 @@ def make_pipeline_grad_fn(cfg: LlamaConfig, num_microbatches: int,
     if schedule in ("1f1b", "interleaved"):
         return make_1f1b_grad_fn(
             cfg, num_microbatches, param_specs, num_chunks=num_chunks,
-            ignore_index=ignore_index)
+            ignore_index=ignore_index, vocab_pp=vocab_pp)
     if schedule != "gpipe":
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if vocab_pp:
+        raise ValueError("vocab_pp requires schedule='1f1b'/'interleaved'")
 
     pp_loss = pipelined_loss_fn(cfg, num_microbatches, ignore_index)
 
@@ -231,7 +235,7 @@ def deinterleave_pipeline_params(variables: Any, cfg: LlamaConfig,
 
 def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
                       param_specs: Any, num_chunks: int = 1,
-                      ignore_index: int = -100):
+                      ignore_index: int = -100, vocab_pp: bool = False):
     """1F1B / interleaved executor (:mod:`..pipeline.engine_1f1b`).
 
     Unlike the GPipe path, forward and backward interleave explicitly and
@@ -245,6 +249,14 @@ def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
     :func:`deinterleave_pipeline_params` before checkpoint export); passing
     a canonical-order tree would silently train a layer-permuted model.
 
+    ``vocab_pp=True`` additionally shards the embedding table and LM head
+    over the pp axis (vocab dim ``(pp, tp)``): every stage holds a
+    ``1/(S·tp)`` vocab shard of the params and of the engine's f32 grad
+    accumulators instead of a pp-replicated copy — the SPMD counterpart of
+    the reference placing shared vocab weights only on owning stages
+    (``pipeline/model.py:750,791``), at the cost of ~3 act-sized pp psums
+    per embed/head tick.
+
     NOTE: :func:`.mixtral_pipeline.make_moe_1f1b_grad_fn` mirrors this
     scaffolding (adding router-aux seeding); keep the two in sync.
     """
@@ -254,26 +266,48 @@ def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
     if not cfg.scan_layers:
         raise ValueError("pipeline path requires scan_layers=True")
     C = num_chunks
+    vocab_axis = (ps.PP_AXIS, ps.TP_AXIS) if vocab_pp else ps.TP_AXIS
 
     embed_mod = pl.ParallelEmbedding(
         num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
+        axis=vocab_axis,
         dtype=cfg.dtype, param_dtype=cfg.param_dtype)
     norm_mod = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                        sequence_parallel=cfg.sequence_parallel)
+    # under vocab_pp the SP gather stays a tp collective (explicit in
+    # head_loss_fn) while the kernel/collectives span (pp, tp)
     head_mod = pl.ColumnParallelLinear(
         features=cfg.vocab_size, use_bias=False, gather_output=False,
-        sequence_parallel=cfg.sequence_parallel,
+        sequence_parallel=cfg.sequence_parallel and not vocab_pp,
+        axis=vocab_axis,
         dtype=cfg.dtype, param_dtype=cfg.param_dtype)
 
     def inner(params, ids, labels):
         p = params["params"]
         S = ps.get_pipeline_model_parallel_size()
         M = num_microbatches
-        if cfg.num_layers % (S * C) != 0:
-            raise ValueError(
-                f"num_layers {cfg.num_layers} not divisible by "
-                f"stages*chunks {S * C}")
-        lv = cfg.num_layers // (S * C)
+        L = cfg.num_layers
+        if C == 1:
+            # uneven stage partition (reference cuts anywhere,
+            # pipeline/partition.py:280): zero-pad the scanned stack to a
+            # multiple of S — an all-zero decoder layer is an exact
+            # identity through the residual (attention out-proj and MLP
+            # down-proj are zero), and its grads are dropped by the final
+            # slice so the pad weights never move.
+            # MEMORY CAVEAT: a non-divisible stack cannot carry P('pp') so
+            # params/optimizer state stay pp-replicated and the grad stack
+            # psums over pp each step (trainer._spec_tree fallback); prefer
+            # divisible layer counts where the stack shards over pp
+            lv = -(-L // S)
+            l_pad = lv * S
+        else:
+            if L % (S * C) != 0:
+                raise ValueError(
+                    f"num_layers {L} not divisible by stages*chunks "
+                    f"{S * C} (uneven partition is supported for "
+                    "num_chunks=1)")
+            l_pad = L
+            lv = L // (S * C)
         denom = jnp.maximum(
             jnp.sum(labels != ignore_index).astype(jnp.float32), 1.0)
         cos, sin = attn_mod.precompute_rope(
@@ -306,18 +340,35 @@ def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
 
         def head_loss_fn(hp, act, lb):
             h = norm_mod.apply({"params": hp["norm"]}, act)
+            if vocab_pp and cfg.sequence_parallel:
+                h = mappings.gather_from_sequence_parallel_region(
+                    h, seq_dim=1, to_model_parallel=True)
             if tied:
                 logits = pl.embedding_attend(
-                    hp["table"], h, sequence_parallel=cfg.sequence_parallel,
+                    hp["table"], h, axis=vocab_axis,
+                    sequence_parallel=cfg.sequence_parallel and not vocab_pp,
                     dtype=cfg.dtype)
             else:
                 logits = head_mod.apply({"params": hp["lm_head"]}, h)
-            per_tok = lf.parallel_cross_entropy(logits, lb,
+            per_tok = lf.parallel_cross_entropy(logits, lb, axis=vocab_axis,
                                                 ignore_index=ignore_index)
             return jnp.sum(per_tok) / denom
 
         layers_c = jax.tree_util.tree_map(
-            lambda x: x.reshape((C, lv) + x.shape[1:]), p["model"]["layers"])
+            lambda x: jnp.concatenate(
+                [x, jnp.zeros((l_pad - L,) + x.shape[1:], x.dtype)])
+            if l_pad != L else x, p["model"]["layers"])
+        sliced = l_pad != L and S > 1
+        if sliced:
+            # non-divisible layer count: the stack arrives REPLICATED over
+            # pp (spec fallback in trainer._spec_tree); each stage slices
+            # its contiguous C*lv storage span in-graph
+            my = ps.get_pipeline_model_parallel_rank()
+            layers_c = jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_slice_in_dim(
+                    x, my * C * lv, C * lv, 0), layers_c)
+        layers_c = jax.tree_util.tree_map(
+            lambda x: x.reshape((C, lv) + x.shape[1:]), layers_c)
         head_p = {"norm": p["model"]["norm"]}
         if tied:
             head_p["table"] = p["model"]["embed"]["embedding"]
@@ -327,13 +378,36 @@ def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
                       "head": head_p}
         ids_mb = eng.microbatch(ids, M)
         labels_mb = eng.microbatch(labels, M)
+        m_run = M
+        if C > 1 and M % S != 0:
+            # lift the interleaved M % S constraint: pad microbatches whose
+            # labels are all ignore_index — their CE loss, head grads and
+            # stage cotangents are zero (denom counts real labels only)
+            m_run = -(-M // S) * S
+            ids_mb = jnp.concatenate(
+                [ids_mb, jnp.zeros((m_run - M,) + ids_mb.shape[1:],
+                                   ids_mb.dtype)])
+            labels_mb = jnp.concatenate(
+                [labels_mb, jnp.full((m_run - M,) + labels_mb.shape[1:],
+                                     ignore_index, labels_mb.dtype)])
 
         loss, g = e1.pipeline_1f1b_grads(
             embed_fn, stage_fn, head_loss_fn, eng_params, ids_mb, labels_mb,
-            num_stages=S, num_microbatches=M, num_chunks=C)
+            num_stages=S, num_microbatches=m_run, num_chunks=C,
+            num_real_microbatches=M, vocab_parallel_pp=vocab_pp)
 
         g_layers = jax.tree_util.tree_map(
             lambda x: x.reshape((C * lv,) + x.shape[2:]), g["layers"])
+        if sliced:
+            # re-assemble the replicated [L] gradient: scatter each stage's
+            # span into zeros and psum over pp (grads are primals here —
+            # the compute-inside-shard_map convention)
+            g_layers = jax.tree_util.tree_map(
+                lambda x: comm_mod.all_reduce(
+                    jax.lax.dynamic_update_slice_in_dim(
+                        jnp.zeros((l_pad,) + x.shape[1:], x.dtype), x,
+                        my * C * lv, 0), ps.PP_AXIS), g_layers)
+        g_layers = jax.tree_util.tree_map(lambda x: x[:L], g_layers)
         g_embed = dict(g["embed"])
         if tied:
             g_embed["embedding"] = (g_embed["embedding"]
@@ -344,15 +418,29 @@ def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
         if not tied:
             gp["lm_head"] = g["head"]["lm_head"]
         grads = {"params": gp}
-        grads = grads_mod.allreduce_gradients(grads, specs=param_specs)
+        grads = grads_mod.allreduce_gradients(grads, specs=run_specs)
         return eng.data_parallel_mean(loss), grads
+
+    run_specs = param_specs
+    if vocab_pp:
+        # the shard_map boundary reshards vocab params (pp, tp) on entry
+        # and reassembles the per-shard grads on exit; outer placement
+        # (trainer specs) is untouched
+        import copy
+
+        run_specs = copy.deepcopy(param_specs)
+        mp = run_specs["params"]["model"]
+        mp["embed"]["embedding"] = P((ps.PP_AXIS, ps.TP_AXIS), None)
+        if not cfg.tie_embeddings:
+            run_specs["params"]["lm_head"]["kernel"] = P(
+                None, (ps.PP_AXIS, ps.TP_AXIS))
 
     def grad_fn(params, batch):
         mesh = ps.get_mesh()
         return ps.shard_map(
             inner, mesh,
-            in_specs=(param_specs, P(ps.DP_AXIS, None), P(ps.DP_AXIS, None)),
-            out_specs=(P(), param_specs))(
+            in_specs=(run_specs, P(ps.DP_AXIS, None), P(ps.DP_AXIS, None)),
+            out_specs=(P(), run_specs))(
                 params, batch["input_ids"], batch["labels"])
 
     return grad_fn

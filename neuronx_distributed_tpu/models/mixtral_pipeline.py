@@ -236,13 +236,24 @@ def make_moe_1f1b_grad_fn(cfg: MixtralConfig, num_microbatches: int,
                                "lm_head": p["lm_head"]}}
         ids_mb = eng.microbatch(ids, M)
         labels_mb = eng.microbatch(labels, M)
+        m_run = M
+        if C > 1 and M % S != 0:
+            # pad microbatches with all-ignore labels (cf. llama_pipeline);
+            # their router aux is masked via num_real_microbatches
+            m_run = -(-M // S) * S
+            ids_mb = jnp.concatenate(
+                [ids_mb, jnp.zeros((m_run - M,) + ids_mb.shape[1:],
+                                   ids_mb.dtype)])
+            labels_mb = jnp.concatenate(
+                [labels_mb, jnp.full((m_run - M,) + labels_mb.shape[1:],
+                                     ignore_index, labels_mb.dtype)])
         aux_weight = jnp.asarray(
             [cfg.router_aux_coef, cfg.router_z_coef], jnp.float32) / M
 
         loss, g = e1.pipeline_1f1b_grads(
             embed_fn, stage_fn, head_loss_fn, eng_params, ids_mb, labels_mb,
-            num_stages=S, num_microbatches=M, num_chunks=C,
-            aux_weight=aux_weight)
+            num_stages=S, num_microbatches=m_run, num_chunks=C,
+            aux_weight=aux_weight, num_real_microbatches=M)
 
         g_layers = jax.tree_util.tree_map(
             lambda x: x.reshape((C * lv,) + x.shape[2:]), g["layers"])

@@ -27,22 +27,54 @@ from jax import lax
 from . import mesh as ps
 
 
-def _axis_size(axis: str) -> Optional[int]:
-    """Size of a bound axis, or None if the axis is not bound (GSPMD path).
+def _axis_size(axis) -> Optional[int]:
+    """Size of a bound axis (or product over a TUPLE of axes, counting only
+    the bound ones), or None if nothing is bound (GSPMD path).
 
     Uses the module-validated private accessor from :mod:`.mesh` — API drift
     raises at import, never a silent 'unbound' (see mesh.py)."""
+    if isinstance(axis, (tuple, list)):
+        sizes = [s for s in (_axis_size(a) for a in axis) if s is not None]
+        if not sizes:
+            return None
+        out = 1
+        for s in sizes:
+            out *= s
+        return out
     env = ps._get_axis_env()
     if env.axis_exists(axis):
         return int(env.axis_size(axis))
     return None
 
 
-def all_reduce(x: jax.Array, axis: str = ps.TP_AXIS) -> jax.Array:
+def _bound_names(axis) -> Tuple[str, ...]:
+    """The subset of ``axis`` (a name or tuple of names) currently bound,
+    preserving order (major-to-minor for combined-rank math)."""
+    names = axis if isinstance(axis, (tuple, list)) else (axis,)
+    env = ps._get_axis_env()
+    return tuple(a for a in names if env.axis_exists(a))
+
+
+def combined_axis_index(axis):
+    """Flat rank over a (possibly multi-) axis, major-to-minor — the rank a
+    dim sharded with ``PartitionSpec((a1, a2))`` sees for its shard offset.
+    Zero when nothing is bound."""
+    names = _bound_names(axis)
+    if not names:
+        return jnp.zeros((), jnp.int32)
+    idx = lax.axis_index(names[0])
+    for a in names[1:]:
+        env = ps._get_axis_env()
+        idx = idx * int(env.axis_size(a)) + lax.axis_index(a)
+    return idx
+
+
+def all_reduce(x: jax.Array, axis=ps.TP_AXIS) -> jax.Array:
+    names = _bound_names(axis)
     n = _axis_size(axis)
-    if n is None or n == 1:
+    if not names or n is None or n == 1:
         return x
-    return lax.psum(x, axis)
+    return lax.psum(x, names if len(names) > 1 else names[0])
 
 
 def all_gather(x: jax.Array, axis: str = ps.TP_AXIS, dim: int = -1) -> jax.Array:

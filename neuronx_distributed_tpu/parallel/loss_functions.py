@@ -26,10 +26,12 @@ from . import mesh as ps
 # transpose to another psum and inflate gradients by the axis size.
 
 
-def _rank_or_zero(axis: str):
+def _rank_or_zero(axis):
+    """Flat shard rank over ``axis`` (a name or tuple, e.g. the vocab-over-
+    pp x tp layout of the pipeline engine's vocab-parallel head)."""
     if comm._axis_size(axis) is None:
         return 0
-    return lax.axis_index(axis)
+    return comm.combined_axis_index(axis)
 
 
 def parallel_cross_entropy(
@@ -59,7 +61,9 @@ def parallel_cross_entropy(
     # numerically stable global max; the shift carries no gradient
     local_max = jnp.max(logits, axis=-1)
     if n is not None and n > 1:
-        global_max = lax.pmax(lax.stop_gradient(local_max), axis)
+        names = comm._bound_names(axis)
+        global_max = lax.pmax(lax.stop_gradient(local_max),
+                              names if len(names) > 1 else names[0])
     else:
         global_max = lax.stop_gradient(local_max)
     shifted = logits - global_max[..., None]
@@ -98,7 +102,11 @@ def distributed_log_softmax(logits: jax.Array,
     logits = logits.astype(jnp.float32)
     local_max = lax.stop_gradient(jnp.max(logits, axis=-1))
     n = comm._axis_size(axis)
-    global_max = lax.pmax(local_max, axis) if (n and n > 1) else local_max
+    if n and n > 1:
+        names = comm._bound_names(axis)
+        local_max = lax.pmax(local_max,
+                             names if len(names) > 1 else names[0])
+    global_max = local_max
     shifted = logits - global_max[..., None]
     sum_exp = mappings.reduce_from_tensor_parallel_region(
         jnp.sum(jnp.exp(shifted), axis=-1), axis)

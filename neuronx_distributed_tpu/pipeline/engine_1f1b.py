@@ -103,6 +103,8 @@ def pipeline_1f1b_grads(
     num_chunks: int = 1,
     axis: str = ps.PP_AXIS,
     aux_weight: Optional[jax.Array] = None,
+    num_real_microbatches: Optional[int] = None,
+    vocab_parallel_pp: bool = False,
 ) -> Tuple[jax.Array, Dict[str, Any]]:
     """Run the full 1F1B (or interleaved, ``num_chunks>1``) fwd+bwd pipeline.
 
@@ -128,6 +130,22 @@ def pipeline_1f1b_grads(
         the loss as a primal, and every backward sub-slot seeds the aux
         cotangent with ``aux_weight`` explicitly, so aux gradients are
         exact without any cross-stage cotangent plumbing.
+      num_real_microbatches: with padded microbatches (lifting the
+        interleaved ``M % S`` constraint), the count of REAL ones — aux
+        accumulation skips the pad microbatches (their CE loss and grads
+        are already zero via all-ignore labels, but router aux is computed
+        on whatever activations the pad rows carry).
+      vocab_parallel_pp: embed/head params arrive sharded over pp (x tp) on
+        the vocab dim and ``embed_fn`` / ``head_loss_fn`` carry their own
+        pp-aware collectives (vocab dim ``(pp, tp)``, cf.
+        ``llama_pipeline.make_1f1b_grad_fn(vocab_pp=True)``). Embed and
+        head then run under schedule predicates that are UNIFORM across the
+        pp group (they depend only on the tick), so the collectives inside
+        are legal; every rank holds only a ``1/(S·tp)`` vocab shard of the
+        params AND of the f32 grad accumulators — the memory property the
+        reference gets from placing shared weights on owning stages only
+        (``pipeline/model.py:750,791``). Costs ~3 extra act-sized pp psums
+        per firing tick (embed fwd, head act broadcast, embed bwd seed).
 
     Returns ``(local_loss, grads)`` with ``grads`` shaped like ``params``
     (pp-replicated leaves already psum'd over pp; data-axis sync is the
@@ -135,10 +153,13 @@ def pipeline_1f1b_grads(
     """
     S, M, C = num_stages, num_microbatches, num_chunks
     SC = S * C
+    M_real = M if num_real_microbatches is None else num_real_microbatches
     if C > 1 and M % S != 0:
         raise ValueError(
             f"interleaved schedule requires num_microbatches {M} divisible "
-            f"by pipeline stages {S}")
+            f"by pipeline stages {S} (pad microbatches with all-ignore "
+            "labels and pass num_real_microbatches — the model grad_fns do "
+            "this automatically)")
     bound = comm._axis_size(axis)
     if bound is None and S > 1:
         raise ValueError(
@@ -196,11 +217,23 @@ def pipeline_1f1b_grads(
         sigma_f = (f // S) * SC + c_f * S + (f % S)
         ids_f = lax.dynamic_index_in_dim(ids_mb, f, 0, keepdims=False)
 
-        x_emb = lax.cond(
-            fvalid & (my == 0) & (c_f == 0),
-            lambda ep, i: embed_fn(ep, i).astype(zero_act.dtype),
-            lambda ep, i: zero_act,
-            embed_p, ids_f)
+        if vocab_parallel_pp:
+            # stage-0's schedule decoded WITHOUT the rank offset: a
+            # predicate uniform across pp, so the vocab collectives inside
+            # embed_fn are legal under the cond
+            v0, f0, c0 = slot_decode(t)
+            ids_f0 = lax.dynamic_index_in_dim(ids_mb, f0, 0, keepdims=False)
+            x_emb = lax.cond(
+                v0 & (c0 == 0),
+                lambda ep, i: embed_fn(ep, i).astype(zero_act.dtype),
+                lambda ep, i: zero_act,
+                embed_p, ids_f0)
+        else:
+            x_emb = lax.cond(
+                fvalid & (my == 0) & (c_f == 0),
+                lambda ep, i: embed_fn(ep, i).astype(zero_act.dtype),
+                lambda ep, i: zero_act,
+                embed_p, ids_f)
         inp = jnp.where((my == 0) & (c_f == 0), x_emb, act_recv)
         # bubble ticks (fvalid False) cost control flow, not a full forward
         # (reference schedules simply emit no task; in the scanned SPMD
@@ -208,7 +241,8 @@ def pipeline_1f1b_grads(
         out, aux_f = lax.cond(
             fvalid, stage_call, lambda cp, a: zero_stage_out,
             pick_chunk(c_f), inp)
-        aux_acc = aux_acc + aux_f.astype(jnp.float32)
+        aux_acc = aux_acc + (aux_f.astype(jnp.float32)
+                             * (f < M_real).astype(jnp.float32))
         prev_in_slot = lax.dynamic_index_in_dim(buf, sigma_f % W, 0,
                                                 keepdims=False)
         buf = lax.dynamic_update_index_in_dim(
@@ -229,12 +263,34 @@ def pipeline_1f1b_grads(
             return loss_b, jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), dhp), dact
 
-        head_pred = bvalid & (my == S - 1) & (c_b == C - 1)
-        loss_b, dhead_b, dact_head = lax.cond(
-            head_pred, head_vjp,
-            lambda hp, act, lb: (jnp.zeros((), jnp.float32), f32(head_p),
-                                 jnp.zeros_like(act)),
-            head_p, out, labels_b)
+        if vocab_parallel_pp:
+            # last-stage schedule decoded uniformly; the last stage's
+            # activation is broadcast over pp (primal-only psum), every
+            # rank evaluates the vocab-sharded head on its shard, and the
+            # replicated dact feeds only the last stage's backward ring
+            vL, bL, cposL = slot_decode(t - (SC - 1))
+            c_bL = (C - 1) - cposL
+            labels_bL = lax.dynamic_index_in_dim(labels_mb, bL, 0,
+                                                 keepdims=False)
+
+            def head_vjp_pp(hp, out_, lb):
+                act_b = comm.all_reduce(
+                    jnp.where(my == S - 1, out_, jnp.zeros_like(out_)),
+                    axis)
+                return head_vjp(hp, act_b, lb)
+
+            loss_b, dhead_b, dact_head = lax.cond(
+                vL & (c_bL == C - 1), head_vjp_pp,
+                lambda hp, act, lb: (jnp.zeros((), jnp.float32),
+                                     f32(head_p), jnp.zeros_like(act)),
+                head_p, out, labels_bL)
+        else:
+            head_pred = bvalid & (my == S - 1) & (c_b == C - 1)
+            loss_b, dhead_b, dact_head = lax.cond(
+                head_pred, head_vjp,
+                lambda hp, act, lb: (jnp.zeros((), jnp.float32),
+                                     f32(head_p), jnp.zeros_like(act)),
+                head_p, out, labels_b)
         loss_acc = loss_acc + loss_b
         g_head = jax.tree_util.tree_map(jnp.add, g_head, dhead_b)
 
@@ -247,7 +303,8 @@ def pipeline_1f1b_grads(
 
         def bwd_run(cp, saved, dout_):
             _, s_vjp = jax.vjp(stage_call, cp, saved)
-            aux_ct = (aux_weight.astype(jnp.float32) if has_aux
+            aux_ct = (aux_weight.astype(jnp.float32)
+                      * (b < M_real).astype(jnp.float32) if has_aux
                       else jnp.zeros((0,), jnp.float32))
             dchunk_, dact_ = s_vjp((dout_.astype(act_shape.dtype), aux_ct))
             return (jax.tree_util.tree_map(
@@ -274,11 +331,29 @@ def pipeline_1f1b_grads(
             return jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), dep)
 
-        ids_b = lax.dynamic_index_in_dim(ids_mb, b, 0, keepdims=False)
-        dembed_b = lax.cond(
-            bvalid & (my == 0) & (c_b == 0), embed_vjp,
-            lambda ep, i, d: f32(embed_p),
-            embed_p, ids_b, dact_in)
+        if vocab_parallel_pp:
+            # stage-0's backward schedule decoded uniformly; its dact is
+            # broadcast (primal psum) and every rank accumulates ITS vocab
+            # shard of the embedding gradient
+            vb0, b0, cpos0 = slot_decode(t - (SC - 1) - (S - 1))
+            ids_b0 = lax.dynamic_index_in_dim(ids_mb, b0, 0, keepdims=False)
+
+            def embed_vjp_pp(ep, i, d_local):
+                d = comm.all_reduce(
+                    jnp.where(my == 0, d_local, jnp.zeros_like(d_local)),
+                    axis)
+                return embed_vjp(ep, i, d)
+
+            dembed_b = lax.cond(
+                vb0 & (((C - 1) - cpos0) == 0), embed_vjp_pp,
+                lambda ep, i, d: f32(embed_p),
+                embed_p, ids_b0, dact_in)
+        else:
+            ids_b = lax.dynamic_index_in_dim(ids_mb, b, 0, keepdims=False)
+            dembed_b = lax.cond(
+                bvalid & (my == 0) & (c_b == 0), embed_vjp,
+                lambda ep, i, d: f32(embed_p),
+                embed_p, ids_b, dact_in)
         g_embed = jax.tree_util.tree_map(jnp.add, g_embed, dembed_b)
 
         # ---- ring communications ----------------------------------------
@@ -302,7 +377,12 @@ def pipeline_1f1b_grads(
 
     # loss lives on the last stage; replicate over pp (primal psum is safe —
     # no cotangent crosses here, grads are already explicit)
-    if bound is not None and bound > 1:
+    if vocab_parallel_pp and bound is not None and bound > 1:
+        # every rank already accumulated the replicated loss and ITS vocab
+        # shard of the embed/head grads — nothing to psum except aux
+        loss = loss_acc
+        aux_acc = lax.psum(aux_acc, axis)
+    elif bound is not None and bound > 1:
         loss = lax.psum(jnp.where(my == S - 1, loss_acc, 0.0), axis)
         aux_acc = lax.psum(aux_acc, axis)
         g_embed = jax.tree_util.tree_map(

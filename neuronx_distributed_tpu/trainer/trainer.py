@@ -58,38 +58,56 @@ class ParallelModel:
 def _spec_tree(boxed_variables, logical_axis_rules=None) -> Any:
     """PartitionSpec tree from flax Partitioned metadata. Logical axis names
     that are not mesh axes are mapped through ``logical_axis_rules`` (e.g.
-    ``{"layers": "pp"}`` for pipeline parallelism) and otherwise replicated."""
+    ``{"layers": "pp"}`` for pipeline parallelism) and otherwise replicated.
+
+    A RULE-mapped axis whose dim is not divisible by the mesh axis size
+    falls back to replication (an odd layer count over pp: the pipeline
+    grad_fn then slices stages in-graph). Direct mesh-axis annotations
+    (e.g. tp on a hidden dim) keep failing loudly — those are genuine
+    misconfigurations.
+    """
     specs = nn.get_partition_spec(boxed_variables)
-    mesh_axes = set(ps.get_mesh().axis_names)
+    mesh = ps.get_mesh()
+    mesh_axes = set(mesh.axis_names)
+    sizes = dict(mesh.shape)
     if ps.get_expert_model_parallel_size() > 1:
         # expert-view axes stay in the spec: such params are placed on the
         # expert mesh view (ps.named_sharding_for_spec), making GSPMD EP
         # shard expert weights over ep instead of replicating them
-        mesh_axes |= set(ps.get_expert_mesh().axis_names)
+        em = ps.get_expert_mesh()
+        mesh_axes |= set(em.axis_names)
+        sizes.update(em.shape)
     rules = logical_axis_rules or {}
 
-    def map_axis(a):
+    def map_axis(a, dim_size):
         if a in mesh_axes:
             return a
-        return rules.get(a)
+        m = rules.get(a)
+        if (m is not None and dim_size is not None
+                and dim_size % sizes.get(m, 1) != 0):
+            return None
+        return m
 
-    def clean(spec):
+    def clean(spec, shape):
         if not isinstance(spec, PartitionSpec):
             return PartitionSpec()
+        dims = list(shape) + [None] * (len(spec) - len(shape))
         out = []
-        for p in spec:
+        for p, d in zip(spec, dims):
             if p is None:
                 out.append(None)
             elif isinstance(p, tuple):
-                kept = tuple(m for m in (map_axis(a) for a in p)
+                kept = tuple(m for m in (map_axis(a, d) for a in p)
                              if m is not None)
                 out.append(kept if kept else None)
             else:
-                out.append(map_axis(p))
+                out.append(map_axis(p, d))
         return PartitionSpec(*out)
 
+    shapes = jax.tree_util.tree_map(
+        lambda x: tuple(jnp.shape(x)), meta.unbox(boxed_variables))
     return jax.tree_util.tree_map(
-        clean, specs, is_leaf=lambda s: isinstance(s, PartitionSpec))
+        clean, specs, shapes, is_leaf=lambda s: isinstance(s, PartitionSpec))
 
 
 def initialize_parallel_model(

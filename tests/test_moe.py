@@ -648,6 +648,49 @@ def test_mixtral_1f1b_matches_dense(num_chunks, sp):
             atol=5e-5, err_msg=jax.tree_util.keystr(path))
 
 
+def test_mixtral_interleaved_m_not_divisible_matches_dense():
+    """MoE interleaved with M % S != 0 (M=6, S=2, C=2): pad microbatches
+    run the router on garbage activations, so their aux contribution must
+    be masked in BOTH the forward accumulation (f < M_real) and the
+    backward aux seeding (b < M_real) — grads stay exact vs the dense
+    composite."""
+    from neuronx_distributed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                        tiny_moe_config)
+    from neuronx_distributed_tpu.models import mixtral_pipeline as mpp
+    from neuronx_distributed_tpu.models.llama_pipeline import (
+        deinterleave_pipeline_params, interleave_pipeline_params)
+    from neuronx_distributed_tpu.trainer import initialize_parallel_model
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=2, pipeline_parallel_size=2)
+    mcfg = tiny_moe_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                           num_layers=4, tp_size=2,
+                           moe_dispatch="blockwise", moe_block_size=16)
+    model = MixtralForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(97), (12, 17), 0,
+                             mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(
+        cfg, model, jax.random.key(98), batch["input_ids"],
+        logical_axis_rules=mpp.PIPELINE_LOGICAL_RULES)
+    grad_fn = mpp.make_moe_1f1b_grad_fn(mcfg, num_microbatches=6,
+                                        param_specs=pm.param_specs,
+                                        num_chunks=2)
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+    dense_loss, dense_grads = jax.value_and_grad(
+        _dense_moe_composite(model, mcfg, batch))(host_params)
+    run_params = interleave_pipeline_params(host_params, mcfg, 2, 2)
+    pp_loss, pp_grads = jax.jit(grad_fn)(run_params, batch)
+    pp_grads = deinterleave_pipeline_params(
+        jax.tree_util.tree_map(np.asarray, pp_grads), mcfg, 2, 2)
+    np.testing.assert_allclose(float(pp_loss), float(dense_loss), rtol=2e-4)
+    flat_ref = dict(jax.tree_util.tree_leaves_with_path(dense_grads))
+    for path, g in jax.tree_util.tree_leaves_with_path(pp_grads):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(flat_ref[path]), rtol=5e-3,
+            atol=5e-5, err_msg=jax.tree_util.keystr(path))
+
+
 @pytest.mark.parametrize("tp,ep", [(1, 4), (2, 2)])
 def test_blockwise_bound_ep_parity_and_grads(tp, ep):
     """Dropless blockwise under a BOUND ep axis (shard_map, optionally x tp)
