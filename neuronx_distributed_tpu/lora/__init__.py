@@ -120,7 +120,11 @@ def merge_lora_params(params: Any, cfg: LoraConfig) -> Any:
 
     def ab(a, b):
         # a: [h, r] or [L, h, r] (stacked scan layers); b matches with a
-        # possibly >2-D output tail (fused gate_up [r, 2, I])
+        # possibly >2-D output tail (fused gate_up [r, 2, I]). Conv pairs:
+        # a [kh, kw, cin, r] with a 1x1 b [1, 1, r, cout] compose into one
+        # conv kernel (B is pointwise, so the composition is exact).
+        if a.ndim == 4 and b.ndim == 4:
+            return jnp.einsum("hwir,ro->hwio", a, b[0, 0])
         if a.ndim == 2:
             return jnp.einsum("hr,r...->h...", a, b)
         return jnp.einsum("lhr,lr...->lh...", a, b)

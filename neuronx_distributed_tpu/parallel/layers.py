@@ -146,6 +146,8 @@ class OutputChannelParallelConv2d(nn.Module):
     param_dtype: Dtype = jnp.float32
     kernel_init: Initializer = default_kernel_init
     axis: str = ps.TP_AXIS
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -155,11 +157,36 @@ class OutputChannelParallelConv2d(nn.Module):
             "kernel",
             _partitioned(self.kernel_init, (None, None, None, self.axis)),
             (kh, kw, x.shape[-1], out_local), self.param_dtype)
+        lora_a = lora_b = None
+        if self.lora_rank > 0:
+            # LoRA for convs (reference modules/lora/layer.py:331): A is a
+            # same-geometry conv into the rank, B a 1x1 conv out of it; B's
+            # out channels shard like the base kernel so the adapter rides
+            # the layer's collectives
+            lora_a = self.param(
+                "lora_a",
+                _partitioned(default_kernel_init, (None, None, None, None)),
+                (kh, kw, x.shape[-1], self.lora_rank), self.param_dtype)
+            lora_b = self.param(
+                "lora_b",
+                _partitioned(nn.initializers.zeros_init(),
+                             (None, None, None, self.axis)),
+                (1, 1, self.lora_rank, out_local), self.param_dtype)
         x = mappings.copy_to_tensor_parallel_region(x, self.axis)
         y = jax.lax.conv_general_dilated(
             x.astype(self.dtype), kernel.astype(self.dtype),
             window_strides=self.strides, padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if lora_a is not None:
+            scale = self.lora_alpha / self.lora_rank
+            ya = jax.lax.conv_general_dilated(
+                x.astype(self.dtype), lora_a.astype(self.dtype),
+                window_strides=self.strides, padding=self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            y = y + scale * jax.lax.conv_general_dilated(
+                ya, lora_b.astype(self.dtype), window_strides=(1, 1),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.use_bias:
             bias = self.param("bias",
                               _partitioned(nn.initializers.zeros_init(),
@@ -187,6 +214,8 @@ class InputChannelParallelConv2d(nn.Module):
     param_dtype: Dtype = jnp.float32
     kernel_init: Initializer = default_kernel_init
     axis: str = ps.TP_AXIS
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -201,6 +230,29 @@ class InputChannelParallelConv2d(nn.Module):
             x.astype(self.dtype), kernel.astype(self.dtype),
             window_strides=self.strides, padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.lora_rank > 0:
+            # A's input channels shard with the base kernel; the adapter's
+            # partial sums join the base partials in the SAME exit
+            # all-reduce below
+            lora_a = self.param(
+                "lora_a",
+                _partitioned(default_kernel_init,
+                             (None, None, self.axis, None)),
+                (kh, kw, x.shape[-1], self.lora_rank), self.param_dtype)
+            lora_b = self.param(
+                "lora_b",
+                _partitioned(nn.initializers.zeros_init(),
+                             (None, None, None, None)),
+                (1, 1, self.lora_rank, self.features), self.param_dtype)
+            scale = self.lora_alpha / self.lora_rank
+            ya = jax.lax.conv_general_dilated(
+                x.astype(self.dtype), lora_a.astype(self.dtype),
+                window_strides=self.strides, padding=self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            y = y + scale * jax.lax.conv_general_dilated(
+                ya, lora_b.astype(self.dtype), window_strides=(1, 1),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
         y = mappings.reduce_from_tensor_parallel_region(y, self.axis)
         if self.use_bias:
             bias = self.param("bias",
@@ -346,7 +398,7 @@ class ParallelEmbedding(nn.Module):
                 out = out + scale * jnp.dot(lookup(lora_a, ids),
                                             lora_b.astype(self.dtype))
             return out
-        rank = jax.lax.axis_index(self.axis)
+        rank = comm.combined_axis_index(self.axis)
         start = rank * vocab_local
         local_ids = ids - start
         valid = (local_ids >= 0) & (local_ids < vocab_local)
