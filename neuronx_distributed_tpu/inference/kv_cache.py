@@ -69,6 +69,41 @@ def init_kv_cache(num_layers: int, batch: int, max_len: int,
                    index=jnp.zeros((), jnp.int32))
 
 
+def sharded_slot_update(cache_arr: jax.Array, new_rows: jax.Array,
+                        cache_index, axis: str, slot_dim: int = 1
+                        ) -> jax.Array:
+    """Write ``new_rows`` at GLOBAL slots ``[cache_index, cache_index+s)``
+    into a cache whose slot dim is SHARDED over ``axis`` (flash decoding:
+    each rank of the decode group holds ``L/axis`` slots, reference
+    KV-shared groups ``parallel_state.py:1473``).
+
+    A write may straddle shard boundaries (prefill), so this is a masked
+    gather per local slot rather than a dynamic_update_slice: local slot j
+    (global ``offset + j``) takes ``new_rows[..., offset + j -
+    cache_index, ...]`` when that lands in ``[0, s)``. Falls back to the
+    plain dynamic_update_slice when ``axis`` is unbound.
+    """
+    from jax import lax
+
+    from ..parallel import comm
+
+    s = new_rows.shape[slot_dim]
+    n = comm._axis_size(axis)
+    if n in (None, 1):
+        return lax.dynamic_update_slice_in_dim(cache_arr, new_rows,
+                                               cache_index, axis=slot_dim)
+    l_local = cache_arr.shape[slot_dim]
+    offset = lax.axis_index(axis) * l_local
+    j = jnp.arange(l_local)
+    write_idx = offset + j - cache_index                     # [L_local]
+    wmask = (write_idx >= 0) & (write_idx < s)
+    gathered = jnp.take(new_rows, jnp.clip(write_idx, 0, s - 1),
+                        axis=slot_dim)
+    mshape = [1] * cache_arr.ndim
+    mshape[slot_dim] = l_local
+    return jnp.where(wmask.reshape(mshape), gathered, cache_arr)
+
+
 # ---------------------------------------------------------------------------
 # Quantized KV cache (reference: kv_cache_quant config,
 # quantization_config.py:72). K/V stored int8 with one fp32 scale per

@@ -66,6 +66,11 @@ class LlamaConfig:
     remat: bool = False
     scan_layers: bool = True
     use_flash_attention: bool = False
+    # decode: shard the KV cache's SLOT dim over the cp axis and LSE-combine
+    # partial attention (ops.flash_decoding; reference KV-shared groups,
+    # parallel_state.py:1473 + trace/spmd.py:74). Long-context serving:
+    # cache memory and decode attention FLOPs split over the decode group.
+    use_flash_decoding: bool = False
     # context-parallel attention: "ring" (ppermute KV rotation) or
     # "ulysses" (all-to-all seq<->head resharding; needs heads % cp == 0)
     cp_attn_impl: str = "ring"
@@ -139,28 +144,48 @@ class LlamaAttention(nn.Module):
             # [B, S_max] holds each slot's true token position (PAD_POSITION
             # sentinel for pads), updated once per step by the caller.
             k_cache, v_cache, slot_pos = cache
-            k_cache = jax.lax.dynamic_update_slice_in_dim(
-                k_cache, k.astype(k_cache.dtype), cache_index, axis=1)
-            v_cache = jax.lax.dynamic_update_slice_in_dim(
-                v_cache, v.astype(v_cache.dtype), cache_index, axis=1)
-            new_cache = (k_cache, v_cache)
-            k_full = attn_mod.repeat_kv(k_cache.astype(cfg.dtype),
-                                        n_q_local // n_kv_local)
-            v_full = attn_mod.repeat_kv(v_cache.astype(cfg.dtype),
-                                        n_q_local // n_kv_local)
-            import math as _math
+            if cfg.use_flash_decoding:
+                # slot-sharded cache (flash decoding): masked write into
+                # this rank's slot shard, partial attention + LSE combine
+                # over the decode group (ops.flash_decoding)
+                from ..inference.kv_cache import sharded_slot_update
+                from ..ops.flash_decoding import flash_decode_attention
 
-            scale = 1.0 / _math.sqrt(head_dim)
-            scores = jnp.einsum(
-                "bqnd,bknd->bnqk", q.astype(jnp.float32),
-                k_full.astype(jnp.float32)) * scale
-            # causal mask by stored positions: pads carry PAD_POSITION and
-            # are never attended, so ragged batches need no extra mask
-            mask = positions[:, :, None] >= slot_pos[:, None, :]
-            scores = jnp.where(mask[:, None], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1)
-            out = jnp.einsum("bnqk,bknd->bqnd", probs,
-                             v_full.astype(jnp.float32)).astype(cfg.dtype)
+                k_cache = sharded_slot_update(
+                    k_cache, k.astype(k_cache.dtype), cache_index,
+                    ps.CP_AXIS)
+                v_cache = sharded_slot_update(
+                    v_cache, v.astype(v_cache.dtype), cache_index,
+                    ps.CP_AXIS)
+                new_cache = (k_cache, v_cache)
+                out = flash_decode_attention(
+                    q, k_cache.astype(cfg.dtype), v_cache.astype(cfg.dtype),
+                    slot_pos, positions, axis=ps.CP_AXIS).astype(cfg.dtype)
+            else:
+                k_cache = jax.lax.dynamic_update_slice_in_dim(
+                    k_cache, k.astype(k_cache.dtype), cache_index, axis=1)
+                v_cache = jax.lax.dynamic_update_slice_in_dim(
+                    v_cache, v.astype(v_cache.dtype), cache_index, axis=1)
+                new_cache = (k_cache, v_cache)
+                k_full = attn_mod.repeat_kv(k_cache.astype(cfg.dtype),
+                                            n_q_local // n_kv_local)
+                v_full = attn_mod.repeat_kv(v_cache.astype(cfg.dtype),
+                                            n_q_local // n_kv_local)
+                import math as _math
+
+                scale = 1.0 / _math.sqrt(head_dim)
+                scores = jnp.einsum(
+                    "bqnd,bknd->bnqk", q.astype(jnp.float32),
+                    k_full.astype(jnp.float32)) * scale
+                # causal mask by stored positions: pads carry PAD_POSITION
+                # and are never attended, so ragged batches need no extra
+                # mask
+                mask = positions[:, :, None] >= slot_pos[:, None, :]
+                scores = jnp.where(mask[:, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1)
+                out = jnp.einsum("bnqk,bknd->bqnd", probs,
+                                 v_full.astype(jnp.float32)
+                                 ).astype(cfg.dtype)
         else:
             from ..parallel import comm
 
@@ -471,8 +496,15 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
     # record this step's true positions in the slot-position table (pads
     # carry the PAD_POSITION sentinel and are thereby never attended);
     # shared by all layers, updated once here
-    slot_pos = jax.lax.dynamic_update_slice_in_dim(
-        kv_cache.pos, positions, kv_cache.index, axis=1)
+    if cfg.use_flash_decoding:
+        from ..inference.kv_cache import sharded_slot_update
+
+        slot_pos = sharded_slot_update(kv_cache.pos, positions,
+                                       kv_cache.index, ps.CP_AXIS,
+                                       slot_dim=1)
+    else:
+        slot_pos = jax.lax.dynamic_update_slice_in_dim(
+            kv_cache.pos, positions, kv_cache.index, axis=1)
     # rope lookup needs in-table indices; sentinel pads clamp to the last
     # entry (their K values are garbage but masked out)
     rope_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
