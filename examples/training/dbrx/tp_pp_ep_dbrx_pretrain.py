@@ -1,0 +1,99 @@
+"""DBRX pretraining launcher: TP x PP (1F1B) x EP with dropless experts.
+
+The analogue of the reference's DBRX example (``examples/training/dbrx``):
+DBRX is the fine-grained-MoE configuration — 16 experts, top-4, GQA — whose
+flagship parallel recipe composes tensor parallelism, pipeline parallelism
+(the executed 1F1B schedule) and expert parallelism with dropless
+(blockwise) dispatch.
+
+    python examples/training/dbrx/tp_pp_ep_dbrx_pretrain.py \
+        --tiny --tp 2 --pp 2 --microbatches 4 --steps 20
+"""
+
+import argparse
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.models import mixtral
+from neuronx_distributed_tpu.models import mixtral_pipeline as mpp
+from neuronx_distributed_tpu.trainer import (initialize_parallel_model,
+                                             initialize_parallel_optimizer,
+                                             make_train_step)
+from neuronx_distributed_tpu.trainer.loop import (CheckpointCallback,
+                                                  MetricsLogger, Trainer)
+
+# DBRX's routing shape at test scale: 16 fine-grained experts, top-4
+TINY_DBRX = mixtral.tiny_moe_config(num_experts=16, top_k=4, num_layers=2,
+                                    moe_dispatch="blockwise",
+                                    moe_block_size=16)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true",
+                    help="scaled-down DBRX for smoke runs")
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--ep", type=int, default=1)
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--sp", action="store_true",
+                    help="sequence parallelism (rides the 1F1B ring)")
+    ap.add_argument("--ckpt-dir", default=None)
+    args = ap.parse_args(argv)
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=args.tp,
+        pipeline_parallel_size=args.pp,
+        expert_parallel_size=args.ep,
+        sequence_parallel=args.sp,
+        optimizer_config=nxd.OptimizerConfig(zero_one_enabled=True),
+        activation_checkpoint_config=nxd.ActivationCheckpointConfig(
+            mode="full"),
+    )
+    base = TINY_DBRX if args.tiny else mixtral.DBRX
+    mcfg = nxd.configure_model(cfg, base)
+    mcfg = dataclasses.replace(mcfg, max_seq_len=max(args.seq, 128),
+                               sequence_parallel=args.sp,
+                               tp_size=args.tp)
+    model = mixtral.MixtralForCausalLM(mcfg)
+
+    rng = np.random.RandomState(0)
+
+    def batches():
+        while True:
+            ids = rng.randint(0, mcfg.vocab_size,
+                              (args.batch, args.seq + 1))
+            yield {"input_ids": jnp.asarray(ids[:, :-1]),
+                   "labels": jnp.asarray(ids[:, 1:])}
+
+    data = batches()
+    sample = next(data)
+    rules = mpp.PIPELINE_LOGICAL_RULES if args.pp > 1 else None
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(0),
+                                           sample["input_ids"],
+                                           logical_axis_rules=rules)
+    tx, state, sh = initialize_parallel_optimizer(pm, params, args.lr)
+    grad_fn = None
+    if args.pp > 1:
+        grad_fn = mpp.make_moe_1f1b_grad_fn(
+            mcfg, num_microbatches=args.microbatches,
+            param_specs=pm.param_specs)
+    step = make_train_step(pm, tx, sh, grad_fn=grad_fn)
+
+    callbacks = [MetricsLogger(every=10)]
+    if args.ckpt_dir:
+        callbacks.append(CheckpointCallback(args.ckpt_dir, every=100))
+    Trainer(step, state, callbacks=callbacks,
+            resume_path=args.ckpt_dir).fit(data, max_steps=args.steps)
+
+
+if __name__ == "__main__":
+    main()
