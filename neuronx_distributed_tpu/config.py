@@ -35,7 +35,15 @@ def configure_model(cfg: "NxDConfig", model_cfg: Any) -> Any:
         updates["dtype"] = jnp.dtype(cfg.mixed_precision.compute_dtype)
     if "tp_size" in fields:
         updates["tp_size"] = cfg.parallel.tensor_parallel_size
-    return dataclasses.replace(model_cfg, **updates)
+    model_cfg = dataclasses.replace(model_cfg, **updates)
+    if "num_experts" in fields:
+        # incoherent MoE knobs fail here with actionable errors instead of
+        # as shape errors inside a compiled program (reference
+        # moe_config_validator.py:13)
+        from .modules.moe.config_validator import validate_moe_config
+
+        model_cfg = validate_moe_config(model_cfg, cfg)
+    return model_cfg
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,110 @@
+"""MoE configuration validation.
+
+Analogue of the reference's ``modules/moe/moe_config_validator.py``
+(``MoeConfigValidator:13``): catch incoherent MoE knobs at configure time
+with actionable errors — dropless/activation coupling, capacity semantics,
+parallel-degree divisibility — instead of letting them surface as shape
+errors deep inside a compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+_DISPATCH_MODES = ("capacity", "blockwise")
+_EXPERT_IMPLS = ("float", "mx_fp4", "mx_fp8")
+_ROUTER_TYPES = ("top_k", "sinkhorn", "group_limited")
+
+MX_BLOCK = 32
+
+
+def validate_moe_config(model_cfg: Any, parallel_cfg: Optional[Any] = None):
+    """Validate (and lightly normalise) an MoE model config.
+
+    ``model_cfg``: a dataclass with MoE fields (``num_experts``, ``top_k``,
+    ``moe_dispatch``, ...— :class:`...models.mixtral.MixtralConfig` or any
+    config sharing its field names). ``parallel_cfg``: an
+    :class:`...config.NxDConfig` for degree-divisibility checks.
+
+    Returns the config unchanged on success. Raises ``ValueError`` with the
+    reference validator's style of actionable messages.
+    """
+    f = {fl.name for fl in dataclasses.fields(model_cfg)}
+    if "num_experts" not in f:
+        return model_cfg  # not an MoE config
+
+    e = model_cfg.num_experts
+    k = getattr(model_cfg, "top_k", 1)
+    if e < 1:
+        raise ValueError(f"num_experts must be >= 1, got {e}")
+    if not (1 <= k <= e):
+        raise ValueError(
+            f"top_k {k} must lie in [1, num_experts={e}]. Please adjust "
+            "your configuration.")
+
+    dispatch = getattr(model_cfg, "moe_dispatch", "capacity")
+    if dispatch not in _DISPATCH_MODES:
+        raise ValueError(
+            f"moe_dispatch must be one of {_DISPATCH_MODES}, got "
+            f"{dispatch!r}")
+    router = getattr(model_cfg, "router_type", "top_k")
+    if router not in _ROUTER_TYPES:
+        raise ValueError(
+            f"router_type must be one of {_ROUTER_TYPES}, got {router!r}")
+
+    cap = getattr(model_cfg, "capacity_factor", None)
+    if dispatch == "blockwise":
+        # dropless: capacity is meaningless (reference forces it to 0.0,
+        # moe_config_validator.py:108); the GLU/silu requirement is
+        # structural here — the expert bank IS a silu-GLU
+        bs = getattr(model_cfg, "moe_block_size", 0)
+        if bs < 1:
+            raise ValueError(
+                f"blockwise dispatch requires moe_block_size >= 1, got {bs}")
+        if cap is not None and cap not in (0.0, 2.0):
+            logger.warning(
+                "blockwise (dropless) dispatch ignores capacity_factor "
+                "(got %s)", cap)
+    else:
+        if cap is not None and cap <= 0.0:
+            raise ValueError(
+                "capacity dispatch requires capacity_factor > 0.0 "
+                f"(got {cap}); use moe_dispatch='blockwise' for dropless. "
+                "Please adjust your configuration.")
+        if getattr(model_cfg, "moe_sentinel_empty", False):
+            raise ValueError(
+                "moe_sentinel_empty (decode weight-DMA elision) only "
+                "applies to moe_dispatch='blockwise'")
+
+    impl = getattr(model_cfg, "moe_expert_impl", "float")
+    if impl not in _EXPERT_IMPLS:
+        raise ValueError(
+            f"moe_expert_impl must be one of {_EXPERT_IMPLS}, got {impl!r}")
+    if impl.startswith("mx_"):
+        h = getattr(model_cfg, "hidden_size", 0)
+        i = getattr(model_cfg, "intermediate_size", 0)
+        if h % MX_BLOCK or i % MX_BLOCK:
+            raise ValueError(
+                f"MX expert banks need hidden_size ({h}) and "
+                f"intermediate_size ({i}) divisible by the MX block "
+                f"({MX_BLOCK})")
+
+    if parallel_cfg is not None:
+        p = parallel_cfg.parallel
+        ep = p.expert_parallel_size
+        tp = p.tensor_parallel_size
+        if ep > 1 and e % ep != 0:
+            raise ValueError(
+                f"num_experts {e} not divisible by expert_parallel_size "
+                f"{ep}. Please adjust your configuration.")
+        i = getattr(model_cfg, "intermediate_size", 0)
+        if tp > 1 and i % tp != 0:
+            raise ValueError(
+                f"intermediate_size {i} not divisible by "
+                f"tensor_parallel_size {tp}")
+
+    return model_cfg

@@ -21,6 +21,7 @@ from .mesh import (
     destroy_model_parallel,
     get_mesh,
     get_expert_mesh,
+    get_moe_phase_mesh,
     TP_AXIS,
     PP_AXIS,
     DP_AXIS,
@@ -39,6 +40,7 @@ __all__ = [
     "destroy_model_parallel",
     "get_mesh",
     "get_expert_mesh",
+    "get_moe_phase_mesh",
     "TP_AXIS",
     "PP_AXIS",
     "DP_AXIS",

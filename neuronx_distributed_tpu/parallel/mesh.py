@@ -61,6 +61,7 @@ class _ParallelState:
         self.device_array: Optional[np.ndarray] = None  # [pp, dp, cp, tp]
         self.sizes: dict = {}
         self.aot_mode: bool = False
+        self.phase_meshes: dict = {}  # (tp, ep) -> Mesh view
 
 
 _STATE = _ParallelState()
@@ -208,6 +209,7 @@ def destroy_model_parallel() -> None:
     _STATE.device_array = None
     _STATE.sizes = {}
     _STATE.aot_mode = False
+    _STATE.phase_meshes = {}
 
 
 def _require_init() -> None:
@@ -225,6 +227,36 @@ def get_mesh() -> Mesh:
 def get_expert_mesh() -> Mesh:
     _require_init()
     return _STATE.expert_mesh  # type: ignore[return-value]
+
+
+def get_moe_phase_mesh(tensor_parallel_size: int,
+                       expert_parallel_size: int) -> Mesh:
+    """Per-phase (prefill vs decode) TP x EP mesh view.
+
+    Analogue of the reference's prefill/token-gen MoE process groups
+    (``moe_process_group.py:12`` — separate CTE and TKG tp x ep groups over
+    the same cores): a RESHAPED VIEW of the already-initialised device
+    array with axes ``("dp", "ep", "tp")``, cached per (tp, ep). No
+    re-initialisation and no manual mesh juggling between phases — serve
+    context encoding under ``get_moe_phase_mesh(cte_tp, cte_ep)`` and token
+    generation under ``get_moe_phase_mesh(tkg_tp, tkg_ep)`` in the same
+    process. Axis names match the global mesh so the parallel layers work
+    unchanged inside ``shard_map`` over the view.
+    """
+    _require_init()
+    key = (int(tensor_parallel_size), int(expert_parallel_size))
+    if key not in _STATE.phase_meshes:
+        tp, ep = key
+        world = int(_STATE.sizes["world"])
+        if tp < 1 or ep < 1 or world % (tp * ep) != 0:
+            raise ValueError(
+                f"world size {world} not divisible by phase tp*ep = "
+                f"{tp}*{ep}")
+        flat = _STATE.device_array.reshape(-1)
+        _STATE.phase_meshes[key] = Mesh(
+            flat.reshape(world // (tp * ep), ep, tp),
+            (DP_AXIS, EP_AXIS, TP_AXIS))
+    return _STATE.phase_meshes[key]
 
 
 def set_aot_mode(flag: bool) -> None:

@@ -37,30 +37,56 @@ class _QuantBase(nn.Module):
     quantization_type: QuantizationType = (
         QuantizationType.PER_CHANNEL_SYMMETRIC)
     activation_quantization: bool = False  # w8a8 vs w8a16
+    scale_block_size: int = 128  # PER_BLOCK_SYMMETRIC contraction block
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     axis: str = ps.TP_AXIS
 
     def _qparams(self, name: str, shape, out_names):
-        """Quantized kernel + per-output-channel scale params."""
+        """Quantized kernel + scale params (per-channel [out], per-tensor
+        [1], or per-block [in/B, out] — reference blockwise int8 scheme,
+        ``quantization_layers.py:356``)."""
         qkernel = self.param(
             f"{name}_q",
             nn.with_partitioning(
                 lambda key, s, d: jnp.zeros(s, d), out_names),
             shape, self.quantized_dtype.jnp_dtype)
-        scale = self.param(
-            f"{name}_scale",
-            nn.with_partitioning(
-                nn.initializers.ones_init(),
-                (out_names[-1],) if self.quantization_type
-                == QuantizationType.PER_CHANNEL_SYMMETRIC else (None,)),
-            (shape[-1],) if self.quantization_type
-            == QuantizationType.PER_CHANNEL_SYMMETRIC else (1,),
-            jnp.float32)
+        if self.quantization_type == QuantizationType.PER_BLOCK_SYMMETRIC:
+            if shape[0] % self.scale_block_size != 0:
+                raise ValueError(
+                    f"contraction dim {shape[0]} not divisible by "
+                    f"scale_block_size {self.scale_block_size}")
+            # the blocks dim shards WITH the kernel's contraction dim
+            # (row-parallel: tp-sharded rows keep their own block scales)
+            scale = self.param(
+                f"{name}_scale",
+                nn.with_partitioning(nn.initializers.ones_init(),
+                                     (out_names[0], out_names[-1])),
+                (shape[0] // self.scale_block_size, shape[-1]), jnp.float32)
+        else:
+            scale = self.param(
+                f"{name}_scale",
+                nn.with_partitioning(
+                    nn.initializers.ones_init(),
+                    (out_names[-1],) if self.quantization_type
+                    == QuantizationType.PER_CHANNEL_SYMMETRIC else (None,)),
+                (shape[-1],) if self.quantization_type
+                == QuantizationType.PER_CHANNEL_SYMMETRIC else (1,),
+                jnp.float32)
         return qkernel, scale
 
     def _matmul(self, x: jax.Array, qkernel: jax.Array,
                 scale: jax.Array) -> jax.Array:
+        if self.quantization_type == QuantizationType.PER_BLOCK_SYMMETRIC:
+            if self.activation_quantization:
+                raise ValueError(
+                    "per-block weight quantisation is w8a16-only (block "
+                    "rescale inside the accumulation is not worth the MXU "
+                    "throughput loss)")
+            from .quantization_utils import dequantize_blockwise
+
+            w = dequantize_blockwise(qkernel, scale, self.dtype)
+            return jnp.dot(x.astype(self.dtype), w)
         if not self.activation_quantization:
             w = dequantize(qkernel, scale[None, :], self.dtype)
             return jnp.dot(x.astype(self.dtype), w)

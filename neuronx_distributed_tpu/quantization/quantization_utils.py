@@ -15,10 +15,14 @@ import jax.numpy as jnp
 
 
 class QuantizationType(str, Enum):
-    """Reference ``quantization_config.py:65``."""
+    """Reference ``quantization_config.py:65`` (+ blockwise scheme,
+    ``quantization_layers.py:356``)."""
 
     PER_TENSOR_SYMMETRIC = "per_tensor_symmetric"
     PER_CHANNEL_SYMMETRIC = "per_channel_symmetric"
+    # one scale per (contraction-dim block, out-channel): bounds quant error
+    # per dot-product segment — the int8 counterpart of MX microscaling
+    PER_BLOCK_SYMMETRIC = "per_block_symmetric"
 
 
 class QuantizedDtype(str, Enum):
@@ -47,11 +51,46 @@ def abs_max(x: jax.Array, axis=None, keepdims=False) -> jax.Array:
                    keepdims=keepdims)
 
 
+def _cast_to(q: jax.Array, dtype: QuantizedDtype) -> jax.Array:
+    """Round/clip/cast already-scaled values into the quantized dtype."""
+    if dtype == QuantizedDtype.INT8:
+        return jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8)
+    return jnp.clip(q, -dtype.max_value, dtype.max_value).astype(
+        dtype.jnp_dtype)
+
+
 def quantize(x: jax.Array, dtype: QuantizedDtype = QuantizedDtype.INT8,
              qtype: QuantizationType = QuantizationType.PER_CHANNEL_SYMMETRIC,
-             channel_axis: int = -1) -> Tuple[jax.Array, jax.Array]:
+             channel_axis: int = -1,
+             block_size: int = 128,
+             block_axis: int = 0) -> Tuple[jax.Array, jax.Array]:
     """Symmetric quantisation; returns ``(q, scale)`` with
-    ``x ≈ q * scale`` (reference ``quantization_utils.py:126,144``)."""
+    ``x ≈ q * scale`` (reference ``quantization_utils.py:126,144``).
+
+    ``PER_BLOCK_SYMMETRIC`` (reference blockwise int8 scheme,
+    ``quantization_layers.py:356``): for a 2-D kernel, one scale per
+    ``block_size`` segment of ``block_axis`` (the contraction dim) per
+    other-dim element — scale shape ``[in/B, out]`` for a ``[in, out]``
+    kernel with ``block_axis=0``. Dequantise with
+    :func:`dequantize_blockwise`.
+    """
+    if qtype == QuantizationType.PER_BLOCK_SYMMETRIC:
+        if x.ndim != 2:
+            raise ValueError(
+                f"per-block quantisation expects a 2-D kernel, got "
+                f"{x.shape}")
+        ba = block_axis % 2
+        n = x.shape[ba]
+        if n % block_size != 0:
+            raise ValueError(
+                f"dim {ba} size {n} not divisible by block_size "
+                f"{block_size}")
+        xb = jnp.moveaxis(x.astype(jnp.float32), ba, 0)
+        xb = xb.reshape(n // block_size, block_size, -1)
+        amax = abs_max(xb, axis=1, keepdims=True)      # [nb, 1, out]
+        scale = jnp.where(amax == 0, 1.0, amax / dtype.max_value)
+        q = jnp.moveaxis(_cast_to(xb / scale, dtype).reshape(n, -1), 0, ba)
+        return q, scale[:, 0].astype(jnp.float32)      # [nb, out]
     if qtype == QuantizationType.PER_TENSOR_SYMMETRIC:
         amax = abs_max(x)
     else:
@@ -60,12 +99,7 @@ def quantize(x: jax.Array, dtype: QuantizedDtype = QuantizedDtype.INT8,
         amax = abs_max(x, axis=reduce_axes, keepdims=True)
     scale = amax / dtype.max_value
     scale = jnp.where(scale == 0, 1.0, scale)
-    q = x.astype(jnp.float32) / scale
-    if dtype == QuantizedDtype.INT8:
-        q = jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8)
-    else:
-        q = jnp.clip(q, -dtype.max_value, dtype.max_value).astype(
-            dtype.jnp_dtype)
+    q = _cast_to(x.astype(jnp.float32) / scale, dtype)
     return q, scale.astype(jnp.float32)
 
 
@@ -73,6 +107,20 @@ def dequantize(q: jax.Array, scale: jax.Array,
                dtype=jnp.bfloat16) -> jax.Array:
     """Reference ``dequantize.py:79``."""
     return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def dequantize_blockwise(q: jax.Array, scale: jax.Array,
+                         dtype=jnp.bfloat16,
+                         block_axis: int = 0) -> jax.Array:
+    """Inverse of per-block :func:`quantize`: ``q [in, out]`` with
+    ``scale [in/B, out]`` — the broadcast-multiply XLA fuses into the
+    consuming matmul's operand read."""
+    qb = jnp.moveaxis(q.astype(jnp.float32), block_axis % q.ndim, 0)
+    nb = scale.shape[0]
+    blocks = qb.reshape(nb, qb.shape[0] // nb, -1)
+    out = blocks * scale[:, None]
+    return jnp.moveaxis(out.reshape(qb.shape), 0,
+                        block_axis % q.ndim).astype(dtype)
 
 
 def direct_cast_quantize(x: jax.Array, dtype: QuantizedDtype) -> jax.Array:

@@ -105,3 +105,51 @@ def test_rank_getter_outside_shard_map_raises():
     ps.initialize_model_parallel(tensor_model_parallel_size=4)
     with pytest.raises(RuntimeError):
         ps.get_tensor_model_parallel_rank()
+
+
+def test_moe_phase_mesh_views():
+    """Per-phase (prefill vs decode) TP x EP mesh views (reference
+    moe_process_group.py:12): two factorisations of the SAME devices
+    coexist without re-initialisation, axis names match the global mesh so
+    the expert layers run unchanged, and parity vs the unsharded forward
+    holds under both."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.modules.moe import ExpertMLPs
+
+    ps.initialize_model_parallel(tensor_model_parallel_size=2,
+                                 expert_model_parallel_size=2)
+    cte = ps.get_moe_phase_mesh(4, 2)   # prefill: wide tp
+    tkg = ps.get_moe_phase_mesh(2, 4)   # decode: wide ep
+    assert cte is ps.get_moe_phase_mesh(4, 2)  # cached view
+    assert dict(cte.shape) == {"dp": 1, "ep": 2, "tp": 4}
+    assert dict(tkg.shape) == {"dp": 1, "ep": 4, "tp": 2}
+    # same flat device order as the global mesh — views, not new worlds
+    flat = [d.id for d in ps._STATE.device_array.reshape(-1)]
+    assert [d.id for d in np.asarray(cte.devices).reshape(-1)] == flat
+    assert [d.id for d in np.asarray(tkg.devices).reshape(-1)] == flat
+
+    H, I, E, K, T = 16, 32, 8, 2, 8
+    x = jax.random.normal(jax.random.key(70), (T, H))
+    gates = jax.nn.softmax(
+        jax.random.normal(jax.random.key(71), (T, K)), axis=-1)
+    idx = jax.random.randint(jax.random.key(72), (T, K), 0, E)
+    mod = ExpertMLPs(num_experts=E, hidden_size=H, intermediate_size=I,
+                     top_k=K, dtype=jnp.float32, param_dtype=jnp.float32)
+    params = meta.unbox(mod.init(jax.random.key(73), x, gates, idx))
+    ref, _ = mod.apply(params, x, gates, idx)
+
+    for mesh in (cte, tkg):
+        spec = {"params": {
+            "gate_up": P("ep", None, None, "tp"),
+            "down": P("ep", "tp", None)}}
+        got, _ = jax.jit(ps.shard_map(
+            lambda p, a, g, i: mod.apply(p, a, g, i), mesh,
+            in_specs=(spec, P(), P(), P()), out_specs=(P(), P())))(
+                params, x, gates, idx)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=str(dict(mesh.shape)))
