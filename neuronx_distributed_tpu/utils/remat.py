@@ -1,0 +1,38 @@
+"""Remat (activation checkpointing) policy resolution.
+
+Analogue of the reference's activation-checkpoint config plumbing
+(``trainer/trainer.py:147`` applying ``activation_checkpoint_config``): a
+single place mapping policy NAMES to ``jax.checkpoint_policies`` so model
+configs stay JSON-serialisable.
+
+Policy guide (v5e, 350M llama slice, bs=8 seq=2048, measured r3):
+
+* ``"nothing"`` — recompute everything (min memory; the r2 default);
+* ``"dots"`` — save matmul outputs without batch dims
+  (``dots_with_no_batch_dims_saveable``): +3.6% step throughput over
+  "nothing" at modest extra memory — the better default when activations
+  fit;
+* any other name resolves via ``getattr(jax.checkpoint_policies, name)``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_ALIASES = {
+    "nothing": "nothing_saveable",
+    "dots": "dots_with_no_batch_dims_saveable",
+    "dots_batch": "dots_saveable",
+}
+
+
+def resolve_remat_policy(name: str = "nothing"):
+    """Policy name -> jax.checkpoint policy callable."""
+    resolved = _ALIASES.get(name, name)
+    try:
+        return getattr(jax.checkpoint_policies, resolved)
+    except AttributeError as e:
+        raise ValueError(
+            f"unknown remat policy {name!r} (aliases: "
+            f"{sorted(_ALIASES)}; else any jax.checkpoint_policies "
+            "name)") from e

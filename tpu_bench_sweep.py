@@ -1,0 +1,65 @@
+"""Dev harness: sweep bench configs on the real chip (remat x batch x seq)
+to pick the single-chip headline configuration honestly."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.models import llama
+from neuronx_distributed_tpu.trainer import (initialize_parallel_model,
+                                             initialize_parallel_optimizer,
+                                             make_train_step)
+from neuronx_distributed_tpu.parallel import mesh as ps
+
+
+def run_config(remat, batch, seq, iters=10):
+    ps.destroy_model_parallel()
+    mcfg = llama.LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_layers=16, num_heads=8, num_kv_heads=8, max_seq_len=seq,
+        remat=remat, use_flash_attention=True)
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=1,
+        optimizer_config=nxd.OptimizerConfig(zero_one_enabled=True))
+    model = llama.LlamaForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0,
+                             mcfg.vocab_size)
+    data = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(0),
+                                           data["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 1e-4)
+    step1 = make_train_step(pm, tx, sh, donate=False)
+    stepN = make_train_step(pm, tx, sh, donate=False, scan_steps=iters)
+    dataN = {k: jnp.broadcast_to(v, (iters,) + v.shape)
+             for k, v in data.items()}
+
+    def run(step, b):
+        t0 = time.perf_counter()
+        _, m = step(state, b)
+        float(m["loss"])
+        return time.perf_counter() - t0
+
+    try:
+        run(step1, data)
+        run(stepN, dataN)
+        t1 = min(run(step1, data) for _ in range(2))
+        tN = min(run(stepN, dataN) for _ in range(2))
+        dt = max(tN - t1, 1e-9)
+        toks = batch * seq * (iters - 1) / dt
+        print(f"remat={remat} batch={batch} seq={seq}: "
+              f"{toks:,.0f} tok/s/chip", flush=True)
+        return toks
+    except Exception as e:
+        print(f"remat={remat} batch={batch} seq={seq}: FAILED "
+              f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+        return 0.0
+
+
+if __name__ == "__main__":
+    print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
+    for remat, batch, seq in [(True, 8, 2048), (False, 8, 2048),
+                              (False, 16, 2048), (False, 32, 2048),
+                              (True, 32, 2048), (True, 16, 4096)]:
+        run_config(remat, batch, seq)
