@@ -64,6 +64,14 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     sequence_parallel: bool = False
     remat: bool = False
+    # what the rematerialised layer body saves across fwd→bwd:
+    #   "nothing"        — recompute everything (max memory savings);
+    #   "save_attention" — save flash outputs + log-sum-exp so the backward
+    #     skips re-running the attention forward kernel (the single biggest
+    #     recompute item, ~13% of step compute at bench shapes; the flash
+    #     backward only ever needed out+lse — see
+    #     ops/flash_attention.py::_flash_pallas_vjp_fwd).
+    remat_policy: str = "nothing"
     scan_layers: bool = True
     use_flash_attention: bool = False
     # decode: shard the KV cache's SLOT dim over the cp axis and LSE-combine
@@ -77,12 +85,20 @@ class LlamaConfig:
     tp_size: Optional[int] = None
     # LoRA adapters (see neuronx_distributed_tpu.lora); None = disabled
     lora: Optional["LoraConfig"] = None
+    # sequence-chunked LM loss (fused_linear_cross_entropy): the loss path
+    # streams `chunk`-token slices through head-matmul + CE so [B, S, V]
+    # logits never materialise. None = classic full-logits path.
+    loss_chunk: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.cp_attn_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"cp_attn_impl must be 'ring' or 'ulysses', got "
                 f"{self.cp_attn_impl!r}")
+        if self.remat_policy not in ("nothing", "save_attention"):
+            raise ValueError(
+                f"remat_policy must be 'nothing' or 'save_attention', got "
+                f"{self.remat_policy!r}")
 
     @property
     def head_dim_(self) -> int:
@@ -313,6 +329,15 @@ def context_parallel_positions(input_ids: jax.Array,
     return jnp.broadcast_to(start + jnp.arange(s_local), (b, s_local))
 
 
+def resolve_remat_policy(name: str):
+    """Checkpoint policy for ``nn.remat`` from a config string (see
+    :class:`LlamaConfig.remat_policy`)."""
+    if name == "save_attention":
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse")
+    return jax.checkpoint_policies.nothing_saveable
+
+
 class _ScanBody(nn.Module):
     """nn.scan body: carries the hidden states, emits nothing."""
 
@@ -392,7 +417,7 @@ class LlamaModel(nn.Module):
             if cfg.remat:
                 body_cls = nn.remat(
                     body_cls, prevent_cse=False,
-                    policy=jax.checkpoint_policies.nothing_saveable)
+                    policy=resolve_remat_policy(cfg.remat_policy))
             scanned = nn.scan(
                 body_cls,
                 variable_axes={"params": 0},
@@ -407,7 +432,7 @@ class LlamaModel(nn.Module):
             if cfg.remat:
                 layer_cls = nn.remat(
                     layer_cls, prevent_cse=False,
-                    policy=jax.checkpoint_policies.nothing_saveable)
+                    policy=resolve_remat_policy(cfg.remat_policy))
             for i in range(cfg.num_layers):
                 x = layer_cls(cfg, name=f"layer_{i}")(x, cos, sin, positions)
         x = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
@@ -421,15 +446,37 @@ class LlamaModel(nn.Module):
         return x
 
 
+class _LMHeadKernel(nn.Module):
+    """LM-head kernel param only — name/shape/partitioning identical to the
+    ``ColumnParallelLinear(name='lm_head')`` the full-logits path creates,
+    so checkpoints interchange between the fused and unfused loss paths."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self) -> jax.Array:
+        cfg = self.cfg
+        out_local = pl._maybe_local(cfg.vocab_size, ps.TP_AXIS)
+        return self.param(
+            "kernel",
+            pl._partitioned(pl.default_kernel_init, (None, ps.TP_AXIS)),
+            (cfg.hidden_size, out_local), cfg.param_dtype)
+
+
 class LlamaForCausalLM(nn.Module):
     """Body + tp-sharded LM head; ``loss()`` uses vocab-parallel CE so the
-    full-vocab logits never materialise unsharded."""
+    full-vocab logits never materialise unsharded — and, with
+    ``cfg.loss_chunk`` set, streams sequence chunks through the head matmul
+    so even the vocab-*local* logits never materialise at full length
+    (:func:`..parallel.loss_functions.fused_linear_cross_entropy`)."""
 
     cfg: LlamaConfig
 
     @nn.compact
     def __call__(self, input_ids: jax.Array,
-                 positions: Optional[jax.Array] = None) -> jax.Array:
+                 positions: Optional[jax.Array] = None,
+                 labels: Optional[jax.Array] = None,
+                 ignore_index: int = -100) -> jax.Array:
         cfg = self.cfg
         model = LlamaModel(cfg, name="model")
         x = model(input_ids, positions)
@@ -447,20 +494,40 @@ class LlamaForCausalLM(nn.Module):
 
             table = meta.unbox(
                 model.variables["params"]["embed"]["embedding"])
-            return pl.embedding_attend(
+            logits = pl.embedding_attend(
                 table, x, sequence_parallel=cfg.sequence_parallel,
+                dtype=cfg.dtype)
+            if labels is not None:
+                return lf.causal_lm_loss(logits, labels,
+                                         ignore_index=ignore_index)
+            return logits
+        if (labels is not None and cfg.loss_chunk
+                and not _lora_kw(cfg, "lm_head")):
+            # fused chunked head+CE: enter the TP region exactly where
+            # ColumnParallelLinear would, then stream chunks
+            if cfg.sequence_parallel:
+                x = mappings.gather_from_sequence_parallel_region(
+                    x, seq_dim=1, to_model_parallel=True)
+            else:
+                x = mappings.copy_to_tensor_parallel_region(x)
+            kernel = _LMHeadKernel(cfg, name="lm_head")()
+            return lf.fused_linear_cross_entropy(
+                x.astype(cfg.dtype), kernel, labels,
+                ignore_index=ignore_index, chunk=cfg.loss_chunk,
                 dtype=cfg.dtype)
         logits = pl.ColumnParallelLinear(
             features=cfg.vocab_size, use_bias=False, gather_output=False,
             sequence_parallel=cfg.sequence_parallel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
             **_lora_kw(cfg, "lm_head"))(x)
+        if labels is not None:
+            return lf.causal_lm_loss(logits, labels,
+                                     ignore_index=ignore_index)
         return logits
 
     def loss(self, input_ids: jax.Array, labels: jax.Array,
              ignore_index: int = -100) -> jax.Array:
-        logits = self(input_ids)
-        return lf.causal_lm_loss(logits, labels, ignore_index=ignore_index)
+        return self(input_ids, labels=labels, ignore_index=ignore_index)
 
 
 def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,

@@ -447,6 +447,16 @@ def _flash_pallas_vjp_fwd(q, k, v, causal, block_q, block_k, scale,
                           interpret):
     out, lse = _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
                                  interpret)
+    # Residual names for rematerialisation policies: under
+    # ``jax.checkpoint(policy=save_only_these_names('flash_out',
+    # 'flash_lse'))`` (models expose this as ``remat_policy=
+    # 'save_attention'``) the backward pass reuses the saved output +
+    # softmax stats instead of re-running the forward kernel — the flash
+    # backward only ever needed (q, k, v, out, lse), and q/k/v fall out of
+    # the (cheap) projection recompute. This trades O(B·S·N·D) saved bytes
+    # for skipping the full attention forward in the backward pass.
+    out = jax.ad_checkpoint.checkpoint_name(out, "flash_out")
+    lse = jax.ad_checkpoint.checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 

@@ -122,3 +122,59 @@ def causal_lm_loss(logits: jax.Array, labels: jax.Array,
                                      ignore_index=ignore_index)
     denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
     return jnp.sum(per_tok) / denom
+
+
+def fused_linear_cross_entropy(
+    x: jax.Array,
+    kernel: jax.Array,
+    labels: jax.Array,
+    axis: str = ps.TP_AXIS,
+    ignore_index: int = -100,
+    chunk: int = 512,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """LM-head matmul + vocab-parallel CE, chunked over the sequence so the
+    full ``[B, S, V]`` logits (and their fp32 softmax intermediates) never
+    materialise at once.
+
+    The reference materialises full logits and feeds them to
+    ``parallel_cross_entropy`` (``parallel_layers/loss_functions.py:217``);
+    at tp=1 that is a ``[B, S, 32000]`` bf16 tensor plus an fp32 CE over it —
+    pure HBM traffic. Here a ``lax.scan`` over sequence chunks computes
+    ``x_chunk @ W → CE`` with the chunk body under
+    ``jax.checkpoint(nothing_saveable)``: the backward recomputes each
+    chunk's logits (one extra chunk matmul) and accumulates ``dW`` across
+    chunks through the scan, so peak memory is O(B·chunk·V) instead of
+    O(B·S·V) and the loss fuses into a streaming pipeline.
+
+    Args:
+      x: ``[B, S, H]`` hidden states, already inside the TP region (caller
+        performs the copy_to / sequence-parallel gather, exactly where
+        ``ColumnParallelLinear`` would).
+      kernel: ``[H, V_local]`` LM-head kernel (vocab-sharded over ``axis``).
+      labels: ``[B, S]`` global vocab ids.
+    """
+    b, s, h = x.shape
+    nc = -(-s // chunk)
+    pad = nc * chunk - s
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)),
+                         constant_values=ignore_index)
+    denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
+    xs = jnp.swapaxes(x.reshape(b, nc, chunk, h), 0, 1)       # [nc,B,C,H]
+    ls = jnp.swapaxes(labels.reshape(b, nc, chunk), 0, 1)     # [nc,B,C]
+    kern = kernel.astype(dtype)
+
+    def body(acc, xl):
+        xc, lc = xl
+        logits = jnp.dot(xc.astype(dtype), kern)
+        per_tok = parallel_cross_entropy(logits, lc, axis=axis,
+                                         ignore_index=ignore_index)
+        return acc + jnp.sum(per_tok), None
+
+    body = jax.checkpoint(
+        body, policy=jax.checkpoint_policies.nothing_saveable,
+        prevent_cse=False)
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (xs, ls))
+    return total / denom

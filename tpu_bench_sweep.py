@@ -1,5 +1,6 @@
-"""Dev harness: sweep bench configs on the real chip (remat x batch x seq)
-to pick the single-chip headline configuration honestly."""
+"""Dev harness: sweep bench configs on the real chip (remat policy x loss
+chunking x batch x seq) to pick the single-chip headline configuration
+honestly."""
 import sys
 import time
 
@@ -14,12 +15,14 @@ from neuronx_distributed_tpu.trainer import (initialize_parallel_model,
 from neuronx_distributed_tpu.parallel import mesh as ps
 
 
-def run_config(remat, batch, seq, iters=10):
+def run_config(remat, batch, seq, remat_policy="nothing", loss_chunk=None,
+               iters=10):
     ps.destroy_model_parallel()
     mcfg = llama.LlamaConfig(
         vocab_size=32000, hidden_size=1024, intermediate_size=2816,
         num_layers=16, num_heads=8, num_kv_heads=8, max_seq_len=seq,
-        remat=remat, use_flash_attention=True)
+        remat=remat, remat_policy=remat_policy, loss_chunk=loss_chunk,
+        use_flash_attention=True)
     cfg = nxd.neuronx_distributed_config(
         tensor_parallel_size=1,
         optimizer_config=nxd.OptimizerConfig(zero_one_enabled=True))
@@ -41,6 +44,8 @@ def run_config(remat, batch, seq, iters=10):
         float(m["loss"])
         return time.perf_counter() - t0
 
+    tag = (f"remat={remat}/{remat_policy} chunk={loss_chunk} "
+           f"batch={batch} seq={seq}")
     try:
         run(step1, data)
         run(stepN, dataN)
@@ -48,18 +53,25 @@ def run_config(remat, batch, seq, iters=10):
         tN = min(run(stepN, dataN) for _ in range(2))
         dt = max(tN - t1, 1e-9)
         toks = batch * seq * (iters - 1) / dt
-        print(f"remat={remat} batch={batch} seq={seq}: "
-              f"{toks:,.0f} tok/s/chip", flush=True)
+        print(f"{tag}: {toks:,.0f} tok/s/chip", flush=True)
         return toks
     except Exception as e:
-        print(f"remat={remat} batch={batch} seq={seq}: FAILED "
-              f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+        print(f"{tag}: FAILED {type(e).__name__}: {str(e)[:200]}",
+              flush=True)
         return 0.0
 
 
 if __name__ == "__main__":
     print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
-    for remat, batch, seq in [(True, 8, 2048), (False, 8, 2048),
-                              (False, 16, 2048), (False, 32, 2048),
-                              (True, 32, 2048), (True, 16, 4096)]:
-        run_config(remat, batch, seq)
+    for remat, batch, seq, pol, chunk in [
+        (True, 8, 2048, "nothing", None),          # r3 headline config
+        (True, 8, 2048, "save_attention", None),
+        (True, 8, 2048, "nothing", 512),
+        (True, 8, 2048, "save_attention", 512),
+        (True, 8, 2048, "save_attention", 256),
+        (True, 8, 2048, "save_attention", 1024),
+        (False, 8, 2048, "nothing", 512),
+        (True, 16, 2048, "save_attention", 512),
+        (True, 32, 2048, "save_attention", 512),
+    ]:
+        run_config(remat, batch, seq, remat_policy=pol, loss_chunk=chunk)
