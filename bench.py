@@ -94,14 +94,20 @@ def main():
         batch, seq = 4, 2048
         mcfg = llama.LlamaConfig(
             **{**mcfg.__dict__, "max_seq_len": seq, "remat": True,
-               "use_flash_attention": True})
+               "use_flash_attention": True,
+               "remat_policy": "save_attention", "loss_chunk": 512})
     else:
         # single-chip slice: ~350M params, bf16 compute; head_dim 128 so
         # the Pallas flash kernel path tiles (d % 128 == 0)
+        # remat_policy="save_attention" saves flash out+lse across fwd→bwd
+        # (skips re-running the attention forward in the backward);
+        # loss_chunk streams 512-token slices through head+CE so [B,S,V]
+        # logits never materialise (r4 levers, wired per VERDICT r4 next #1c)
         mcfg = llama.LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_layers=16, num_heads=8, num_kv_heads=8, max_seq_len=2048,
-            remat=True, use_flash_attention=True)
+            remat=True, use_flash_attention=True,
+            remat_policy="save_attention", loss_chunk=512)
         tp = 1
         batch, seq = 8, 2048
     if platform != "cpu":

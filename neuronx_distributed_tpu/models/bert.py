@@ -35,6 +35,12 @@ class BertConfig:
     # HF BertForMaskedLM head: transform dense + gelu + LN, decoder tied to
     # the word embeddings with a free bias (cls.predictions.*)
     mlm_transform: bool = False
+    # dropout (HF bert defaults are 0.1/0.1): active iff a "dropout" rng is
+    # supplied to apply() — no deterministic-flag threading. Attention-prob
+    # dropout uses the counter-based hash shared with the flash kernels;
+    # hidden dropout applies after each sublayer projection, pre-residual.
+    attention_dropout: float = 0.0
+    hidden_dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     scan_layers: bool = True
@@ -58,6 +64,7 @@ class BertLayer(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
+        train = self.has_rng("dropout")
         hd = cfg.hidden_size // cfg.num_heads
         q, k, v = pl.GQAQKVColumnParallelLinear(
             num_heads=cfg.num_heads, num_kv_heads=cfg.num_heads,
@@ -69,11 +76,20 @@ class BertLayer(nn.Module):
         q = q.reshape(b, s, n_local, hd)
         k = k.reshape(b, s, n_local, hd)
         v = v.reshape(b, s, n_local, hd)
-        attn = attn_mod.sdpa_reference(q, k, v, causal=False)
+        dropout_p, dropout_seed = 0.0, None
+        if cfg.attention_dropout > 0.0 and train:
+            dropout_p = cfg.attention_dropout
+            dropout_seed = jax.random.bits(self.make_rng("dropout"), (),
+                                           jnp.uint32)
+        attn = attn_mod.sdpa_reference(q, k, v, causal=False,
+                                       dropout_p=dropout_p,
+                                       dropout_seed=dropout_seed)
         attn = attn.reshape(b, s, n_local * hd)
         attn = pl.RowParallelLinear(
             features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="o_proj")(attn)
+        hidden_drop = nn.Dropout(rate=cfg.hidden_dropout)
+        attn = hidden_drop(attn, deterministic=not train)
         x = LayerNorm(eps=cfg.layernorm_eps, dtype=cfg.dtype,
                       name="ln_attn")(x + attn)
         h = pl.ColumnParallelLinear(
@@ -83,6 +99,7 @@ class BertLayer(nn.Module):
         h = pl.RowParallelLinear(
             features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="down")(h)
+        h = hidden_drop(h, deterministic=not train)
         return LayerNorm(eps=cfg.layernorm_eps, dtype=cfg.dtype,
                          name="ln_mlp")(x + h)
 
@@ -121,6 +138,8 @@ class BertForPreTraining(nn.Module):
                              axis=0)
         x = LayerNorm(eps=cfg.layernorm_eps, dtype=cfg.dtype,
                       name="embed_norm")(x)
+        x = nn.Dropout(rate=cfg.hidden_dropout)(
+            x, deterministic=not self.has_rng("dropout"))
         if cfg.scan_layers:
             body_cls = _BertScanBody
             if cfg.remat:
@@ -129,7 +148,8 @@ class BertForPreTraining(nn.Module):
                     policy=jax.checkpoint_policies.nothing_saveable)
             scanned = nn.scan(
                 body_cls, variable_axes={"params": 0},
-                split_rngs={"params": True}, length=cfg.num_layers,
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"})(
                     cfg, name="layers")
             x, _ = scanned(x)

@@ -35,6 +35,7 @@ from ..parallel import mappings
 from ..parallel import mesh as ps
 
 from ..lora import LoraConfig
+from ..utils.remat import resolve_remat_policy, validate_remat_policy
 
 
 def _lora_kw(cfg: "LlamaConfig", name: str) -> dict:
@@ -42,7 +43,8 @@ def _lora_kw(cfg: "LlamaConfig", name: str) -> dict:
     walks the model matching target_modules; here targets select at
     construction)."""
     if cfg.lora is not None and name in cfg.lora.target_modules:
-        return {"lora_rank": cfg.lora.r, "lora_alpha": cfg.lora.alpha}
+        return {"lora_rank": cfg.lora.r, "lora_alpha": cfg.lora.alpha,
+                "lora_dropout": cfg.lora.dropout}
     return {}
 
 
@@ -74,6 +76,10 @@ class LlamaConfig:
     remat_policy: str = "nothing"
     scan_layers: bool = True
     use_flash_attention: bool = False
+    # force the Pallas flash kernel (interpret mode on CPU) instead of the
+    # backend/shape auto-dispatch — lets CI exercise the kernel path (incl.
+    # its named remat residuals) on the virtual CPU mesh. None = auto.
+    attn_force_pallas: Optional[bool] = None
     # decode: shard the KV cache's SLOT dim over the cp axis and LSE-combine
     # partial attention (ops.flash_decoding; reference KV-shared groups,
     # parallel_state.py:1473 + trace/spmd.py:74). Long-context serving:
@@ -82,6 +88,11 @@ class LlamaConfig:
     # context-parallel attention: "ring" (ppermute KV rotation) or
     # "ulysses" (all-to-all seq<->head resharding; needs heads % cp == 0)
     cp_attn_impl: str = "ring"
+    # attention-probability dropout (training path only; active iff a
+    # "dropout" rng is supplied to apply()). In-kernel on the flash path
+    # via counter-based masks (reference seed plumbing:
+    # kernels/flash_attn.py:30,54); not applied under ring/Ulysses CP.
+    attention_dropout: float = 0.0
     tp_size: Optional[int] = None
     # LoRA adapters (see neuronx_distributed_tpu.lora); None = disabled
     lora: Optional["LoraConfig"] = None
@@ -95,10 +106,23 @@ class LlamaConfig:
             raise ValueError(
                 f"cp_attn_impl must be 'ring' or 'ulysses', got "
                 f"{self.cp_attn_impl!r}")
-        if self.remat_policy not in ("nothing", "save_attention"):
-            raise ValueError(
-                f"remat_policy must be 'nothing' or 'save_attention', got "
-                f"{self.remat_policy!r}")
+        validate_remat_policy(self.remat_policy)
+        if self.loss_chunk is not None:
+            if self.loss_chunk <= 0:
+                raise ValueError(
+                    f"loss_chunk must be positive, got {self.loss_chunk}")
+            unsupported = ("tie_embeddings=True" if self.tie_embeddings
+                           else "LoRA targeting 'lm_head'"
+                           if (self.lora is not None
+                               and "lm_head" in self.lora.target_modules)
+                           else None)
+            if unsupported:
+                # silently falling back to full logits would let users
+                # believe they have the memory savings when they don't
+                raise ValueError(
+                    f"loss_chunk is incompatible with {unsupported}: the "
+                    "fused chunked loss streams through a dedicated lm_head "
+                    "kernel param; unset loss_chunk for this configuration")
 
     @property
     def head_dim_(self) -> int:
@@ -205,7 +229,24 @@ class LlamaAttention(nn.Module):
         else:
             from ..parallel import comm
 
+            # attention dropout: active iff the config rate > 0 AND the
+            # caller supplied a "dropout" rng (training); eval calls without
+            # the rng are deterministic with no flag-threading
+            dropout_p = 0.0
+            dropout_seed = None
+            if cfg.attention_dropout > 0.0 and self.has_rng("dropout"):
+                dropout_p = cfg.attention_dropout
+                dropout_seed = jax.random.bits(self.make_rng("dropout"), (),
+                                               jnp.uint32)
             cp = comm._axis_size(ps.CP_AXIS)
+            if cp is not None and cp > 1 and dropout_p > 0.0:
+                # the ring/Ulysses kernels carry no dropout plumbing; a
+                # silent skip would let the user believe regularization is
+                # active (cf. the loss_chunk validation in __post_init__)
+                raise ValueError(
+                    "attention_dropout > 0 is not supported under context "
+                    "parallelism (ring/Ulysses); drop the dropout rng or "
+                    "set attention_dropout=0 when cp > 1")
             if cp is not None and cp > 1 and cfg.cp_attn_impl == "ulysses":
                 # Ulysses moves the raw GQA kv heads through its
                 # all-to-alls and expands after the reshard
@@ -225,11 +266,16 @@ class LlamaAttention(nn.Module):
 
                 k = attn_mod.repeat_kv(k, n_q_local // n_kv_local)
                 v = attn_mod.repeat_kv(v, n_q_local // n_kv_local)
-                out = flash_attention(q, k, v, causal=True)
+                out = flash_attention(q, k, v, causal=True,
+                                      force_pallas=cfg.attn_force_pallas,
+                                      dropout_p=dropout_p,
+                                      dropout_seed=dropout_seed)
             else:
                 k = attn_mod.repeat_kv(k, n_q_local // n_kv_local)
                 v = attn_mod.repeat_kv(v, n_q_local // n_kv_local)
-                out = attn_mod.sdpa_reference(q, k, v, causal=True)
+                out = attn_mod.sdpa_reference(q, k, v, causal=True,
+                                              dropout_p=dropout_p,
+                                              dropout_seed=dropout_seed)
         out = out.reshape(b, s, n_q_local * head_dim)
         out = pl.RowParallelLinear(
             features=cfg.num_heads * head_dim, use_bias=False,
@@ -257,7 +303,11 @@ class LlamaMLP(nn.Module):
             nn.with_partitioning(pl.default_kernel_init,
                                  (None, None, ps.TP_AXIS)),
             (cfg.hidden_size, 2, i_local), cfg.param_dtype)
-        if cfg.lora is not None and "gate_up" in cfg.lora.target_modules:
+        lora_on = (cfg.lora is not None
+                   and "gate_up" in cfg.lora.target_modules)
+        lora_act = (lora_on and cfg.lora.dropout > 0.0
+                    and self.has_rng("dropout"))
+        if lora_on:
             lora_a = self.param(
                 "lora_a", nn.with_partitioning(pl.default_kernel_init,
                                                (None, None)),
@@ -266,8 +316,9 @@ class LlamaMLP(nn.Module):
                 "lora_b", nn.with_partitioning(
                     nn.initializers.zeros_init(), (None, None, ps.TP_AXIS)),
                 (cfg.lora.r, 2, i_local), cfg.param_dtype)
-            kernel = kernel + cfg.lora.scale * jnp.einsum(
-                "hr,rki->hki", lora_a, lora_b)
+            if not lora_act:
+                kernel = kernel + cfg.lora.scale * jnp.einsum(
+                    "hr,rki->hki", lora_a, lora_b)
         if cfg.sequence_parallel:
             x = mappings.gather_from_sequence_parallel_region(
                 x, seq_dim=1, to_model_parallel=True)
@@ -275,6 +326,12 @@ class LlamaMLP(nn.Module):
             x = mappings.copy_to_tensor_parallel_region(x)
         x = x.astype(cfg.dtype)
         h = jnp.einsum("bsh,hki->bski", x, kernel.astype(cfg.dtype))
+        if lora_act:
+            # dropout on the adapter input cannot fold into the kernel
+            x_l = nn.Dropout(rate=cfg.lora.dropout)(x, deterministic=False)
+            h = h + cfg.lora.scale * jnp.einsum(
+                "bsr,rki->bski", jnp.dot(x_l, lora_a.astype(cfg.dtype)),
+                lora_b.astype(cfg.dtype))
         if pl._bound_size(ps.TP_AXIS) is None:
             h = ps.with_sharding_constraint(h, None, None, None, ps.TP_AXIS)
         h = nn.silu(h[..., 0, :]) * h[..., 1, :]
@@ -329,13 +386,6 @@ def context_parallel_positions(input_ids: jax.Array,
     return jnp.broadcast_to(start + jnp.arange(s_local), (b, s_local))
 
 
-def resolve_remat_policy(name: str):
-    """Checkpoint policy for ``nn.remat`` from a config string (see
-    :class:`LlamaConfig.remat_policy`)."""
-    if name == "save_attention":
-        return jax.checkpoint_policies.save_only_these_names(
-            "flash_out", "flash_lse")
-    return jax.checkpoint_policies.nothing_saveable
 
 
 class _ScanBody(nn.Module):
@@ -421,7 +471,7 @@ class LlamaModel(nn.Module):
             scanned = nn.scan(
                 body_cls,
                 variable_axes={"params": 0},
-                split_rngs={"params": True},
+                split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},

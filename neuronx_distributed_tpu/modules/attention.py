@@ -82,15 +82,21 @@ def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
 def sdpa_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                    causal: bool = True,
                    segment_positions: Optional[jax.Array] = None,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   dropout_p: float = 0.0,
+                   dropout_seed: Optional[jax.Array] = None) -> jax.Array:
     """Plain softmax attention, fp32 accumulation. ``q: [B, S, N, D]``,
-    ``k/v: [B, S, N, D]`` (already GQA-expanded)."""
+    ``k/v: [B, S, N, D]`` (already GQA-expanded). Attention dropout uses the
+    same counter-based (seed, head, q, k) hash as the flash kernels
+    (``ops.flash_attention.dropout_keep_mask``), so sdpa and flash produce
+    bit-identical masks for the same seed."""
     b, sq, n, d = q.shape
+    sk = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     scores = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
     if causal:
-        kpos = jnp.arange(k.shape[1])
+        kpos = jnp.arange(sk)
         if segment_positions is None:
             mask = (jnp.arange(sq)[:, None] >= kpos[None, :])[None, None]
         else:
@@ -99,5 +105,15 @@ def sdpa_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                     )[:, None]
         scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p > 0.0:
+        from ..ops.flash_attention import dropout_keep_mask
+
+        bh = (jnp.arange(b)[:, None] * n
+              + jnp.arange(n)[None, :])[..., None, None]
+        keep = dropout_keep_mask(
+            jnp.asarray(dropout_seed, jnp.uint32), bh,
+            jnp.arange(sq)[None, None, :, None],
+            jnp.arange(sk)[None, None, None, :], sk, dropout_p)
+        probs = jnp.where(keep, probs * (1.0 / (1.0 - dropout_p)), 0.0)
     out = jnp.einsum("bnqk,bknd->bqnd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)

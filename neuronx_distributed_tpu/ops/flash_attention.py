@@ -23,8 +23,40 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from .pallas_utils import compiler_params as _compiler_params
+
+
+# ---------------------------------------------------------------------------
+# Attention dropout: counter-based keep masks. The reference threads a seed
+# into its NKI kernels the same way (``kernels/flash_attn.py:30,54`` passes
+# seed + dropout_p into flash_fwd/flash_attn_bwd). Here the mask for element
+# (head, q, k) is a pure integer hash of (seed, head, q, k) — a murmur3-style
+# finalizer in plain uint32 ops — so the SAME mask regenerates anywhere it is
+# needed: the Pallas forward kernel, both Pallas backward kernels, the XLA
+# fallback scan, and ``sdpa_reference``. No PRNG state to carry, no [S, S]
+# mask to materialise, and (unlike ``pltpu.prng_random_bits``) it works in
+# interpret mode on CPU, so CI exercises the exact TPU mask path.
+# ---------------------------------------------------------------------------
+
+def dropout_keep_mask(seed, head_idx, q_pos, k_pos, sk: int, p: float):
+    """Boolean keep-mask from integer coordinate arrays (broadcastable).
+
+    ``seed``: uint32 scalar. ``head_idx``: flat batch*head index. The
+    per-element counter is ``q*sk + k`` (unique while sq*sk < 2**32, i.e.
+    sequences to 64K) xored with a per-(seed, head) hash, then mixed with
+    the murmur3 finalizer. Keep probability is ``1 - p``.
+    """
+    hseed = (seed.astype(jnp.uint32)
+             + head_idx.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+    hseed = (hseed ^ (hseed >> 16)) * jnp.uint32(0x21F0AAAD)
+    x = (q_pos.astype(jnp.uint32) * jnp.uint32(sk)
+         + k_pos.astype(jnp.uint32)) ^ hseed
+    x = (x ^ (x >> 16)) * jnp.uint32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x >= jnp.uint32(round(p * 0xFFFFFFFF))
 
 
 def _block_attention(q, k_blk, v_blk, q_pos, k_pos_start, block_k, causal,
@@ -40,12 +72,9 @@ def _block_attention(q, k_blk, v_blk, q_pos, k_pos_start, block_k, causal,
     return s
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_k"))
-def flash_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array,
-                        causal: bool = True, block_k: int = 512,
-                        scale: Optional[float] = None) -> jax.Array:
-    """Blockwise attention. ``q/k/v: [B, S, N, D]`` (kv already GQA-expanded);
-    returns ``[B, S, N, D]``."""
+def _flash_xla_impl(q, k, v, causal, block_k, scale, dropout_p,
+                    dropout_seed):
+    """Blockwise-scan forward; returns ``(out [B,S,N,D], lse [B,N,S])``."""
     b, sq, n, d = q.shape
     sk = k.shape[1]
     block_k = min(block_k, sk)
@@ -53,12 +82,15 @@ def flash_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array,
         # fall back to one block covering everything (static shapes only)
         block_k = sk
     nblocks = sk // block_k
-    scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
     qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B,N,Sq,D]
     kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
     vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
     q_pos = jnp.arange(sq)
+    if dropout_p > 0.0:
+        bh = (jnp.arange(b)[:, None] * n
+              + jnp.arange(n)[None, :])[..., None, None]  # [B,N,1,1]
+        seed = jnp.asarray(dropout_seed, jnp.uint32)
 
     kb = kt.reshape(b, n, nblocks, block_k, d)
     vb = vt.reshape(b, n, nblocks, block_k, d)
@@ -77,8 +109,17 @@ def flash_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array,
         correction = jnp.where(jnp.isfinite(m_prev),
                                jnp.exp(m_prev - m_safe), 0.0)
         l_new = l_prev * correction + jnp.sum(p, axis=-1)
+        if dropout_p > 0.0:
+            keep = dropout_keep_mask(
+                seed, bh, q_pos[None, None, :, None],
+                (idx * block_k + jnp.arange(block_k))[None, None, None, :],
+                sk, dropout_p)
+            p_acc = jnp.where(keep, p, 0.0)
+        else:
+            p_acc = p
         acc = acc * correction[..., None] + jnp.einsum(
-            "bnqk,bnkd->bnqd", p, v_blk, preferred_element_type=jnp.float32)
+            "bnqk,bnkd->bnqd", p_acc, v_blk,
+            preferred_element_type=jnp.float32)
         return (m_new, l_new, acc), None
 
     m0 = jnp.full((b, n, sq), -jnp.inf, jnp.float32)
@@ -88,7 +129,71 @@ def flash_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array,
             jnp.arange(nblocks))
     (m, l, acc), _ = lax.scan(body, (m0, l0, acc0), blks)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+    if dropout_p > 0.0:
+        out = out * (1.0 / (1.0 - dropout_p))
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), -jnp.inf)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_xla(q, k, v, seed, causal, block_k, scale, dropout_p):
+    out, _ = _flash_xla_impl(q, k, v, causal, block_k, scale, dropout_p,
+                             seed[0])
+    return out
+
+
+def _flash_xla_vjp_fwd(q, k, v, seed, causal, block_k, scale, dropout_p):
+    out, lse = _flash_xla_impl(q, k, v, causal, block_k, scale, dropout_p,
+                               seed[0])
+    # same named residuals as the Pallas path, so remat_policy=
+    # "save_attention" is NOT a silent no-op when shapes demote the dispatch
+    # to the XLA fallback (review finding r5): the saved out+lse feed
+    # _flash_bwd_from_lse directly.
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, (q, k, v, seed, out, lse)
+
+
+def _flash_xla_vjp_bwd(causal, block_k, scale, dropout_p, res, g):
+    import numpy as np
+
+    q, k, v, seed, out, lse = res
+    dq, dk, dv = _flash_bwd_from_lse(q, k, v, out, lse, g, causal, block_k,
+                                     scale, dropout_p, seed[0])
+    return dq, dk, dv, np.zeros(seed.shape, jax.dtypes.float0)
+
+
+_flash_xla.defvjp(_flash_xla_vjp_fwd, _flash_xla_vjp_bwd)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_k", "scale",
+                                    "dropout_p"))
+def flash_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True, block_k: int = 512,
+                        scale: Optional[float] = None,
+                        dropout_p: float = 0.0,
+                        dropout_seed: Optional[jax.Array] = None) -> jax.Array:
+    """Blockwise attention. ``q/k/v: [B, S, N, D]`` (kv already GQA-expanded);
+    returns ``[B, S, N, D]``. ``dropout_p``: attention-probability dropout
+    (the softmax normaliser sums UNdropped probabilities; dropped entries are
+    zeroed and survivors rescaled by 1/(1-p), standard semantics; the
+    counter-based mask regenerates identically in the backward)."""
+    d = q.shape[-1]
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
+    seed = (jnp.asarray(dropout_seed, jnp.uint32).reshape((1,))
+            if dropout_p > 0.0 else jnp.zeros((1,), jnp.uint32))
+    # clamp HERE (not just in the impl) so the custom_vjp backward sees the
+    # same static block_k the forward actually used — _flash_bwd_from_lse
+    # reshapes k/v by it (review finding r5: sk % block_k != 0 would crash
+    # the backward with a size-mismatched reshape)
+    sk = k.shape[1]
+    block_k = min(block_k, sk)
+    if sk % block_k != 0:
+        block_k = sk
+    return _flash_xla(q, k, v, seed, causal, block_k, scale_, dropout_p)
 
 
 # ---------------------------------------------------------------------------
@@ -101,12 +206,23 @@ def flash_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array,
 # implementation to maintain).
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
-                      acc_ref, *, block_q: int, block_k: int, num_kb: int,
-                      causal: bool, scale: float):
+def _tile_keep_mask(seed_ref, head, qi, kb, block_q, block_k, sk, dropout_p):
+    """Regenerate the (block_q, block_k) keep mask for one tile — identical
+    in the forward and both backward kernels (coords are global)."""
+    shape = (block_q, block_k)
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, shape, 1)
+    return dropout_keep_mask(seed_ref[0], head, q_pos, k_pos, sk, dropout_p)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, m_ref,
+                      l_ref, acc_ref, *, block_q: int, block_k: int,
+                      num_kb: int, causal: bool, scale: float,
+                      dropout_p: float, sk: int):
     from jax.experimental import pallas as pl
 
-    qi = pl.program_id(1)
+    head = pl.program_id(0)  # hoisted: program_id has no lowering inside
+    qi = pl.program_id(1)    # pl.when bodies in interpret mode
     kb = pl.program_id(2)
 
     @pl.when(kb == 0)
@@ -138,13 +254,23 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
         m_ref[:] = m_new
         l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1)
+        if dropout_p > 0.0:
+            # normaliser l accumulates UNdropped p; only the PV accumulation
+            # sees the mask (survivor rescale happens once, in _finalize)
+            keep = _tile_keep_mask(seed_ref, head, qi, kb,
+                                   block_q, block_k, sk, dropout_p)
+            p_acc = jnp.where(keep, p, 0.0)
+        else:
+            p_acc = p
         acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p_acc, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kb == num_kb - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)[:, None]
+        inv_keep = 1.0 / (1.0 - dropout_p) if dropout_p > 0.0 else 1.0
+        o_ref[0] = (acc_ref[:] * inv_keep
+                    / jnp.maximum(l_ref[:], 1e-30)[:, None]
                     ).astype(o_ref.dtype)
         # log-sum-exp per query row (softmax stats for the flash backward).
         # lse block is (1, 1, block_q): 3D so the sublane dim (=1) equals the
@@ -168,8 +294,8 @@ def _causal_kv_index(causal, block_q, block_k):
         i, jnp.minimum(kb, (j * block_q + block_q - 1) // block_k), 0)
 
 
-def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
-                      interpret=False):
+def _flash_pallas_fwd(q, k, v, seed, causal, block_q, block_k, scale,
+                      interpret=False, dropout_p=0.0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -185,7 +311,7 @@ def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_q=block_q,
                           block_k=block_k, num_kb=num_kb, causal=causal,
-                          scale=scale),
+                          scale=scale, dropout_p=dropout_p, sk=sk),
         out_shape=[jax.ShapeDtypeStruct((b * n, sq, d), q.dtype),
                    jax.ShapeDtypeStruct((b * n, 1, sq), jnp.float32)],
         grid=grid,
@@ -193,6 +319,7 @@ def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
                    pl.BlockSpec((1, 1, block_q),
@@ -202,15 +329,18 @@ def _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
                         pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
         compiler_params=None if interpret else _compiler_params(),
-    )(qt, kt, vt)
+    )(qt, kt, vt, seed)
     return (jnp.swapaxes(out.reshape(b, n, sq, d), 1, 2),
             lse.reshape(b, n, sq))
 
 
-def _flash_bwd_from_lse(q, k, v, out, lse, g, causal, block_k, scale):
+def _flash_bwd_from_lse(q, k, v, out, lse, g, causal, block_k, scale,
+                        dropout_p=0.0, dropout_seed=None):
     """Standard flash backward from saved softmax stats: one blockwise pass
     recomputing p = exp(s - lse) per KV block (no second forward's
-    max/sum accumulation). All in fp32; O(S) memory."""
+    max/sum accumulation). All in fp32; O(S) memory. With ``dropout_p`` the
+    forward's counter-based keep mask regenerates per block (same math as
+    ``_flash_bwd_dkv_kernel``)."""
     b, sq, n, d = q.shape
     sk = k.shape[1]
     nb = sk // block_k
@@ -221,6 +351,11 @@ def _flash_bwd_from_lse(q, k, v, out, lse, g, causal, block_k, scale):
     gt = jnp.swapaxes(g, 1, 2).astype(jnp.float32)
     delta = jnp.sum(gt * ot, axis=-1)                   # [B,N,Sq]
     q_pos = jnp.arange(sq)
+    if dropout_p > 0.0:
+        bh_idx = (jnp.arange(b)[:, None] * n
+                  + jnp.arange(n)[None, :])[..., None, None]
+        seed_u32 = jnp.asarray(dropout_seed, jnp.uint32)
+        inv_keep = 1.0 / (1.0 - dropout_p)
 
     kb_ = kt.reshape(b, n, nb, block_k, d)
     vb_ = vt.reshape(b, n, nb, block_k, d)
@@ -235,10 +370,20 @@ def _flash_bwd_from_lse(q, k, v, out, lse, g, causal, block_k, scale):
             s = jnp.where(mask, s, -jnp.inf)
         p = jnp.where(jnp.isfinite(s),
                       jnp.exp(s - lse[..., None]), 0.0)  # [B,N,Sq,BK]
-        dv = jnp.einsum("bnqk,bnqd->bnkd", p, gt,
+        if dropout_p > 0.0:
+            keep = dropout_keep_mask(
+                seed_u32, bh_idx, q_pos[None, None, :, None],
+                (idx * block_k + jnp.arange(block_k))[None, None, None, :],
+                sk, dropout_p)
+            p_v = jnp.where(keep, p * inv_keep, 0.0)
+        else:
+            p_v = p
+        dv = jnp.einsum("bnqk,bnqd->bnkd", p_v, gt,
                         preferred_element_type=jnp.float32)
         dp = jnp.einsum("bnqd,bnkd->bnqk", gt, v_blk,
                         preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
         ds = p * (dp - delta[..., None]) * scale
         dq = dq + jnp.einsum("bnqk,bnkd->bnqd", ds, k_blk,
                              preferred_element_type=jnp.float32)
@@ -269,10 +414,12 @@ def _flash_bwd_from_lse(q, k, v, out, lse, g, causal, block_k, scale):
 # ---------------------------------------------------------------------------
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                         dq_ref, dq_acc, *, block_q: int, block_k: int,
-                         num_kb: int, causal: bool, scale: float):
+                         seed_ref, dq_ref, dq_acc, *, block_q: int,
+                         block_k: int, num_kb: int, causal: bool,
+                         scale: float, dropout_p: float, sk: int):
     from jax.experimental import pallas as pl
 
+    head = pl.program_id(0)
     qi = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -297,6 +444,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            # dP flows only through kept entries (the same regenerated mask
+            # as the forward); the delta identity delta = rowsum(g*out) =
+            # sum_j P_j dP_j still holds under dropout, so ds is unchanged
+            keep = _tile_keep_mask(seed_ref, head, qi, kb,
+                                   block_q, block_k, sk, dropout_p)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         ds = p * (dp - delta[:, None]) * scale
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
@@ -308,11 +462,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
-                          block_k: int, num_qb: int, causal: bool,
-                          scale: float):
+                          seed_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          block_q: int, block_k: int, num_qb: int,
+                          causal: bool, scale: float, dropout_p: float,
+                          sk: int):
     from jax.experimental import pallas as pl
 
+    head = pl.program_id(0)
     kb = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -336,11 +492,21 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref,
             k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse[:, None]), 0.0)
+        if dropout_p > 0.0:
+            keep = _tile_keep_mask(seed_ref, head, qi, kb,
+                                   block_q, block_k, sk, dropout_p)
+            inv = 1.0 / (1.0 - dropout_p)
+            # dV sees the dropped+rescaled probabilities (out = D(P) @ V)
+            p_v = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_v = p
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p, g, (((0,), (0,)), ((), ())),
+            p_v, g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta[:, None]) * scale
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -352,8 +518,8 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k, scale,
-                      interpret=False):
+def _flash_pallas_bwd(q, k, v, out, lse, g, seed, causal, block_q, block_k,
+                      scale, interpret=False, dropout_p=0.0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -389,7 +555,7 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k, scale,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, num_kb=num_kb, causal=causal,
-                          scale=scale),
+                          scale=scale, dropout_p=dropout_p, sk=sk),
         out_shape=jax.ShapeDtypeStruct((b * n, sq, d), q.dtype),
         grid=(b * n, num_qb, num_kb),
         in_specs=[
@@ -399,17 +565,18 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k, scale,
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
             pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
             pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
         compiler_params=None if interpret else _compiler_params(),
-    )(qt, kt, vt, gt, lse_t, delta)
+    )(qt, kt, vt, gt, lse_t, delta, seed)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
                           block_k=block_k, num_qb=num_qb, causal=causal,
-                          scale=scale),
+                          scale=scale, dropout_p=dropout_p, sk=sk),
         out_shape=[jax.ShapeDtypeStruct((b * n, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((b * n, sk, d), v.dtype)],
         grid=(b * n, num_kb, num_qb),
@@ -420,6 +587,7 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k, scale,
             pl.BlockSpec((1, block_q, d), q_index),
             pl.BlockSpec((1, 1, block_q), qrow_index),
             pl.BlockSpec((1, 1, block_q), qrow_index),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
@@ -429,24 +597,25 @@ def _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k, scale,
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
         compiler_params=None if interpret else _compiler_params(),
-    )(kt, vt, qt, gt, lse_t, delta)
+    )(kt, vt, qt, gt, lse_t, delta, seed)
 
     return (jnp.swapaxes(dq.reshape(b, n, sq, d), 1, 2),
             jnp.swapaxes(dk.reshape(b, n, sk, d), 1, 2),
             jnp.swapaxes(dv.reshape(b, n, sk, d), 1, 2))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_pallas(q, k, v, causal, block_q, block_k, scale, interpret):
-    out, _ = _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
-                               interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_pallas(q, k, v, seed, causal, block_q, block_k, scale, interpret,
+                  dropout_p):
+    out, _ = _flash_pallas_fwd(q, k, v, seed, causal, block_q, block_k,
+                               scale, interpret, dropout_p)
     return out
 
 
-def _flash_pallas_vjp_fwd(q, k, v, causal, block_q, block_k, scale,
-                          interpret):
-    out, lse = _flash_pallas_fwd(q, k, v, causal, block_q, block_k, scale,
-                                 interpret)
+def _flash_pallas_vjp_fwd(q, k, v, seed, causal, block_q, block_k, scale,
+                          interpret, dropout_p):
+    out, lse = _flash_pallas_fwd(q, k, v, seed, causal, block_q, block_k,
+                                 scale, interpret, dropout_p)
     # Residual names for rematerialisation policies: under
     # ``jax.checkpoint(policy=save_only_these_names('flash_out',
     # 'flash_lse'))`` (models expose this as ``remat_policy=
@@ -455,30 +624,47 @@ def _flash_pallas_vjp_fwd(q, k, v, causal, block_q, block_k, scale,
     # backward only ever needed (q, k, v, out, lse), and q/k/v fall out of
     # the (cheap) projection recompute. This trades O(B·S·N·D) saved bytes
     # for skipping the full attention forward in the backward pass.
-    out = jax.ad_checkpoint.checkpoint_name(out, "flash_out")
-    lse = jax.ad_checkpoint.checkpoint_name(lse, "flash_lse")
-    return out, (q, k, v, out, lse)
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, (q, k, v, seed, out, lse)
 
 
-def _flash_pallas_vjp_bwd(causal, block_q, block_k, scale, interpret, res, g):
-    q, k, v, out, lse = res
-    return _flash_pallas_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
-                             scale, interpret)
+def _flash_pallas_vjp_bwd(causal, block_q, block_k, scale, interpret,
+                          dropout_p, res, g):
+    import numpy as np
+
+    q, k, v, seed, out, lse = res
+    dq, dk, dv = _flash_pallas_bwd(q, k, v, out, lse, g, seed, causal,
+                                   block_q, block_k, scale, interpret,
+                                   dropout_p)
+    # seed is integer-typed: its cotangent is the unit float0 type
+    dseed = np.zeros(seed.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseed
 
 
 _flash_pallas.defvjp(_flash_pallas_vjp_fwd, _flash_pallas_vjp_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                             "scale", "force_pallas"))
+                                             "scale", "force_pallas",
+                                             "dropout_p"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, block_q: int = 512,
                     block_k: int = 512,
                     scale: Optional[float] = None,
-                    force_pallas: Optional[bool] = None) -> jax.Array:
+                    force_pallas: Optional[bool] = None,
+                    dropout_p: float = 0.0,
+                    dropout_seed: Optional[jax.Array] = None) -> jax.Array:
     """Flash attention entry point: Pallas kernel on TPU when the shapes
     tile cleanly, scan/XLA formulation otherwise (the reference dispatches
-    NKI-vs-torch the same way, ``kernels/flash_attn.py``)."""
+    NKI-vs-torch the same way, ``kernels/flash_attn.py``).
+
+    ``dropout_p`` + ``dropout_seed`` (uint32 scalar, required when p > 0):
+    in-kernel attention dropout via counter-based masks — the same
+    (seed, head, q, k) hash regenerates the mask in the forward kernel,
+    both backward kernels, and the XLA fallback, so the two dispatch paths
+    are bit-identical per seed (reference seed plumbing:
+    ``kernels/flash_attn.py:30,54``)."""
     b, sq, n, d = q.shape
     sk = k.shape[1]
     scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -510,6 +696,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       and tileable_strict)
     else:
         use_pallas = False
+    if dropout_p > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_p > 0 requires dropout_seed (a uint32 "
+                             "scalar; derive it from a PRNG key per step)")
+        seed = jnp.asarray(dropout_seed, jnp.uint32).reshape((1,))
+    else:
+        seed = jnp.zeros((1,), jnp.uint32)
     if use_pallas:
         interpret = jax.default_backend() == "cpu"
         if not interpret and not tileable_strict:
@@ -517,6 +710,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 f"force_pallas on TPU requires 128-aligned blocks "
                 f"(got block_q={bq}, block_k={bk}); loose 8-aligned blocks "
                 "are only valid in CPU interpret mode")
-        return _flash_pallas(q, k, v, causal, bq, bk, scale_, interpret)
+        return _flash_pallas(q, k, v, seed, causal, bq, bk, scale_,
+                             interpret, dropout_p)
     return flash_attention_xla(q, k, v, causal=causal,
-                               block_k=bk, scale=scale_)
+                               block_k=bk, scale=scale_,
+                               dropout_p=dropout_p,
+                               dropout_seed=seed[0] if dropout_p > 0.0
+                               else None)

@@ -121,12 +121,14 @@ def _chunk_fwd(q, k_c, v_c, rel, block_q, block_k, scale, interpret):
     (r - src): 0 -> diagonal (causal), >0 -> fully attended, <0 -> skip."""
     from .flash_attention import _flash_pallas_fwd
 
+    zseed = jnp.zeros((1,), jnp.uint32)  # no dropout under CP
+
     def diag(q, k_c, v_c):
-        return _flash_pallas_fwd(q, k_c, v_c, True, block_q, block_k,
+        return _flash_pallas_fwd(q, k_c, v_c, zseed, True, block_q, block_k,
                                  scale, interpret)
 
     def full(q, k_c, v_c):
-        return _flash_pallas_fwd(q, k_c, v_c, False, block_q, block_k,
+        return _flash_pallas_fwd(q, k_c, v_c, zseed, False, block_q, block_k,
                                  scale, interpret)
 
     def skip(q, k_c, v_c):
@@ -144,13 +146,15 @@ def _chunk_bwd(q, k_c, v_c, out, lse, g, rel, block_q, block_k, scale,
                interpret):
     from .flash_attention import _flash_pallas_bwd
 
+    zseed = jnp.zeros((1,), jnp.uint32)  # no dropout under CP
+
     def diag(args):
-        return _flash_pallas_bwd(*args, True, block_q, block_k, scale,
+        return _flash_pallas_bwd(*args, zseed, True, block_q, block_k, scale,
                                  interpret)
 
     def full(args):
-        return _flash_pallas_bwd(*args, False, block_q, block_k, scale,
-                                 interpret)
+        return _flash_pallas_bwd(*args, zseed, False, block_q, block_k,
+                                 scale, interpret)
 
     def skip(args):
         q, k_c, v_c, _, _, _ = args

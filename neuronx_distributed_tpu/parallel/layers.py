@@ -59,6 +59,16 @@ def _partitioned(init: Initializer, names: Tuple[Optional[str], ...]):
     return nn.with_partitioning(init, names)
 
 
+def _lora_input(mod: nn.Module, x: jax.Array, rate: float) -> jax.Array:
+    """The LoRA branch's input: dropped-out iff ``rate > 0`` and the caller
+    supplied a "dropout" rng (reference ``modules/lora/layer.py:237``
+    computes ``lora_B(lora_A(lora_dropout(x)))``; the frozen base matmul
+    always sees the undropped activations)."""
+    if rate > 0.0 and mod.has_rng("dropout"):
+        return nn.Dropout(rate=rate)(x, deterministic=False)
+    return x
+
+
 class ColumnParallelLinear(nn.Module):
     """Linear with output features sharded over the tp axis.
 
@@ -82,6 +92,7 @@ class ColumnParallelLinear(nn.Module):
     # 0 disables; A is replicated, B is output-sharded like the kernel.
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    lora_dropout: float = 0.0
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -114,8 +125,9 @@ class ColumnParallelLinear(nn.Module):
         y = jnp.dot(x, kernel.astype(self.dtype))
         if lora_a is not None:
             scale = self.lora_alpha / self.lora_rank
+            x_l = _lora_input(self, x, self.lora_dropout)
             y = y + scale * jnp.dot(
-                jnp.dot(x, lora_a.astype(self.dtype)),
+                jnp.dot(x_l, lora_a.astype(self.dtype)),
                 lora_b.astype(self.dtype))
         if bias is not None:
             y = y + bias.astype(self.dtype)
@@ -148,6 +160,7 @@ class OutputChannelParallelConv2d(nn.Module):
     axis: str = ps.TP_AXIS
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    lora_dropout: float = 0.0
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -179,8 +192,9 @@ class OutputChannelParallelConv2d(nn.Module):
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if lora_a is not None:
             scale = self.lora_alpha / self.lora_rank
+            x_l = _lora_input(self, x, self.lora_dropout)
             ya = jax.lax.conv_general_dilated(
-                x.astype(self.dtype), lora_a.astype(self.dtype),
+                x_l.astype(self.dtype), lora_a.astype(self.dtype),
                 window_strides=self.strides, padding=self.padding,
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
             y = y + scale * jax.lax.conv_general_dilated(
@@ -216,6 +230,7 @@ class InputChannelParallelConv2d(nn.Module):
     axis: str = ps.TP_AXIS
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    lora_dropout: float = 0.0
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -245,8 +260,9 @@ class InputChannelParallelConv2d(nn.Module):
                              (None, None, None, None)),
                 (1, 1, self.lora_rank, self.features), self.param_dtype)
             scale = self.lora_alpha / self.lora_rank
+            x_l = _lora_input(self, x, self.lora_dropout)
             ya = jax.lax.conv_general_dilated(
-                x.astype(self.dtype), lora_a.astype(self.dtype),
+                x_l.astype(self.dtype), lora_a.astype(self.dtype),
                 window_strides=self.strides, padding=self.padding,
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
             y = y + scale * jax.lax.conv_general_dilated(
@@ -312,6 +328,7 @@ class RowParallelLinear(nn.Module):
     # lora partial sums ride the layer's existing all-reduce/reduce-scatter.
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    lora_dropout: float = 0.0
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -333,8 +350,9 @@ class RowParallelLinear(nn.Module):
                 _partitioned(nn.initializers.zeros_init(), (None, None)),
                 (self.lora_rank, self.features), self.param_dtype)
             scale = self.lora_alpha / self.lora_rank
+            x_l = _lora_input(self, x, self.lora_dropout)
             y = y + scale * jnp.dot(
-                jnp.dot(x, lora_a.astype(self.dtype)),
+                jnp.dot(x_l, lora_a.astype(self.dtype)),
                 lora_b.astype(self.dtype))
         if self.sequence_parallel:
             y = mappings.reduce_scatter_to_sequence_parallel_region(
@@ -366,9 +384,12 @@ class ParallelEmbedding(nn.Module):
     embedding_init: Initializer = default_embed_init
     axis: str = ps.TP_AXIS
     # LoRA adapter (reference modules/lora/layer.py LoraEmbedding): A is
-    # vocab-sharded like the table, B replicated.
+    # vocab-sharded like the table, B replicated. lora_dropout is accepted
+    # for config uniformity but inapplicable — the input is integer ids
+    # (the reference's LoraEmbedding likewise applies no dropout).
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    lora_dropout: float = 0.0
 
     @nn.compact
     def __call__(self, ids: jax.Array) -> jax.Array:
@@ -437,9 +458,13 @@ class GQAQKVColumnParallelLinear(nn.Module):
     axis: str = ps.TP_AXIS
     seq_dim: int = 1
     tp_size: Optional[int] = None  # required to size KV replication
-    # LoRA adapters (weight-space; reference LoraGQAQKVParallelLinear)
+    # LoRA adapters (weight-space; reference LoraGQAQKVParallelLinear).
+    # With lora_dropout active (rate > 0 and a "dropout" rng supplied) the
+    # adapters switch to activation space — dropout on the adapter input
+    # cannot be expressed as a weight delta.
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    lora_dropout: float = 0.0
 
     def _tp(self) -> int:
         s = _bound_size(self.axis)
@@ -485,6 +510,8 @@ class GQAQKVColumnParallelLinear(nn.Module):
                         kv_shape, self.param_dtype)
         wv = self.param("v_kernel", _partitioned(self.kernel_init, kv_names),
                         kv_shape, self.param_dtype)
+        lora_act = (self.lora_rank > 0 and self.lora_dropout > 0.0
+                    and self.has_rng("dropout"))
         if self.lora_rank > 0:
             # weight-space adapters (reference LoraGQAQKVParallelLinear,
             # tp_layer.py:62): delta = scale * A @ B added to each kernel,
@@ -508,9 +535,10 @@ class GQAQKVColumnParallelLinear(nn.Module):
             vb = self.param("v_lora_b", _partitioned(
                 nn.initializers.zeros_init(), kv_names),
                 (self.lora_rank, kv_shape[1]), self.param_dtype)
-            wq = wq + scale * (qa @ qb)
-            wk = wk + scale * (ka @ kb)
-            wv = wv + scale * (va @ vb)
+            if not lora_act:
+                wq = wq + scale * (qa @ qb)
+                wk = wk + scale * (ka @ kb)
+                wv = wv + scale * (va @ vb)
 
         bq = bk = bv = None
         if self.use_bias:
@@ -535,6 +563,15 @@ class GQAQKVColumnParallelLinear(nn.Module):
                 wk, head * self.head_dim, self.head_dim, axis=1)
             wv = jax.lax.dynamic_slice_in_dim(
                 wv, head * self.head_dim, self.head_dim, axis=1)
+            if lora_act:
+                # activation-space adapters need the same per-head slice of
+                # the replicated B factors
+                kb = mappings.copy_to_tensor_parallel_region(kb, self.axis)
+                vb = mappings.copy_to_tensor_parallel_region(vb, self.axis)
+                kb = jax.lax.dynamic_slice_in_dim(
+                    kb, head * self.head_dim, self.head_dim, axis=1)
+                vb = jax.lax.dynamic_slice_in_dim(
+                    vb, head * self.head_dim, self.head_dim, axis=1)
             if self.use_bias:
                 bk = mappings.copy_to_tensor_parallel_region(bk, self.axis)
                 bv = mappings.copy_to_tensor_parallel_region(bv, self.axis)
@@ -553,6 +590,14 @@ class GQAQKVColumnParallelLinear(nn.Module):
         q = jnp.dot(x, wq.astype(self.dtype))
         k = jnp.dot(x, wk.astype(self.dtype))
         v = jnp.dot(x, wv.astype(self.dtype))
+        if lora_act:
+            x_l = _lora_input(self, x, self.lora_dropout)
+            q = q + scale * jnp.dot(jnp.dot(x_l, qa.astype(self.dtype)),
+                                    qb.astype(self.dtype))
+            k = k + scale * jnp.dot(jnp.dot(x_l, ka.astype(self.dtype)),
+                                    kb.astype(self.dtype))
+            v = v + scale * jnp.dot(jnp.dot(x_l, va.astype(self.dtype)),
+                                    vb.astype(self.dtype))
         if self.use_bias:
             q = q + bq.astype(self.dtype)
             k = k + bk.astype(self.dtype)

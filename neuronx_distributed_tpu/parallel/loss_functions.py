@@ -166,9 +166,17 @@ def fused_linear_cross_entropy(
     ls = jnp.swapaxes(labels.reshape(b, nc, chunk), 0, 1)     # [nc,B,C]
     kern = kernel.astype(dtype)
 
+    gspmd = comm._axis_size(axis) is None
+
     def body(acc, xl):
         xc, lc = xl
         logits = jnp.dot(xc.astype(dtype), kern)
+        if gspmd:
+            # GSPMD path: pin the chunk logits vocab-sharded (mirrors
+            # ColumnParallelLinear's output constraint, layers.py:124-128)
+            # so XLA doesn't replicate [B,chunk,V] across tp inside the
+            # scan, defeating the memory goal
+            logits = ps.with_sharding_constraint(logits, None, None, axis)
         per_tok = parallel_cross_entropy(logits, lc, axis=axis,
                                          ignore_index=ignore_index)
         return acc + jnp.sum(per_tok), None

@@ -188,6 +188,7 @@ def make_train_step(
     donate: bool = True,
     grad_accum_steps: int = 1,
     scan_steps: int = 1,
+    dropout_rng: Optional[jax.Array] = None,
 ):
     """Build the jitted SPMD train step.
 
@@ -205,6 +206,12 @@ def make_train_step(
     loss_fn or grad_fn. Note: the result is the *mean over microbatch
     means* — identical to the full-batch step when microbatches carry equal
     valid-token counts (the reference accumulates the same way).
+
+    ``dropout_rng``: base PRNG key enabling dropout (attention/hidden/LoRA —
+    any module gated on the "dropout" rng). Folded with ``state.step`` each
+    step so masks differ per step while the compiled program stays one
+    program. Only the default loss_fn threads it; custom loss_fn/grad_fn
+    callers manage their own rngs.
     """
     mesh = ps.get_mesh()
 
@@ -212,23 +219,38 @@ def make_train_step(
         raise ValueError(
             "pass either loss_fn (differentiated here) or grad_fn "
             "(self-differentiating, e.g. the pipeline engine), not both")
+    if dropout_rng is not None and (loss_fn is not None
+                                    or grad_fn is not None):
+        raise ValueError(
+            "dropout_rng is only threaded through the default loss_fn; "
+            "custom loss_fn/grad_fn callers must manage their own rngs "
+            "(fold state.step in and pass rngs= to apply)")
     if grad_accum_steps < 1:
         raise ValueError(f"grad_accum_steps must be >= 1, got "
                          f"{grad_accum_steps}")
     if scan_steps < 1:
         raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")
     if loss_fn is None and grad_fn is None:
-        def loss_fn(module, params, batch):
+        def loss_fn(module, params, batch, rngs=None):
             input_ids, labels = batch["input_ids"], batch["labels"]
+            if rngs is not None:
+                return module.apply(params, input_ids, labels,
+                                    method="loss", rngs=rngs)
             return module.apply(params, input_ids, labels, method="loss")
+        default_loss = True
+    else:
+        default_loss = False
 
-    def one_grad(params, batch):
+    def one_grad(params, batch, rngs=None):
         if grad_fn is not None:
             return grad_fn(params, batch)
+        if default_loss:
+            return jax.value_and_grad(
+                lambda p: loss_fn(pm.module, p, batch, rngs))(params)
         return jax.value_and_grad(
             lambda p: loss_fn(pm.module, p, batch))(params)
 
-    def accum_grad(params, batch):
+    def accum_grad(params, batch, rngs=None):
         a = grad_accum_steps
 
         def slice_mb(x):
@@ -246,25 +268,32 @@ def make_train_step(
         mbs = jax.tree_util.tree_map(
             lambda x: jax.lax.with_sharding_constraint(x, mb_sharding), mbs)
 
-        def body(carry, mb):
+        def body(carry, xs):
             loss_sum, gacc = carry
-            loss, g = one_grad(params, mb)
+            mb, i = xs
+            mb_rngs = (None if rngs is None else
+                       {k: jax.random.fold_in(r, i)
+                        for k, r in rngs.items()})
+            loss, g = one_grad(params, mb, mb_rngs)
             gacc = jax.tree_util.tree_map(jnp.add, gacc, g)
             return (loss_sum + loss, gacc), None
 
         zero = jax.tree_util.tree_map(
             lambda p: jnp.zeros(jnp.shape(p), jnp.result_type(p)), params)
         (loss_sum, gsum), _ = jax.lax.scan(
-            body, (jnp.zeros((), jnp.float32), zero), mbs)
+            body, (jnp.zeros((), jnp.float32), zero),
+            (mbs, jnp.arange(a)))
         scale = 1.0 / a
         return loss_sum * scale, jax.tree_util.tree_map(
             lambda g: g * scale, gsum)
 
     def step_fn(state: TrainState, batch) -> Tuple[TrainState, dict]:
+        rngs = (None if dropout_rng is None else
+                {"dropout": jax.random.fold_in(dropout_rng, state.step)})
         if grad_accum_steps > 1:
-            loss, grads = accum_grad(state.params, batch)
+            loss, grads = accum_grad(state.params, batch, rngs)
         else:
-            loss, grads = one_grad(state.params, batch)
+            loss, grads = one_grad(state.params, batch, rngs)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {

@@ -113,11 +113,12 @@ def test_pallas_bwd_kernels_match_xla_golden():
         v = jax.random.normal(ks[2], (b, sk, n, d))
         g = jax.random.normal(ks[3], (b, sq, n, d))
         scale = 1.0 / np.sqrt(d)
-        out, lse = _flash_pallas_fwd(q, k, v, causal, 64, 64, scale,
+        zseed = jnp.zeros((1,), jnp.uint32)
+        out, lse = _flash_pallas_fwd(q, k, v, zseed, causal, 64, 64, scale,
                                      interpret=True)
         ref = _flash_bwd_from_lse(q, k, v, out, lse, g, causal, 64, scale)
-        got = _flash_pallas_bwd(q, k, v, out, lse, g, causal, 64, 64, scale,
-                                interpret=True)
+        got = _flash_pallas_bwd(q, k, v, out, lse, g, zseed, causal, 64, 64,
+                                scale, interpret=True)
         for a, r, name in zip(got, ref, "qkv"):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(r), rtol=2e-5, atol=2e-5,
