@@ -1,0 +1,100 @@
+"""Mixtral (MoE) serving: cached prefill/decode with dropless experts and
+optional per-phase TP x EP meshes.
+
+Analogue of the reference's ``examples/inference/mixtral`` runner. With
+``--phase-meshes``, context encoding runs under a wide-TP mesh view and
+token generation under a wide-EP one (reference CTE/TKG MoE process groups,
+``modules/moe/moe_process_group.py:12``).
+
+    python examples/inference/mixtral_serve.py --max-new 16
+    python examples/inference/mixtral_serve.py --phase-meshes \
+        --cte-tp 2 --cte-ep 2 --tkg-tp 1 --tkg-ep 4
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.core import meta
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.inference.kv_cache import init_kv_cache
+from neuronx_distributed_tpu.models.mixtral import (MIXTRAL_8X7B,
+                                                    MixtralForCausalLM,
+                                                    mixtral_forward_with_cache,
+                                                    tiny_moe_config)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny", choices=["tiny", "8x7b"])
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--ep", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--prompt-len", type=int, default=8)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--phase-meshes", action="store_true",
+                    help="prefill under (cte_tp, cte_ep), decode under "
+                         "(tkg_tp, tkg_ep) mesh views")
+    ap.add_argument("--cte-tp", type=int, default=2)
+    ap.add_argument("--cte-ep", type=int, default=2)
+    ap.add_argument("--tkg-tp", type=int, default=1)
+    ap.add_argument("--tkg-ep", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=args.tp,
+                                         expert_parallel_size=args.ep)
+    mcfg = (tiny_moe_config(moe_dispatch="blockwise", moe_block_size=8)
+            if args.model == "tiny" else MIXTRAL_8X7B)
+    model = MixtralForCausalLM(mcfg)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, mcfg.vocab_size,
+                                  (args.batch, args.prompt_len)))
+    plen = jnp.full((args.batch,), args.prompt_len, jnp.int32)
+
+    from neuronx_distributed_tpu.trainer import initialize_parallel_model
+
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(0),
+                                           ids)
+
+    t0 = time.perf_counter()
+    if args.phase_meshes:
+        from neuronx_distributed_tpu.inference.moe_serving import (
+            moe_phase_generate)
+
+        toks = moe_phase_generate(
+            mcfg, params, pm.param_specs, ids, plen, args.max_new,
+            cte=(args.cte_tp, args.cte_ep),
+            tkg=(args.tkg_tp, args.tkg_ep),
+            buckets=(args.prompt_len,))
+    else:
+        cache = init_kv_cache(mcfg.num_layers, args.batch,
+                              args.prompt_len + args.max_new,
+                              mcfg.num_kv_heads, mcfg.head_dim_,
+                              dtype=mcfg.dtype)
+        ar = jnp.broadcast_to(jnp.arange(args.prompt_len),
+                              (args.batch, args.prompt_len))
+        logits, cache = mixtral_forward_with_cache(mcfg, params, ids, ar,
+                                                   cache)
+        tok = jnp.argmax(logits[:, -1], axis=-1)
+        pos = plen
+        out = []
+        for _ in range(args.max_new):
+            out.append(tok)
+            logits, cache = mixtral_forward_with_cache(
+                mcfg, params, tok[:, None], pos[:, None], cache)
+            tok = jnp.argmax(logits[:, 0], axis=-1)
+            pos = pos + 1
+        toks = jnp.stack(out, axis=1)
+    jax.block_until_ready(toks)
+    dt = time.perf_counter() - t0
+    total = args.batch * args.max_new
+    print(f"generated {total} tokens in {dt*1e3:.1f} ms "
+          f"({total/dt:,.0f} tok/s, phase_meshes={args.phase_meshes})")
+    print("tokens:", np.asarray(toks).tolist())
+
+
+if __name__ == "__main__":
+    main()

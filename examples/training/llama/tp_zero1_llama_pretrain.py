@@ -37,18 +37,37 @@ MODELS = {
 
 def batches(args, vocab):
     if args.data:
-        stream = np.load(args.data, mmap_mode="r")
-        n = args.batch * (args.seq + 1)
-        i = 0
+        # native C++ loader: mmap + shuffled prefetch on background
+        # threads, IO off the GIL (csrc/data_loader.cpp). .npy inputs are
+        # converted once to the raw token stream the loader mmaps.
+        from neuronx_distributed_tpu.data.native_loader import (
+            TokenBatchLoader)
+
+        import os
+
+        path = args.data
+        if path.endswith(".npy"):
+            arr = np.load(path, mmap_mode="r")
+            path = path[:-len(".npy")] + ".bin"
+            # regenerate when the .npy is newer (mtime check, matching the
+            # native loader's own .so cache); wider int dtypes narrow to
+            # the loader's uint32
+            if (not os.path.exists(path)
+                    or os.path.getmtime(path) < os.path.getmtime(args.data)):
+                if arr.dtype.itemsize in (2, 4):
+                    np.asarray(arr).tofile(path)
+                else:
+                    np.asarray(arr).astype(np.uint32).tofile(path)
+            dtype = (arr.dtype.name if arr.dtype.itemsize in (2, 4)
+                     else "uint32")
+        else:
+            dtype = "uint16" if vocab <= 0xFFFF else "uint32"
+        loader = TokenBatchLoader(path, args.batch, args.seq, dtype=dtype)
+        print(f"data: native loader={loader.native} "
+              f"({loader.num_sequences} sequences)")
         while True:
-            chunk = np.asarray(stream[i:i + n])
-            if len(chunk) < n:
-                i = 0
-                continue
-            i += n
-            ids = chunk.reshape(args.batch, args.seq + 1).astype(np.int32)
-            yield {"input_ids": jnp.asarray(ids[:, :-1]),
-                   "labels": jnp.asarray(ids[:, 1:])}
+            b = loader.next_batch()
+            yield {k: jnp.asarray(v) for k, v in b.items()}
     else:
         rng = np.random.RandomState(0)
         while True:

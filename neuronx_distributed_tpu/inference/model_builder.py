@@ -347,8 +347,18 @@ class NxDModel:
         return {"format": "orbax", "dir": os.path.basename(store_dir)}
 
     @classmethod
-    def load(cls, path: str, devices: Optional[Sequence] = None
-             ) -> "NxDModel":
+    def load(cls, path: str, devices: Optional[Sequence] = None,
+             trust_packaged_executables: bool = False) -> "NxDModel":
+        """Load a serving bundle.
+
+        ``trust_packaged_executables``: the packaged-executable payloads
+        (instant cold start) are pickle-encoded by
+        ``jax.experimental.serialize_executable`` — unpickling executes
+        arbitrary code if the bundle was tampered with. Default False:
+        packaged executables are SKIPPED and every graph recompiles lazily
+        from its (safe) StableHLO export; pass True only for bundles from a
+        trusted store (the deployment's own artifact registry).
+        """
         import numpy as np
 
         artifacts: Dict[Tuple[str, int], TraceArtifacts] = {}
@@ -357,6 +367,7 @@ class NxDModel:
             if manifest["version"] not in (1, 2, cls.FORMAT_VERSION):
                 raise ValueError(
                     f"unsupported NxDModel format {manifest['version']}")
+            warned_untrusted = False
             for item in manifest["artifacts"]:
                 exported = jax_export.deserialize(z.read(item["file"]))
                 leaves = [jax.ShapeDtypeStruct(a.shape, a.dtype)
@@ -366,7 +377,15 @@ class NxDModel:
                                                        leaves)
                 art = TraceArtifacts(key=item["key"], bucket=tuple(args),
                                      exported=exported)
-                if item.get("pjrt_file"):
+                if item.get("pjrt_file") and not trust_packaged_executables:
+                    if not warned_untrusted:
+                        logger.info(
+                            "bundle carries packaged executables; skipping "
+                            "them (pickle payloads) — pass "
+                            "trust_packaged_executables=True for instant "
+                            "cold start from a trusted store")
+                        warned_untrusted = True
+                elif item.get("pjrt_file"):
                     # instant cold start: load the packaged executable; any
                     # runtime/topology mismatch falls back to lazy recompile
                     try:

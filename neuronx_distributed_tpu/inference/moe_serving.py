@@ -1,0 +1,131 @@
+"""Per-phase TP x EP meshes for MoE serving.
+
+Analogue of the reference's prefill-vs-decode MoE process groups
+(``modules/moe/moe_process_group.py:12``, consumed by
+``modules/moe/expert_mlps_v2.py``): context encoding (CTE) is compute-bound
+and prefers wide TP; token generation (TKG) is expert-bandwidth-bound and
+prefers wide EP. Here each phase runs under its own
+:func:`..parallel.mesh.get_moe_phase_mesh` view of the SAME device array —
+no process-group rebuilds, just two ``shard_map`` closures whose bound axis
+sizes differ. Axis names match the global mesh, so the parallel layers and
+MoE dispatch run unchanged under either view.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..models.mixtral import (MixtralConfig, MixtralForCausalLM,
+                              mixtral_forward_with_cache)
+from ..parallel import mesh as ps
+from .kv_cache import KVCache, init_kv_cache
+
+
+def _phase_fn(cfg: MixtralConfig, mesh):
+    """shard_map'd ``(params, ids, positions, cache) -> (logits, cache)``
+    over one phase mesh. Data and cache ride replicated (serving batches
+    are small); params enter per THIS phase's spec tree — layouts are
+    tp-size-dependent (GQA keeps the single-copy KV kernel replicated when
+    phase tp > num_kv_heads, sharded otherwise), so each phase derives its
+    own specs rather than reusing the training mesh's."""
+    tp = mesh.shape[ps.TP_AXIS]
+    if cfg.num_kv_heads % tp != 0:
+        # the serving KV cache shards its kv-head dim over tp; a phase tp
+        # beyond num_kv_heads would need per-rank replica caches (the GQA
+        # mult>1 slice) — pick a wider ep instead for such phases
+        raise ValueError(
+            f"phase tp={tp} must divide num_kv_heads={cfg.num_kv_heads} "
+            "(the phase KV cache is kv-head-sharded over tp)")
+    pcfg = dataclasses.replace(cfg, tp_size=tp)
+    model = MixtralForCausalLM(pcfg)
+    boxed = jax.eval_shape(model.init, jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    raw_specs = nn.get_partition_spec(boxed)
+    axes = set(mesh.axis_names)
+
+    def clean(spec):
+        if not isinstance(spec, P):
+            return P()
+        return P(*[a if (a in axes or (isinstance(a, tuple)
+                                       and set(a) <= axes)) else None
+                   for a in spec])
+
+    param_specs = jax.tree_util.tree_map(
+        clean, raw_specs, is_leaf=lambda s: isinstance(s, P))
+    # cache [L, B, S, KV, D]: kv heads shard over this phase's tp, matching
+    # the layer's per-rank local K/V
+    kv_spec = P(None, None, None, ps.TP_AXIS, None)
+    cache_specs = KVCache(k=kv_spec, v=kv_spec, pos=P(), index=P())
+
+    def inner(params, ids, positions, cache):
+        logits, new_cache = mixtral_forward_with_cache(
+            pcfg, params, ids, positions, cache)
+        return logits, new_cache
+
+    return jax.jit(ps.shard_map(
+        inner, mesh,
+        in_specs=(param_specs, P(), P(), cache_specs),
+        out_specs=(P(), cache_specs)))
+
+
+def make_phase_serving_fns(cfg: MixtralConfig,
+                           cte: Tuple[int, int],
+                           tkg: Tuple[int, int]):
+    """Build ``(prefill_fn, decode_fn)`` where prefill runs under the
+    CTE ``(tp, ep)`` phase mesh and decode under the TKG one. The single
+    stored param tree serves both phases (true-GQA single-copy KV and
+    [E, in, out] expert stacks are layout-identical across tp/ep sizes);
+    only each phase's distribution differs."""
+    cte_mesh = ps.get_moe_phase_mesh(*cte)
+    tkg_mesh = ps.get_moe_phase_mesh(*tkg)
+    return _phase_fn(cfg, cte_mesh), _phase_fn(cfg, tkg_mesh)
+
+
+def moe_phase_generate(cfg: MixtralConfig, params, param_specs,
+                       input_ids, prompt_len, max_new_tokens: int,
+                       cte: Tuple[int, int], tkg: Tuple[int, int],
+                       buckets: Sequence[int] = (128, 512, 2048),
+                       kv_dtype=None) -> jax.Array:
+    """Greedy generation with prefill under the CTE TP x EP mesh and the
+    decode loop under the TKG mesh (reference: separate CTE/TKG groups,
+    ``moe_process_group.py:12``). Returns ``[B, max_new_tokens]``.
+
+    ``param_specs`` is accepted for signature stability but unused — each
+    phase derives its own spec tree (layouts are tp-size-dependent)."""
+    del param_specs
+    from .generation import pick_bucket
+    from .kv_cache import PAD_POSITION
+
+    prefill_fn, decode_fn = make_phase_serving_fns(cfg, cte, tkg)
+    input_ids = jnp.asarray(input_ids)
+    prompt_len = jnp.asarray(prompt_len)
+    b, s = input_ids.shape
+    bucket = pick_bucket(s, buckets)
+    if bucket > s:
+        input_ids = jnp.pad(input_ids, ((0, 0), (0, bucket - s)))
+    cache = init_kv_cache(cfg.num_layers, b, bucket + max_new_tokens,
+                          cfg.num_kv_heads, cfg.head_dim_,
+                          dtype=kv_dtype or cfg.dtype)
+
+    ar = jnp.broadcast_to(jnp.arange(bucket), (b, bucket))
+    positions = jnp.where(ar < prompt_len[:, None], ar, PAD_POSITION)
+    logits, cache = prefill_fn(params, input_ids, positions, cache)
+    last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None],
+                               axis=1)[:, 0]
+
+    toks = []
+    tok = jnp.argmax(last, axis=-1)
+    pos = prompt_len
+    for _ in range(max_new_tokens):
+        toks.append(tok)
+        logits, cache = decode_fn(params, tok[:, None], pos[:, None], cache)
+        tok = jnp.argmax(logits[:, 0], axis=-1)
+        pos = pos + 1
+    return jnp.stack(toks, axis=1)

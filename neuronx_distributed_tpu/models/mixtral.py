@@ -242,12 +242,14 @@ def mixtral_forward_with_cache(cfg: MixtralConfig, params,
 
     if not cfg.scan_layers:
         raise ValueError("cached decode requires scan_layers=True")
-    # token-generation-sized calls only: at prefill (large s) most experts
-    # are hit anyway and the decode kernel's partial-sum layout would cost
-    # O(num_ib * s * H) HBM for nothing (measured crossover ~T=4,
-    # BASELINE.md r3 decode-MoE table)
+    # token-generation-sized calls only: at prefill (large batch*seq) most
+    # experts are hit anyway and the decode kernel's partial-sum layout
+    # would cost O(num_ib * tokens * H) HBM for nothing (measured crossover
+    # ~T=4 tokens TOTAL, BASELINE.md r3 decode-MoE table — so the batch dim
+    # counts, advisor r3)
+    total_tokens = input_ids.shape[0] * input_ids.shape[1]
     if (cfg.moe_dispatch == "blockwise" and not cfg.moe_sentinel_empty
-            and input_ids.shape[1] * cfg.top_k <= cfg.num_experts):
+            and total_tokens * cfg.top_k <= cfg.num_experts):
         cfg = dataclasses.replace(cfg, moe_sentinel_empty=True)
     p = params["params"]
     b, s = input_ids.shape

@@ -65,14 +65,18 @@ def pipelined_moe_loss_fn(cfg: MixtralConfig, num_microbatches: int,
 
         embed_p = jax.tree_util.tree_map(eng.stage_replicated_param,
                                          p["model"]["embed"])
-        x = embed_mod.apply({"params": embed_p}, ids)
-        if cfg.sequence_parallel:
-            # stage activations ride the ring SP-sharded; the MoE block's
-            # own gather/scatter (MixtralDecoderLayer) handles the regather
-            # inside each stage (reference moe/model.py:154 delayed
-            # reduce-scatter inside NxDPPModel)
-            x = mappings.scatter_to_sequence_parallel_region(x, seq_dim=1)
-        x_mb = eng.microbatch(x, M)
+        ids_mb = eng.microbatch(ids, M)
+
+        def input_fn(ids_):
+            x = embed_mod.apply({"params": embed_p}, ids_)
+            if cfg.sequence_parallel:
+                # stage activations ride the ring SP-sharded; the MoE
+                # block's own gather/scatter (MixtralDecoderLayer) handles
+                # the regather inside each stage (reference
+                # moe/model.py:154 delayed reduce-scatter inside NxDPPModel)
+                x = mappings.scatter_to_sequence_parallel_region(x,
+                                                                 seq_dim=1)
+            return x
 
         body = nn.scan(
             _MoEScanBody,
@@ -92,8 +96,9 @@ def pipelined_moe_loss_fn(cfg: MixtralConfig, num_microbatches: int,
             stage_fn = jax.checkpoint(
                 stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
 
-        outs, aux_local = eng.pipeline_spmd(stage_fn, x_mb, S, M,
-                                            with_aux=True)
+        outs, aux_local = eng.pipeline_spmd(stage_fn, ids_mb, S, M,
+                                            with_aux=True,
+                                            input_fn=input_fn)
         # global router aux: sum over stages with the fwd-psum/bwd-identity
         # mapping (raw psum would transpose to psum and hand every stage
         # S copies of the cotangent), then mean over microbatches

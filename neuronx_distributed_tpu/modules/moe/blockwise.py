@@ -337,7 +337,10 @@ def grouped_glu_decode(xs, gate_up, down, block_expert, block_size,
     we = functools.partial(jnp.minimum, num_real - 1)
     partial = pl.pallas_call(
         functools.partial(_glu_fwd_decode_kernel, num_real=num_real),
-        out_shape=jax.ShapeDtypeStruct((num_ib, p, h), xs.dtype),
+        # fp32 partials: the per-ib contributions are summed below, and a
+        # bf16 round-trip through HBM before that sum loses mantissa bits
+        # the kernel already paid fp32 accumulation for (advisor r3)
+        out_shape=jax.ShapeDtypeStruct((num_ib, p, h), jnp.float32),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(num_ib, nb),
@@ -354,7 +357,7 @@ def grouped_glu_decode(xs, gate_up, down, block_expert, block_size,
         interpret=interpret,
         compiler_params=None if interpret else _compiler_params(),
     )(block_expert, xs, gate_up, down)
-    return jnp.sum(partial.astype(jnp.float32), axis=0).astype(xs.dtype)
+    return jnp.sum(partial, axis=0).astype(xs.dtype)
 
 
 def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,

@@ -85,6 +85,15 @@ class MoE(nn.Module):
         gates, idx, aux = router_cls(**router_kw)(flat)
 
         if self.expert_impl.startswith("mx_"):
+            if self.dispatch_mode != "capacity":
+                # MXExpertMLPs only implements the capacity path; silently
+                # ignoring a requested blockwise dispatch would change the
+                # drop behaviour without telling the user (advisor r3)
+                raise ValueError(
+                    f"expert_impl={self.expert_impl!r} supports only "
+                    f"dispatch_mode='capacity' (got "
+                    f"{self.dispatch_mode!r}); use float experts for "
+                    "blockwise/dropless dispatch")
             from ...quantization.mx_layers import MXExpertMLPs
 
             experts = MXExpertMLPs(

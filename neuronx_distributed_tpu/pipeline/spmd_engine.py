@@ -62,6 +62,7 @@ def pipeline_spmd(
     num_microbatches: int,
     axis: str = ps.PP_AXIS,
     with_aux: bool = False,
+    input_fn: Optional[Callable[[jax.Array], jax.Array]] = None,
 ):
     """Run the scanned GPipe pipeline. Must be called with ``axis`` bound
     (inside shard_map).
@@ -72,6 +73,15 @@ def pipeline_spmd(
         ``with_aux`` it returns ``(act, aux)`` where ``aux`` is a pytree of
         per-stage scalars (e.g. MoE router losses).
       x_mb: ``[M, mb, ...]`` stage-0 input microbatches (replicated over pp).
+        With ``input_fn``, these are the RAW inputs (e.g. int32 token ids)
+        and ``input_fn`` maps one microbatch to stage-0 activations INSIDE
+        the tick, cond-gated to stage 0's valid ticks — so only the small
+        raw inputs ride the scan replicated, never the [M, mb, S, H]
+        activations (the 1F1B engine embeds per-tick the same way,
+        ``engine_1f1b.py:231``). input_fn may contain tp collectives: the
+        gate predicate depends only on the pp coordinate, hence is uniform
+        across tp. Its param grads keep the stage-0-only pattern the
+        ``stage_replicated_param`` psum expects.
 
     Returns ``[M, mb, ...]`` outputs, **valid on the last pp rank only**
     (other ranks carry bubble garbage; mask before use). With ``with_aux``
@@ -97,22 +107,46 @@ def pipeline_spmd(
     ticks = M + S - 1
     perm = [(i, i + 1) for i in range(S - 1)]
 
+    if input_fn is not None:
+        act_sd = jax.eval_shape(input_fn, x_mb[0])
+        act0 = jnp.zeros(act_sd.shape, act_sd.dtype)
+    else:
+        act0 = jnp.zeros_like(x_mb[0])
+    if with_aux:
+        _, aux_shape = jax.eval_shape(stage_fn, act0)
+        zero_aux = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), aux_shape)
+
     def tick(act, t):
-        inp = lax.dynamic_index_in_dim(x_mb, jnp.minimum(t, M - 1), 0,
+        # stage `my` computes microbatch m = t - my; ticks outside
+        # [my, my + M) are bubbles and skip the stage compute entirely via
+        # lax.cond (matching the 1F1B engine, engine_1f1b.py:241 — the
+        # reference's schedules simply emit no task for bubbles)
+        valid = (t >= my) & (t < my + M)
+        raw = lax.dynamic_index_in_dim(x_mb, jnp.minimum(t, M - 1), 0,
                                        keepdims=False)
+        if input_fn is not None:
+            # embed only on stage 0's firing ticks (predicate uniform
+            # across tp, so collectives inside input_fn are legal)
+            inp = lax.cond(valid & (my == 0),
+                           lambda r: input_fn(r).astype(act0.dtype),
+                           lambda r: act0, raw)
+        else:
+            inp = raw
         act_in = jnp.where(my == 0, inp, act)
         if with_aux:
-            out, aux = stage_fn(act_in)
-            # this stage's valid ticks are [my, my + M)
-            valid = ((t >= my) & (t < my + M)).astype(jnp.float32)
-            aux = jax.tree_util.tree_map(lambda a: a * valid, aux)
+            out, aux = lax.cond(
+                valid, stage_fn,
+                lambda a: (jnp.zeros_like(a), zero_aux), act_in)
+            aux = jax.tree_util.tree_map(
+                lambda a: a * valid.astype(a.dtype), aux)
         else:
-            out = stage_fn(act_in)
+            out = lax.cond(valid, stage_fn,
+                           lambda a: jnp.zeros_like(a), act_in)
             aux = None
         act_next = comm.ppermute(out, axis, perm)
         return act_next, (out, aux) if with_aux else out
 
-    act0 = jnp.zeros_like(x_mb[0])
     _, ys = lax.scan(tick, act0, jnp.arange(ticks))
     # microbatch m finishes on the last stage at tick m + S - 1
     if with_aux:

@@ -13,6 +13,7 @@ behind one abstraction so the engine never touches ``os.path`` directly.
 
 from __future__ import annotations
 
+import errno
 import functools
 import json
 import logging
@@ -43,9 +44,21 @@ _TRANSIENT_MARKERS = ("slowdown", "slow down", "throttl", "timed out",
                       "internal error")
 _TRANSIENT_STATUS_RE = re.compile(r"\b(?:429|500|502|503|504)\b")
 
+# OSError errnos that describe a deterministic local condition, not an
+# environment hiccup: no amount of backoff frees the disk or remounts the
+# filesystem writable.
+_DETERMINISTIC_ERRNOS = frozenset(
+    getattr(errno, name) for name in
+    ("ENOSPC", "EDQUOT", "EROFS", "ENAMETOOLONG", "EISDIR", "ENOTDIR")
+    if hasattr(errno, name))
+
 
 def _is_transient(e: Exception) -> bool:
     if isinstance(e, _NON_RETRIABLE):
+        return False
+    if isinstance(e, OSError) and e.errno in _DETERMINISTIC_ERRNOS:
+        # disk full / quota / read-only fs: retrying with backoff burns
+        # minutes before surfacing the same condition (advisor r3)
         return False
     if isinstance(e, (ConnectionError, TimeoutError, OSError)):
         # network errors plus remaining OSErrors (EIO, ENETDOWN, stale NFS

@@ -60,10 +60,13 @@ def _spec_tree(boxed_variables, logical_axis_rules=None) -> Any:
     that are not mesh axes are mapped through ``logical_axis_rules`` (e.g.
     ``{"layers": "pp"}`` for pipeline parallelism) and otherwise replicated.
 
-    A RULE-mapped axis whose dim is not divisible by the mesh axis size
-    falls back to replication (an odd layer count over pp: the pipeline
-    grad_fn then slices stages in-graph). Direct mesh-axis annotations
-    (e.g. tp on a hidden dim) keep failing loudly — those are genuine
+    RULE-mapped axes keep their mesh axis even when the dim is not
+    divisible by the axis size: GSPMD shards uneven dims by padding the
+    last shard, so an odd layer count over pp still stores ~1/S of the
+    stack per stage (reference partitions unevenly, partition.py:280; the
+    pipeline grad_fn zero-pads to a divisible length before entering its
+    shard_map). Direct mesh-axis annotations (e.g. tp on a hidden dim)
+    keep failing loudly on indivisibility — those are genuine
     misconfigurations.
     """
     specs = nn.get_partition_spec(boxed_variables)
@@ -82,11 +85,7 @@ def _spec_tree(boxed_variables, logical_axis_rules=None) -> Any:
     def map_axis(a, dim_size):
         if a in mesh_axes:
             return a
-        m = rules.get(a)
-        if (m is not None and dim_size is not None
-                and dim_size % sizes.get(m, 1) != 0):
-            return None
-        return m
+        return rules.get(a)
 
     def clean(spec, shape):
         if not isinstance(spec, PartitionSpec):
@@ -130,13 +129,57 @@ def initialize_parallel_model(
     specs = _spec_tree(boxed_shapes, logical_axis_rules)
     shapes = jax.tree_util.tree_map(
         lambda x: tuple(x.shape), meta.unbox(boxed_shapes))
+
+    # Uneven RULE-mapped stacks (odd layer count over pp): NamedSharding
+    # requires divisible dims, so the STORAGE is zero-padded up to the next
+    # multiple — inside the jitted init, so GSPMD materialises only each
+    # device's shard, never a replicated [L] stack. Per-stage param and
+    # optimizer bytes are ~1/S of dense (reference partitions unevenly,
+    # partition.py:280). Pad rows are zero, their grads are masked zero by
+    # the pipeline grad_fn, and ``llama_pipeline.unpad_pipeline_params``
+    # strips them for export/serving. ONLY logical-rule axes (e.g.
+    # "layers"→pp) pad; direct mesh-axis annotations (tp on a vocab or
+    # feature dim) keep failing loudly — padding those would silently
+    # change model numerics (e.g. pad vocab columns entering the CE
+    # logsumexp of a tied head).
+    sizes = dict(ps.get_mesh().shape)
+    rules = logical_axis_rules or {}
+    raw_specs = nn.get_partition_spec(boxed_shapes)
+
+    def _pad_amount(raw, spec, shape):
+        rule_mapped = (isinstance(raw, PartitionSpec) and len(raw)
+                       and isinstance(raw[0], str) and raw[0] in rules)
+        if (rule_mapped and isinstance(spec, PartitionSpec) and len(spec)
+                and shape and isinstance(spec[0], str)):
+            n = sizes.get(spec[0])
+            if n and shape[0] % n != 0:
+                return (-(-shape[0] // n)) * n - shape[0]
+        return 0
+
+    pads = jax.tree_util.tree_map(
+        _pad_amount, raw_specs, specs, shapes,
+        is_leaf=lambda s: isinstance(s, PartitionSpec))
+    needs_pad = any(jax.tree_util.tree_leaves(pads))
+    if needs_pad:
+        def unboxed_init(r, *a):
+            p = meta.unbox(init_fn(r, *a))
+            return jax.tree_util.tree_map(
+                lambda x, n: jnp.pad(
+                    x, [(0, n)] + [(0, 0)] * (x.ndim - 1)) if n else x,
+                p, pads)
+        # pads leads: its int leaves are true leaves, while shapes' tuple
+        # leaves would be descended into as containers
+        shapes = jax.tree_util.tree_map(
+            lambda n, s: (s[0] + n,) + tuple(s[1:]) if n else s,
+            pads, shapes)
+    else:
+        def unboxed_init(r, *a):
+            return meta.unbox(init_fn(r, *a))
+
     shardings = jax.tree_util.tree_map(
         ps.named_sharding_for_spec, specs,
         is_leaf=lambda s: isinstance(s, PartitionSpec))
-
-    init_jit = jax.jit(
-        lambda r, *a: meta.unbox(init_fn(r, *a)),
-        out_shardings=shardings)
+    init_jit = jax.jit(unboxed_init, out_shardings=shardings)
     params = init_jit(rng, *sample_args)
     pm = ParallelModel(module=module, config=cfg, param_specs=specs,
                        param_shapes=shapes)

@@ -11,6 +11,9 @@ import itertools
 import numpy as np
 import pytest
 
+# heavyweight sweep tier: excluded from the fast gate (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 

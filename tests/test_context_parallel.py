@@ -58,6 +58,7 @@ def test_ring_attention_grads_match_dense():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_llama_cp_training_matches_dense():
     """tp=2 × cp=2 × dp=2: full-model loss and grads equal the dense model
     (sequence sliced over cp, ring attention, global rope positions)."""
@@ -200,6 +201,7 @@ def test_ulysses_attention_matches_dense():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_llama_cp_ulysses_training_matches_dense():
     """Full-model CP training with cp_attn_impl='ulysses' matches dense."""
     from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,

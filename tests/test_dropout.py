@@ -147,6 +147,7 @@ def test_llama_attention_dropout_active_iff_rng():
     assert not np.array_equal(np.asarray(tr_a), np.asarray(eval_a))
 
 
+@pytest.mark.slow
 def test_bert_trains_with_dropout():
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models.bert import (BertForPreTraining,

@@ -50,7 +50,8 @@ def _loss_and_grads(cfg, params, ids, labels):
     return float(loss), grads
 
 
-@pytest.mark.parametrize("chunk", [16, 24])  # 24 does not divide s=32
+@pytest.mark.parametrize("chunk", [
+    16, pytest.param(24, marks=pytest.mark.slow)])  # 24: non-dividing pad case
 def test_fused_loss_matches_classic_tp1(chunk):
     ps.initialize_model_parallel(tensor_model_parallel_size=1)
     base = _fp32()
@@ -102,6 +103,7 @@ def test_fused_loss_checkpoint_interchange():
     assert c_head == f_head, (c_head, f_head)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("sp", [False, True])
 def test_fused_loss_matches_classic_tp4(sp):
     """tp=4 shard_map: fused loss ≡ classic loss to fp32 tolerance,

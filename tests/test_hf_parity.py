@@ -6,6 +6,9 @@ directly, so parity with HF IS parity with the reference)."""
 import numpy as np
 import pytest
 
+# heavyweight sweep tier: excluded from the fast gate (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 

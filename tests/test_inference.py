@@ -223,6 +223,7 @@ def test_router_smallest_bucket_and_padding(tiny_model):
         nxd_model.forward("ce", ids)
 
 
+@pytest.mark.slow
 def test_speculative_generate_exact_and_accepting(tiny_model):
     """End-to-end speculative decoding (reference 'speculation' key):
     greedy speculative output must equal the target's own greedy decode for
@@ -254,6 +255,7 @@ def test_speculative_generate_exact_and_accepting(tiny_model):
     assert (np.asarray(toks2) == np.asarray(ref)).all()
 
 
+@pytest.mark.slow
 def test_bundle_serves_from_fresh_process(tiny_model, tmp_path):
     """The decisive serving-bundle gate (VERDICT r1 missing #6): save a
     bundle with programs + weights + state spec + generation config, load
@@ -382,7 +384,11 @@ import numpy as np, jax
 import jax.tree_util as jtu
 from neuronx_distributed_tpu.inference.model_builder import (NxDModel,
                                                              bundle_generate)
-m = NxDModel.load({path!r})
+# default load must NOT unpickle packaged executables (untrusted bundle)
+m0 = NxDModel.load({path!r})
+assert all(a.compiled is None for a in m0._artifacts.values()), \\
+    "untrusted load must skip pickle-encoded executables"
+m = NxDModel.load({path!r}, trust_packaged_executables=True)
 assert all(a.compiled is not None for a in m._artifacts.values()), \\
     "packaged executables should load without recompilation"
 embed = m.params["params"]["model"]["embed"]["embedding"]
@@ -401,6 +407,7 @@ print("TOKENS", np.asarray(toks).tolist())
     np.testing.assert_array_equal(got, np.asarray(ref))
 
 
+@pytest.mark.slow
 def test_speculation_bundle_key_parity(tiny_model, tmp_path):
     """"speculation" as a first-class bundle key (reference
     model_base.py:155): a saved/loaded bundle packaging target + draft
@@ -571,6 +578,7 @@ def test_flash_decoding_kv_split_matches_dense():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_medusa_generate_exact(tiny_model):
     """Medusa end-to-end: decode heads draft the block, verified exactly
     like draft speculation — greedy output equals target-only decode
@@ -595,6 +603,7 @@ def test_medusa_generate_exact(tiny_model):
     assert int(stats["rounds"]) >= 1
 
 
+@pytest.mark.slow
 def test_decode_benchmark_suite_smoke(tiny_model):
     from neuronx_distributed_tpu.inference.benchmark import (
         decode_benchmark_suite)

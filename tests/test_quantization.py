@@ -270,6 +270,7 @@ def test_mx_layers_tp_parity():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_mx_expert_decode_end_to_end():
     """End-to-end mixtral decode from packed MX expert weights (the
     VERDICT 'Done =' for MX; reference experimental/expert_mlps_mx.py:299):

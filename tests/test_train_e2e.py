@@ -26,6 +26,7 @@ def _make_batch(rng, batch=8, seq=32, vocab=256):
     return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("zero1", [False, True])
 def test_tiny_llama_loss_decreases(zero1):
     cfg = nxd.neuronx_distributed_config(
@@ -75,6 +76,7 @@ def test_zero1_opt_state_sharded_over_dp():
     assert dp_sharded, "no optimizer-state leaf sharded over dp"
 
 
+@pytest.mark.slow
 def test_sequence_parallel_shard_map_matches_gspmd():
     """Full tiny-llama loss under explicit shard_map TP+SP equals the
     single-device computation."""
@@ -119,6 +121,7 @@ def test_sequence_parallel_shard_map_matches_gspmd():
             err_msg=jax.tree_util.keystr(path))
 
 
+@pytest.mark.slow
 def test_grad_accumulation_matches_full_batch():
     """grad_accum_steps=4 produces the same update as the full-batch step
     (mean-of-microbatch-means == full mean for equal microbatches)."""
@@ -154,6 +157,7 @@ def test_grad_accumulation_matches_full_batch():
                                    err_msg=jax.tree_util.keystr(p1))
 
 
+@pytest.mark.slow
 def test_lr_schedules():
     """Reference-style warmup schedules drive the optimizer via optax's
     callable learning_rate; training runs with a schedule."""

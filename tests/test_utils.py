@@ -3,6 +3,8 @@
 import json
 
 import numpy as np
+import pytest
+
 import jax
 import jax.numpy as jnp
 from flax.core import meta
@@ -34,6 +36,7 @@ def test_timeline_chrome_trace(tmp_path):
     assert all(e["ph"] == "X" and e["dur"] >= 0 for e in data["traceEvents"])
 
 
+@pytest.mark.slow
 def test_tensor_capture_and_replacement():
     from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
                                                       tiny_config)
