@@ -41,6 +41,8 @@ class BertConfig:
     # hidden dropout applies after each sublayer projection, pre-residual.
     attention_dropout: float = 0.0
     hidden_dropout: float = 0.0
+    # Pallas flash path (bidirectional, causal=False; d=64 lane-pads)
+    use_flash_attention: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     scan_layers: bool = True
@@ -81,9 +83,16 @@ class BertLayer(nn.Module):
             dropout_p = cfg.attention_dropout
             dropout_seed = jax.random.bits(self.make_rng("dropout"), (),
                                            jnp.uint32)
-        attn = attn_mod.sdpa_reference(q, k, v, causal=False,
-                                       dropout_p=dropout_p,
-                                       dropout_seed=dropout_seed)
+        if cfg.use_flash_attention:
+            from ..ops.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=False,
+                                   dropout_p=dropout_p,
+                                   dropout_seed=dropout_seed)
+        else:
+            attn = attn_mod.sdpa_reference(q, k, v, causal=False,
+                                           dropout_p=dropout_p,
+                                           dropout_seed=dropout_seed)
         attn = attn.reshape(b, s, n_local * hd)
         attn = pl.RowParallelLinear(
             features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,

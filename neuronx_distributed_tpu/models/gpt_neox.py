@@ -41,6 +41,10 @@ class GPTNeoXConfig:
     sequence_parallel: bool = False
     remat: bool = False
     scan_layers: bool = True
+    # Pallas flash path (any head_dim — non-128 widths lane-pad in the
+    # kernel dispatcher; the reference's NKI flash serves its whole zoo,
+    # kernels/flash_attn.py:162)
+    use_flash_attention: bool = False
     tp_size: Optional[int] = None
 
     @property
@@ -91,7 +95,12 @@ class NeoXAttention(nn.Module):
             k = jnp.concatenate([
                 attn_mod.apply_rotary(k[..., :rot], cos, sin, positions),
                 k[..., rot:]], axis=-1)
-        out = attn_mod.sdpa_reference(q, k, v, causal=True)
+        if cfg.use_flash_attention:
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            out = attn_mod.sdpa_reference(q, k, v, causal=True)
         out = out.reshape(b, s, n_local * hd)
         return pl.RowParallelLinear(
             features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
