@@ -1,17 +1,22 @@
-"""Mixtral (MoE) serving: cached prefill/decode with dropless experts and
-optional per-phase TP x EP meshes.
+"""MoE serving (Mixtral and DBRX): cached prefill/decode with dropless
+experts and optional per-phase TP x EP meshes.
 
-Analogue of the reference's ``examples/inference/mixtral`` runner. With
-``--phase-meshes``, context encoding runs under a wide-TP mesh view and
-token generation under a wide-EP one (reference CTE/TKG MoE process groups,
-``modules/moe/moe_process_group.py:12``).
+Analogue of the reference's ``examples/inference/mixtral`` and ``dbrx``
+runners. With ``--phase-meshes``, context encoding runs under a wide-TP
+mesh view and token generation under a wide-EP one (reference CTE/TKG MoE
+process groups, ``modules/moe/moe_process_group.py:12``). Token generation
+auto-enables the empty-expert sentinel under blockwise dispatch — a decode
+step reads only the experts its tokens hit (DBRX E=16 K=4 at batch 1:
+4/16 expert banks).
 
     python examples/inference/mixtral_serve.py --max-new 16
+    python examples/inference/mixtral_serve.py --model dbrx-tiny
     python examples/inference/mixtral_serve.py --phase-meshes \
         --cte-tp 2 --cte-ep 2 --tkg-tp 1 --tkg-ep 4
 """
 
 import argparse
+import dataclasses
 import time
 
 import jax
@@ -21,7 +26,7 @@ from flax.core import meta
 
 import neuronx_distributed_tpu as nxd
 from neuronx_distributed_tpu.inference.kv_cache import init_kv_cache
-from neuronx_distributed_tpu.models.mixtral import (MIXTRAL_8X7B,
+from neuronx_distributed_tpu.models.mixtral import (DBRX, MIXTRAL_8X7B,
                                                     MixtralForCausalLM,
                                                     mixtral_forward_with_cache,
                                                     tiny_moe_config)
@@ -29,7 +34,8 @@ from neuronx_distributed_tpu.models.mixtral import (MIXTRAL_8X7B,
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="tiny", choices=["tiny", "8x7b"])
+    ap.add_argument("--model", default="tiny",
+                    choices=["tiny", "8x7b", "dbrx-tiny", "dbrx"])
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--ep", type=int, default=1)
     ap.add_argument("--batch", type=int, default=2)
@@ -46,8 +52,18 @@ def main(argv=None):
 
     cfg = nxd.neuronx_distributed_config(tensor_parallel_size=args.tp,
                                          expert_parallel_size=args.ep)
-    mcfg = (tiny_moe_config(moe_dispatch="blockwise", moe_block_size=8)
-            if args.model == "tiny" else MIXTRAL_8X7B)
+    mcfg = {
+        "tiny": tiny_moe_config(moe_dispatch="blockwise", moe_block_size=8),
+        # DBRX routing width at tiny scale: 16 fine-grained experts, top-4
+        "dbrx-tiny": tiny_moe_config(num_experts=16, top_k=4,
+                                     moe_dispatch="blockwise",
+                                     moe_block_size=8),
+        # full presets serve with blockwise dispatch so decode takes the
+        # sentinel path (the preset default is capacity, which reads every
+        # expert's weights at every step)
+        "8x7b": dataclasses.replace(MIXTRAL_8X7B, moe_dispatch="blockwise"),
+        "dbrx": dataclasses.replace(DBRX, moe_dispatch="blockwise"),
+    }[args.model]
     model = MixtralForCausalLM(mcfg)
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, mcfg.vocab_size,
@@ -92,7 +108,8 @@ def main(argv=None):
     dt = time.perf_counter() - t0
     total = args.batch * args.max_new
     print(f"generated {total} tokens in {dt*1e3:.1f} ms "
-          f"({total/dt:,.0f} tok/s, phase_meshes={args.phase_meshes})")
+          f"({total/dt:,.0f} tok/s, E={mcfg.num_experts} K={mcfg.top_k}, "
+          f"phase_meshes={args.phase_meshes})")
     print("tokens:", np.asarray(toks).tolist())
 
 

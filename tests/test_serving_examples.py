@@ -51,3 +51,10 @@ def test_quantized_serve_smoke(capsys):
 def test_mixtral_serve_smoke(capsys):
     _run("mixtral_serve.py", ["--max-new", "4", "--prompt-len", "8"])
     assert "tok/s" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_dbrx_serve_smoke(capsys):
+    _run("mixtral_serve.py", ["--model", "dbrx-tiny", "--max-new", "4",
+                              "--prompt-len", "8"])
+    assert "E=16 K=4" in capsys.readouterr().out
