@@ -1,0 +1,81 @@
+"""Tokenize and pack text into the native loader's flat token stream.
+
+Completes the data path end to end: raw text -> HF tokenizer -> packed
+``(seqlen + 1)``-token rows -> flat uint16/uint32 ``.bin`` that
+``data/native_loader.TokenBatchLoader`` (csrc/data_loader.cpp) mmaps and
+prefetches off the GIL. The role of the reference's dataset preparation in
+its training examples (``examples/training/llama/.../get_dataset.py`` —
+tokenize, concatenate, chunk to seqlen blocks).
+
+    python -m neuronx_distributed_tpu.scripts.prepare_dataset \
+        --input corpus.txt --tokenizer hf-internal-testing/llama-tokenizer \
+        --seqlen 2048 --output tokens.bin
+
+``--input`` accepts a text file (one document per line) or ``-`` for
+stdin. Documents are concatenated with the tokenizer's EOS between them
+and chunked into non-overlapping ``seqlen + 1`` rows (the +1 provides the
+shifted-label target); the trailing remainder is dropped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def pack_tokens(token_iter, seqlen: int, dtype) -> "np.ndarray":
+    """Concatenate token id chunks and cut into [N, seqlen+1] rows."""
+    flat = np.concatenate([np.asarray(t, np.int64) for t in token_iter])
+    per = seqlen + 1
+    n = len(flat) // per
+    if n == 0:
+        raise ValueError(
+            f"corpus has {len(flat)} tokens, fewer than one row of "
+            f"seqlen+1 = {per}")
+    info = np.iinfo(dtype)
+    if flat.max(initial=0) > info.max:
+        raise ValueError(
+            f"token id {int(flat.max())} exceeds {np.dtype(dtype).name}; "
+            "use --dtype uint32")
+    return flat[:n * per].astype(dtype)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--input", required=True,
+                    help="text file (one document per line), or '-'")
+    ap.add_argument("--tokenizer", required=True,
+                    help="HF tokenizer name or local path")
+    ap.add_argument("--seqlen", type=int, default=2048)
+    ap.add_argument("--output", required=True, help="output .bin path")
+    ap.add_argument("--dtype", default="uint16",
+                    choices=["uint16", "uint32"])
+    args = ap.parse_args(argv)
+
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(args.tokenizer)
+    eos = [tok.eos_token_id] if tok.eos_token_id is not None else []
+
+    def token_chunks():
+        stream = (sys.stdin if args.input == "-"
+                  else open(args.input, encoding="utf-8"))
+        for line in stream:
+            line = line.strip()
+            if not line:
+                continue
+            yield tok.encode(line) + eos
+
+    packed = pack_tokens(token_chunks(), args.seqlen,
+                         np.dtype(args.dtype))
+    packed.tofile(args.output)
+    per = args.seqlen + 1
+    print(f"wrote {args.output}: {len(packed) // per} sequences of "
+          f"seqlen {args.seqlen} ({packed.nbytes / 1e6:.1f} MB, "
+          f"{args.dtype})")
+
+
+if __name__ == "__main__":
+    main()

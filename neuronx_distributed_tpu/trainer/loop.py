@@ -30,6 +30,8 @@ class Callback:
 
     def on_step_end(self, trainer: "Trainer", metrics: Dict) -> None: ...
 
+    def on_eval_end(self, trainer: "Trainer", metrics: Dict) -> None: ...
+
     def on_train_end(self, trainer: "Trainer") -> None: ...
 
 
@@ -89,8 +91,10 @@ class Trainer:
 
     def __init__(self, step_fn: Callable, state: Any,
                  callbacks: Optional[List[Callback]] = None,
-                 resume_path: Optional[str] = None):
+                 resume_path: Optional[str] = None,
+                 eval_fn: Optional[Callable] = None):
         self.step_fn = step_fn
+        self.eval_fn = eval_fn
         self.state = state
         self.callbacks = callbacks or []
         self.tokens_per_batch = 0
@@ -107,10 +111,27 @@ class Trainer:
             self.host_step = int(self.state.step)
             logger.info("resumed from step %d", self.host_step)
 
-    def fit(self, batches: Iterable, max_steps: Optional[int] = None):
+    def fit(self, batches: Iterable, max_steps: Optional[int] = None,
+            eval_batches: Optional[Iterable] = None,
+            eval_every: Optional[int] = None):
+        """Train; optionally evaluate every ``eval_every`` steps and once
+        at the end — the validation-loop role of the reference's Lightning
+        adapter. Eval metrics reach ``on_eval_end`` and the returned
+        metrics dict under ``eval_*`` keys (they are NOT visible to
+        ``on_step_end``, which fires before each eval)."""
+        if eval_batches is not None:
+            if self.eval_fn is None:
+                # fail in milliseconds, not after the whole training run
+                raise ValueError(
+                    "fit(eval_batches=...) requires eval_fn at "
+                    "construction")
+            # materialise once: a one-shot generator would silently yield
+            # zero batches on every eval after the first
+            eval_batches = list(eval_batches)
         for cb in self.callbacks:
             cb.on_train_start(self)
         metrics: Dict = {}
+        evaluated_at = -1
         for batch in batches:
             if max_steps is not None and self.host_step >= max_steps:
                 break
@@ -120,6 +141,35 @@ class Trainer:
             self.host_step += 1
             for cb in self.callbacks:
                 cb.on_step_end(self, metrics)
+            if (eval_batches is not None and eval_every
+                    and self.host_step % eval_every == 0):
+                metrics.update(self.evaluate(eval_batches))
+                evaluated_at = self.host_step
+        if eval_batches is not None and evaluated_at != self.host_step:
+            metrics.update(self.evaluate(eval_batches))
         for cb in self.callbacks:
             cb.on_train_end(self)
         return self.state, metrics
+
+    def evaluate(self, batches: Iterable) -> Dict:
+        """Mean loss over ``batches`` with NO gradient/optimizer work.
+
+        Uses ``eval_fn(params, batch) -> scalar loss`` when provided;
+        otherwise derives it is an error (the step_fn mutates state). The
+        model runs without a dropout rng, so dropout-gated modules are
+        deterministic.
+        """
+        if self.eval_fn is None:
+            raise ValueError(
+                "Trainer.evaluate requires eval_fn (params, batch) -> "
+                "loss; pass it at construction "
+                "(e.g. lambda p, b: pm.module.apply(p, b['input_ids'], "
+                "b['labels'], method='loss'))")
+        total, n = 0.0, 0
+        for batch in batches:
+            total += float(self.eval_fn(self.state.params, batch))
+            n += 1
+        metrics = {"eval_loss": total / max(n, 1), "eval_batches": n}
+        for cb in self.callbacks:
+            cb.on_eval_end(self, metrics)
+        return metrics

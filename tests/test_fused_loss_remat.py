@@ -248,3 +248,34 @@ def test_direct_kernel_saves_named_residuals():
             print_saved_residuals(ck, q)
         has_lse = "f32[1,2,64]" in buf.getvalue()
         assert has_lse == expect, (pol, buf.getvalue())
+
+
+def test_loss_chunk_reduces_compiled_peak_memory():
+    """The memory claim itself, pinned via XLA's compiled memory analysis:
+    with loss_chunk the [B, S, V] logits (+fp32 CE intermediates) never
+    materialise, so the differentiated step's temp allocation drops
+    substantially at a vocab-dominated config."""
+    ps.initialize_model_parallel(tensor_model_parallel_size=1)
+    kw = dict(dtype=jnp.float32, param_dtype=jnp.float32,
+              vocab_size=8192, hidden_size=64, intermediate_size=128,
+              num_layers=2, max_seq_len=256)
+    base = tiny_config(**kw)
+    fused = tiny_config(**kw, loss_chunk=32)
+    ids, labels = _batch(base, b=4, s=256)
+    from flax.core import meta
+
+    params = meta.unbox(LlamaForCausalLM(base).init(jax.random.key(1), ids))
+
+    def temps(cfg):
+        model = LlamaForCausalLM(cfg)
+        f = jax.jit(jax.value_and_grad(
+            lambda p: model.apply(p, ids, labels=labels)))
+        ma = f.lower(params).compile().memory_analysis()
+        return ma.temp_size_in_bytes
+
+    t_classic = temps(base)
+    t_fused = temps(fused)
+    # full-logits path holds multiple fp32 [4, 256, 8192] buffers (33 MB
+    # each); the chunked path holds [4, 32, 8192] slices. Require a >=40%
+    # drop — far above noise, well below the theoretical ratio
+    assert t_fused < 0.6 * t_classic, (t_fused, t_classic)
