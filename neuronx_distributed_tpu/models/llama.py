@@ -91,7 +91,9 @@ class LlamaConfig:
     # attention-probability dropout (training path only; active iff a
     # "dropout" rng is supplied to apply()). In-kernel on the flash path
     # via counter-based masks (reference seed plumbing:
-    # kernels/flash_attn.py:30,54); not applied under ring/Ulysses CP.
+    # kernels/flash_attn.py:30,54). Under CP: ring uses global-coordinate
+    # masks (bit-identical to the cp=1 model at the same TP degree),
+    # Ulysses per-rank deterministic masks.
     attention_dropout: float = 0.0
     tp_size: Optional[int] = None
     # LoRA adapters (see neuronx_distributed_tpu.lora); None = disabled
@@ -239,28 +241,27 @@ class LlamaAttention(nn.Module):
                 dropout_seed = jax.random.bits(self.make_rng("dropout"), (),
                                                jnp.uint32)
             cp = comm._axis_size(ps.CP_AXIS)
-            if cp is not None and cp > 1 and dropout_p > 0.0:
-                # the ring/Ulysses kernels carry no dropout plumbing; a
-                # silent skip would let the user believe regularization is
-                # active (cf. the loss_chunk validation in __post_init__)
-                raise ValueError(
-                    "attention_dropout > 0 is not supported under context "
-                    "parallelism (ring/Ulysses); drop the dropout rng or "
-                    "set attention_dropout=0 when cp > 1")
             if cp is not None and cp > 1 and cfg.cp_attn_impl == "ulysses":
                 # Ulysses moves the raw GQA kv heads through its
-                # all-to-alls and expands after the reshard
+                # all-to-alls and expands after the reshard; dropout masks
+                # there are per-rank-deterministic (see ulysses_attention)
                 from ..ops.ulysses import ulysses_attention
 
-                out = ulysses_attention(q, k, v, causal=True)
+                out = ulysses_attention(q, k, v, causal=True,
+                                        dropout_p=dropout_p,
+                                        dropout_seed=dropout_seed)
             elif cp is not None and cp > 1:
                 # context parallel: KV rotates around the cp ring
-                # (reference kernels/ring_attention_kernel.py)
+                # (reference kernels/ring_attention_kernel.py); dropout
+                # masks use GLOBAL seq coordinates, bit-identical to the
+                # cp=1 model at the same TP degree
                 from ..ops.ring_attention import ring_attention
 
                 k = attn_mod.repeat_kv(k, n_q_local // n_kv_local)
                 v = attn_mod.repeat_kv(v, n_q_local // n_kv_local)
-                out = ring_attention(q, k, v, causal=True)
+                out = ring_attention(q, k, v, causal=True,
+                                     dropout_p=dropout_p,
+                                     dropout_seed=dropout_seed)
             elif cfg.use_flash_attention:
                 from ..ops.flash_attention import flash_attention
 

@@ -106,10 +106,9 @@ def sdpa_reference(q: jax.Array, k: jax.Array, v: jax.Array,
         scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     if dropout_p > 0.0:
-        from ..ops.flash_attention import dropout_keep_mask
+        from ..ops.flash_attention import dropout_keep_mask, flat_bh
 
-        bh = (jnp.arange(b)[:, None] * n
-              + jnp.arange(n)[None, :])[..., None, None]
+        bh = flat_bh(b, n)
         keep = dropout_keep_mask(
             jnp.asarray(dropout_seed, jnp.uint32), bh,
             jnp.arange(sq)[None, None, :, None],

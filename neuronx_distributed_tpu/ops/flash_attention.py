@@ -59,6 +59,18 @@ def dropout_keep_mask(seed, head_idx, q_pos, k_pos, sk: int, p: float):
     return x >= jnp.uint32(round(p * 0xFFFFFFFF))
 
 
+def flat_bh(b: int, n: int) -> jax.Array:
+    """``[B, N, 1, 1]`` flat batch*head coordinate for dropout masks.
+
+    Every mask site (sdpa_reference, the XLA flash scan, ring attention)
+    must use this exact batch-major layout — cross-implementation mask
+    parity (and ring's bit-consistency with the dense model) depends on
+    all of them agreeing.
+    """
+    return (jnp.arange(b)[:, None] * n
+            + jnp.arange(n)[None, :])[..., None, None]
+
+
 def _block_attention(q, k_blk, v_blk, q_pos, k_pos_start, block_k, causal,
                      scale):
     """Scores and partial PV for one KV block. q: [B,N,Sq,D],
@@ -88,8 +100,7 @@ def _flash_xla_impl(q, k, v, causal, block_k, scale, dropout_p,
     vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
     q_pos = jnp.arange(sq)
     if dropout_p > 0.0:
-        bh = (jnp.arange(b)[:, None] * n
-              + jnp.arange(n)[None, :])[..., None, None]  # [B,N,1,1]
+        bh = flat_bh(b, n)
         seed = jnp.asarray(dropout_seed, jnp.uint32)
 
     kb = kt.reshape(b, n, nblocks, block_k, d)
@@ -352,8 +363,7 @@ def _flash_bwd_from_lse(q, k, v, out, lse, g, causal, block_k, scale,
     delta = jnp.sum(gt * ot, axis=-1)                   # [B,N,Sq]
     q_pos = jnp.arange(sq)
     if dropout_p > 0.0:
-        bh_idx = (jnp.arange(b)[:, None] * n
-                  + jnp.arange(n)[None, :])[..., None, None]
+        bh_idx = flat_bh(b, n)
         seed_u32 = jnp.asarray(dropout_seed, jnp.uint32)
         inv_keep = 1.0 / (1.0 - dropout_p)
 

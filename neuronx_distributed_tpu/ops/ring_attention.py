@@ -36,12 +36,22 @@ from ..parallel import mesh as ps
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis: str = ps.CP_AXIS,
                    causal: bool = True,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   dropout_p: float = 0.0,
+                   dropout_seed: Optional[jax.Array] = None) -> jax.Array:
     """Ring attention over the cp axis.
 
     ``q/k/v: [B, S_local, N, D]`` — this rank's sequence slice, kv already
     GQA-expanded. Must be called with ``axis`` bound (inside shard_map);
     falls back to plain attention when cp is absent/1.
+
+    ``dropout_p``: attention dropout with the shared counter-based hash
+    over GLOBAL (q, k) sequence coordinates — every cp rank regenerates
+    exactly the mask the non-CP model draws for its slice, so adding cp
+    sharding is bit-consistent with the same model at cp=1. (Head indices
+    in the hash are tp-LOCAL, so the masks match at equal TP degree;
+    changing tp changes the draw, as in the reference's per-rank seed
+    plumbing, ``kernels/ring_attention_kernel.py``.)
 
     Returns ``[B, S_local, N, D]``.
     """
@@ -49,7 +59,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if cp is None or cp == 1:
         from ..modules.attention import sdpa_reference
 
-        return sdpa_reference(q, k, v, causal=causal, scale=scale)
+        return sdpa_reference(q, k, v, causal=causal, scale=scale,
+                              dropout_p=dropout_p,
+                              dropout_seed=dropout_seed)
 
     b, s_local, n, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -58,6 +70,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B,N,Sq,D]
     ring_perm = [(i, (i + 1) % cp) for i in range(cp)]
+    if dropout_p > 0.0:
+        from .flash_attention import dropout_keep_mask, flat_bh
+
+        if dropout_seed is None:
+            raise ValueError("dropout_p > 0 requires dropout_seed")
+        seed_u32 = jnp.asarray(dropout_seed, jnp.uint32)
+        s_global = cp * s_local
+        bh = flat_bh(b, n)
 
     def accumulate(carry, k_cur, v_cur, i):
         m_prev, l_prev, acc = carry
@@ -66,8 +86,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         vt = jnp.swapaxes(v_cur, 1, 2).astype(jnp.float32)
         s = jnp.einsum("bnqd,bnkd->bnqk", qt, kt,
                        preferred_element_type=jnp.float32) * scale
+        kpos = src * s_local + jnp.arange(s_local)
         if causal:
-            kpos = src * s_local + jnp.arange(s_local)
             mask = qpos[:, None] >= kpos[None, :]
             s = jnp.where(mask[None, None], s, -jnp.inf)
         m_cur = jnp.max(s, axis=-1)
@@ -76,8 +96,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe[..., None]), 0.0)
         corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
         l_new = l_prev * corr + jnp.sum(p, axis=-1)
+        if dropout_p > 0.0:
+            keep = dropout_keep_mask(
+                seed_u32, bh, qpos[None, None, :, None],
+                kpos[None, None, None, :], s_global, dropout_p)
+            p_acc = jnp.where(keep, p, 0.0)
+        else:
+            p_acc = p
         acc = acc * corr[..., None] + jnp.einsum(
-            "bnqk,bnkd->bnqd", p, vt, preferred_element_type=jnp.float32)
+            "bnqk,bnkd->bnqd", p_acc, vt,
+            preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
     def step(carry, i):
@@ -96,6 +124,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         step, (m0, l0, acc0, k, v), jnp.arange(cp - 1))
     m, l, acc = accumulate((m, l, acc), k_last, v_last, cp - 1)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
+    if dropout_p > 0.0:
+        out = out * (1.0 / (1.0 - dropout_p))
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
@@ -121,7 +151,7 @@ def _chunk_fwd(q, k_c, v_c, rel, block_q, block_k, scale, interpret):
     (r - src): 0 -> diagonal (causal), >0 -> fully attended, <0 -> skip."""
     from .flash_attention import _flash_pallas_fwd
 
-    zseed = jnp.zeros((1,), jnp.uint32)  # no dropout under CP
+    zseed = jnp.zeros((1,), jnp.uint32)  # Pallas ring has no dropout path
 
     def diag(q, k_c, v_c):
         return _flash_pallas_fwd(q, k_c, v_c, zseed, True, block_q, block_k,
@@ -146,7 +176,7 @@ def _chunk_bwd(q, k_c, v_c, out, lse, g, rel, block_q, block_k, scale,
                interpret):
     from .flash_attention import _flash_pallas_bwd
 
-    zseed = jnp.zeros((1,), jnp.uint32)  # no dropout under CP
+    zseed = jnp.zeros((1,), jnp.uint32)  # Pallas ring has no dropout path
 
     def diag(args):
         return _flash_pallas_bwd(*args, zseed, True, block_q, block_k, scale,
@@ -251,8 +281,10 @@ def ring_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
                           scale: Optional[float] = None,
                           interpret: Optional[bool] = None) -> jax.Array:
     """Ring attention with the Pallas flash kernels fused into each ring
-    step. Same contract as :func:`ring_attention` (causal only — the
-    cross-chunk skip logic assumes causal). Falls back to
+    step. Same contract as :func:`ring_attention` except: causal only (the
+    cross-chunk skip logic assumes causal) and no dropout plumbing — use
+    :func:`ring_attention` when ``dropout_p > 0`` (passing dropout kwargs
+    here is a TypeError, never a silent skip). Falls back to
     :func:`ring_attention` when cp is absent or shapes don't tile."""
     cp = comm._axis_size(axis)
     b, s_local, n, d = q.shape

@@ -21,6 +21,7 @@ import math
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 
 from ..parallel import comm, mappings
 from ..parallel import mesh as ps
@@ -28,7 +29,9 @@ from ..parallel import mesh as ps
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis: str = ps.CP_AXIS, causal: bool = True,
-                      scale: Optional[float] = None) -> jax.Array:
+                      scale: Optional[float] = None,
+                      dropout_p: float = 0.0,
+                      dropout_seed: Optional[jax.Array] = None) -> jax.Array:
     """All-to-all context-parallel attention.
 
     ``q: [B, S_local, N, D]``; ``k/v: [B, S_local, KV, D]`` may carry the
@@ -38,6 +41,14 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Must be called with ``axis`` bound; falls back to plain attention when
     cp is absent/1. Differentiable (the all-to-alls are the custom_vjp
     expert-region pair, whose transpose is the reverse all-to-all).
+
+    ``dropout_p``: attention dropout on the post-reshard full-sequence
+    view. The cp rank index is folded into the seed so head groups on
+    different ranks draw independent masks; the result is deterministic
+    and fwd/bwd-consistent but not bit-identical to the unsharded
+    model's (ring_attention gives bit-exact masks; the torch reference's
+    per-rank RNG streams likewise decorrelate ranks without matching the
+    single-device draw).
     """
     from ..modules.attention import repeat_kv
 
@@ -48,7 +59,9 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
         rep = n // k.shape[2]
         return sdpa_reference(q, repeat_kv(k, rep), repeat_kv(v, rep),
-                              causal=causal, scale=scale)
+                              causal=causal, scale=scale,
+                              dropout_p=dropout_p,
+                              dropout_seed=dropout_seed)
     if n % cp != 0:
         raise ValueError(
             f"ulysses attention requires heads {n} divisible by cp {cp}")
@@ -66,6 +79,16 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return mappings.exit_expert_parallel_region(
             x, axis, split_dim=1, concat_dim=2)
 
+    if dropout_p > 0.0:
+        # flash_attention hashes LOCAL head indices (0..n/cp-1), identical
+        # on every rank — without a per-rank seed offset the same mask
+        # would repeat across the cp head groups
+        if dropout_seed is None:
+            raise ValueError("dropout_p > 0 requires dropout_seed")
+        rank = jax.lax.axis_index(axis).astype(jnp.uint32)
+        dropout_seed = (jnp.asarray(dropout_seed, jnp.uint32)
+                        + rank * jnp.uint32(0x9E3779B1))
+
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     if kh.shape[2] != qh.shape[2]:
         # expand after the reshard: repeat_kv is adjacent (kv head j
@@ -76,5 +99,6 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     from .flash_attention import flash_attention
 
     scale_ = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    out = flash_attention(qh, kh, vh, causal=causal, scale=scale_)
+    out = flash_attention(qh, kh, vh, causal=causal, scale=scale_,
+                          dropout_p=dropout_p, dropout_seed=dropout_seed)
     return heads_to_seq(out)

@@ -244,3 +244,101 @@ def test_llama_cp_ulysses_training_matches_dense():
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(flat_ref[path]), rtol=5e-3,
             atol=3e-5, err_msg=jax.tree_util.keystr(path))
+
+
+def test_ring_attention_dropout_matches_dense():
+    """CP + dropout (lifting the r5 restriction): the ring regenerates
+    masks from GLOBAL (head, q, k) coordinates, so cp-sharded outputs AND
+    grads are bit-consistent with the unsharded sdpa-dropout model at the
+    same seed."""
+    mesh = ps.initialize_model_parallel(context_parallel_size=4)
+    b, s, n, d = 2, 32, 4, 8
+    ks = jax.random.split(jax.random.key(5), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, n, d)) for kk in ks)
+    seed = jnp.uint32(77)
+    ref = sdpa_reference(q, k, v, causal=True, dropout_p=0.25,
+                         dropout_seed=seed)
+    out = jax.jit(ps.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, dropout_p=0.25,
+                                       dropout_seed=seed),
+        mesh, in_specs=(P(None, "cp", None, None),) * 3,
+        out_specs=P(None, "cp", None, None)))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    dense_g = jax.grad(lambda q, k, v: jnp.sum(
+        sdpa_reference(q, k, v, causal=True, dropout_p=0.25,
+                       dropout_seed=seed) ** 2), argnums=(0, 1, 2))(
+            q, k, v)
+
+    def inner(q, k, v):
+        return jax.grad(lambda q, k, v: jax.lax.pmean(jnp.sum(
+            ring_attention(q, k, v, dropout_p=0.25, dropout_seed=seed)
+            ** 2), "cp"), argnums=(0, 1, 2))(q, k, v)
+
+    g = jax.jit(ps.shard_map(
+        inner, mesh, in_specs=(P(None, "cp", None, None),) * 3,
+        out_specs=(P(None, "cp", None, None),) * 3))(q, k, v)
+    for a, r in zip(g, dense_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_dropout_deterministic_and_active():
+    """Ulysses dropout: per-rank-deterministic masks — same seed same
+    output, different seed different, p=0 equals no-dropout."""
+    from neuronx_distributed_tpu.ops.ulysses import ulysses_attention
+
+    mesh = ps.initialize_model_parallel(context_parallel_size=4)
+    b, s, n, d = 1, 32, 4, 8
+    ks = jax.random.split(jax.random.key(6), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, n, d)) for kk in ks)
+
+    def run(p, seed):
+        return jax.jit(ps.shard_map(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, dropout_p=p,
+                dropout_seed=None if seed is None else jnp.uint32(seed)),
+            mesh, in_specs=(P(None, "cp", None, None),) * 3,
+            out_specs=P(None, "cp", None, None)))(q, k, v)
+
+    base = run(0.0, None)
+    a = run(0.3, 5)
+    b_ = run(0.3, 5)
+    c = run(0.3, 6)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    assert not np.array_equal(np.asarray(a), np.asarray(base))
+
+
+def test_ulysses_dropout_decorrelated_across_ranks():
+    """With n == cp every rank holds one head at LOCAL index 0; the rank
+    index folded into the seed must keep the masks independent. Identical
+    per-head inputs would otherwise yield identical per-head outputs."""
+    from neuronx_distributed_tpu.ops.ulysses import ulysses_attention
+
+    mesh = ps.initialize_model_parallel(context_parallel_size=4)
+    b, s, n, d = 1, 32, 4, 8
+    ks = jax.random.split(jax.random.key(7), 3)
+    # one head's worth of data, tiled across all 4 heads
+    q, k, v = (jnp.tile(jax.random.normal(kk, (b, s, 1, d)), (1, 1, n, 1))
+               for kk in ks)
+
+    def run(p):
+        return jax.jit(ps.shard_map(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, dropout_p=p,
+                dropout_seed=None if p == 0.0 else jnp.uint32(11)),
+            mesh, in_specs=(P(None, "cp", None, None),) * 3,
+            out_specs=P(None, "cp", None, None)))(q, k, v)
+
+    base = np.asarray(run(0.0))
+    out = np.asarray(run(0.3))
+    # without dropout all heads agree (sanity that inputs are tiled)
+    for h in range(1, n):
+        np.testing.assert_allclose(base[:, :, h], base[:, :, 0],
+                                   rtol=1e-6, atol=1e-6)
+    # with dropout, per-rank seeds must decorrelate the head masks
+    distinct = sum(not np.array_equal(out[:, :, h], out[:, :, 0])
+                   for h in range(1, n))
+    assert distinct == n - 1, "dropout masks repeat across cp ranks"
