@@ -85,7 +85,8 @@ class LlamaConfig:
     # parallel_state.py:1473 + trace/spmd.py:74). Long-context serving:
     # cache memory and decode attention FLOPs split over the decode group.
     use_flash_decoding: bool = False
-    # context-parallel attention: "ring" (ppermute KV rotation) or
+    # context-parallel attention: "ring" (ppermute KV rotation),
+    # "ring_pallas" (ring with the flash kernel fused into each step), or
     # "ulysses" (all-to-all seq<->head resharding; needs heads % cp == 0)
     cp_attn_impl: str = "ring"
     # attention-probability dropout (training path only; active iff a
@@ -104,10 +105,10 @@ class LlamaConfig:
     loss_chunk: Optional[int] = None
 
     def __post_init__(self) -> None:
-        if self.cp_attn_impl not in ("ring", "ulysses"):
+        if self.cp_attn_impl not in ("ring", "ring_pallas", "ulysses"):
             raise ValueError(
-                f"cp_attn_impl must be 'ring' or 'ulysses', got "
-                f"{self.cp_attn_impl!r}")
+                f"cp_attn_impl must be 'ring', 'ring_pallas' or "
+                f"'ulysses', got {self.cp_attn_impl!r}")
         validate_remat_policy(self.remat_policy)
         if self.loss_chunk is not None:
             if self.loss_chunk <= 0:
@@ -254,14 +255,22 @@ class LlamaAttention(nn.Module):
                 # context parallel: KV rotates around the cp ring
                 # (reference kernels/ring_attention_kernel.py); dropout
                 # masks use GLOBAL seq coordinates, bit-identical to the
-                # cp=1 model at the same TP degree
-                from ..ops.ring_attention import ring_attention
+                # cp=1 model at the same TP degree ("ring"); "ring_pallas"
+                # fuses the flash kernel into each ring step and draws
+                # per-(rank, chunk) in-kernel masks instead
+                from ..ops.ring_attention import (ring_attention,
+                                                  ring_attention_pallas)
 
                 k = attn_mod.repeat_kv(k, n_q_local // n_kv_local)
                 v = attn_mod.repeat_kv(v, n_q_local // n_kv_local)
-                out = ring_attention(q, k, v, causal=True,
-                                     dropout_p=dropout_p,
-                                     dropout_seed=dropout_seed)
+                if cfg.cp_attn_impl == "ring_pallas":
+                    out = ring_attention_pallas(q, k, v,
+                                                dropout_p=dropout_p,
+                                                dropout_seed=dropout_seed)
+                else:
+                    out = ring_attention(q, k, v, causal=True,
+                                         dropout_p=dropout_p,
+                                         dropout_seed=dropout_seed)
             elif cfg.use_flash_attention:
                 from ..ops.flash_attention import flash_attention
 

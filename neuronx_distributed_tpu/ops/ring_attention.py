@@ -146,20 +146,21 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # ranks is safe), which skips the masked chunks' compute entirely.
 # ---------------------------------------------------------------------------
 
-def _chunk_fwd(q, k_c, v_c, rel, block_q, block_k, scale, interpret):
+def _chunk_fwd(q, k_c, v_c, rel, seed, block_q, block_k, scale, interpret,
+               dropout_p):
     """(out, lse) of q against one visiting chunk. rel = sign of
-    (r - src): 0 -> diagonal (causal), >0 -> fully attended, <0 -> skip."""
+    (r - src): 0 -> diagonal (causal), >0 -> fully attended, <0 -> skip.
+    ``seed``: (1,) uint32, already folded per (rank, src) pair so every
+    chunk draws an independent mask and the backward regenerates it."""
     from .flash_attention import _flash_pallas_fwd
 
-    zseed = jnp.zeros((1,), jnp.uint32)  # Pallas ring has no dropout path
-
     def diag(q, k_c, v_c):
-        return _flash_pallas_fwd(q, k_c, v_c, zseed, True, block_q, block_k,
-                                 scale, interpret)
+        return _flash_pallas_fwd(q, k_c, v_c, seed, True, block_q, block_k,
+                                 scale, interpret, dropout_p=dropout_p)
 
     def full(q, k_c, v_c):
-        return _flash_pallas_fwd(q, k_c, v_c, zseed, False, block_q, block_k,
-                                 scale, interpret)
+        return _flash_pallas_fwd(q, k_c, v_c, seed, False, block_q, block_k,
+                                 scale, interpret, dropout_p=dropout_p)
 
     def skip(q, k_c, v_c):
         b, s, n, d = q.shape
@@ -172,19 +173,17 @@ def _chunk_fwd(q, k_c, v_c, rel, block_q, block_k, scale, interpret):
                     q, k_c, v_c)
 
 
-def _chunk_bwd(q, k_c, v_c, out, lse, g, rel, block_q, block_k, scale,
-               interpret):
+def _chunk_bwd(q, k_c, v_c, out, lse, g, rel, seed, block_q, block_k, scale,
+               interpret, dropout_p):
     from .flash_attention import _flash_pallas_bwd
 
-    zseed = jnp.zeros((1,), jnp.uint32)  # Pallas ring has no dropout path
-
     def diag(args):
-        return _flash_pallas_bwd(*args, zseed, True, block_q, block_k, scale,
-                                 interpret)
+        return _flash_pallas_bwd(*args, seed, True, block_q, block_k, scale,
+                                 interpret, dropout_p=dropout_p)
 
     def full(args):
-        return _flash_pallas_bwd(*args, zseed, False, block_q, block_k,
-                                 scale, interpret)
+        return _flash_pallas_bwd(*args, seed, False, block_q, block_k,
+                                 scale, interpret, dropout_p=dropout_p)
 
     def skip(args):
         q, k_c, v_c, _, _, _ = args
@@ -195,15 +194,24 @@ def _chunk_bwd(q, k_c, v_c, out, lse, g, rel, block_q, block_k, scale,
                     lambda a: lax.cond(rel > 0, full, skip, a), args)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_pallas(q, k, v, axis, block_q, block_k, scale, interpret):
-    out, _ = _ring_pallas_fwd_pass(q, k, v, axis, block_q, block_k, scale,
-                                   interpret)
+def _pair_seed(seed, r, src, cp):
+    """Fold the (query-rank, chunk-home-rank) pair into the base seed so
+    each of the cp^2 chunk visits draws an independent mask; fwd and bwd
+    recompute the identical fold from (r, src), so masks regenerate."""
+    pair = (r.astype(jnp.uint32) * jnp.uint32(cp) + src.astype(jnp.uint32))
+    return seed + pair * jnp.uint32(0x9E3779B1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _ring_pallas(q, k, v, seed, axis, block_q, block_k, scale, interpret,
+                 dropout_p):
+    out, _ = _ring_pallas_fwd_pass(q, k, v, seed, axis, block_q, block_k,
+                                   scale, interpret, dropout_p)
     return out
 
 
-def _ring_pallas_fwd_pass(q, k, v, axis, block_q, block_k, scale,
-                          interpret):
+def _ring_pallas_fwd_pass(q, k, v, seed, axis, block_q, block_k, scale,
+                          interpret, dropout_p):
     cp = comm._axis_size(axis)
     b, s_local, n, d = q.shape
     r = lax.axis_index(axis)
@@ -213,8 +221,9 @@ def _ring_pallas_fwd_pass(q, k, v, axis, block_q, block_k, scale,
         o_run, lse_run, k_cur, v_cur = carry
         src = (r - i) % cp
         rel = r - src  # 0 diag; >0 earlier rank (attend); <0 later (skip)
-        o_i, lse_i = _chunk_fwd(q, k_cur, v_cur, rel, block_q, block_k,
-                                scale, interpret)
+        o_i, lse_i = _chunk_fwd(q, k_cur, v_cur, rel,
+                                _pair_seed(seed, r, src, cp), block_q,
+                                block_k, scale, interpret, dropout_p)
         o_i = jnp.swapaxes(o_i, 1, 2).astype(jnp.float32)  # [B,N,S,D]
         m = jnp.maximum(lse_run, lse_i)
         m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
@@ -235,14 +244,18 @@ def _ring_pallas_fwd_pass(q, k, v, axis, block_q, block_k, scale,
     return jnp.swapaxes(o, 1, 2).astype(q.dtype), lse
 
 
-def _ring_pallas_vjp_fwd(q, k, v, axis, block_q, block_k, scale, interpret):
-    out, lse = _ring_pallas_fwd_pass(q, k, v, axis, block_q, block_k, scale,
-                                     interpret)
-    return out, (q, k, v, out, lse)
+def _ring_pallas_vjp_fwd(q, k, v, seed, axis, block_q, block_k, scale,
+                         interpret, dropout_p):
+    out, lse = _ring_pallas_fwd_pass(q, k, v, seed, axis, block_q, block_k,
+                                     scale, interpret, dropout_p)
+    return out, (q, k, v, seed, out, lse)
 
 
-def _ring_pallas_vjp_bwd(axis, block_q, block_k, scale, interpret, res, g):
-    q, k, v, out, lse = res
+def _ring_pallas_vjp_bwd(axis, block_q, block_k, scale, interpret, dropout_p,
+                         res, g):
+    import numpy as np
+
+    q, k, v, seed, out, lse = res
     cp = comm._axis_size(axis)
     r = lax.axis_index(axis)
     ring_perm = [(i, (i + 1) % cp) for i in range(cp)]
@@ -252,7 +265,9 @@ def _ring_pallas_vjp_bwd(axis, block_q, block_k, scale, interpret, res, g):
         src = (r - i) % cp
         rel = r - src
         dq_i, dk_i, dv_i = _chunk_bwd(q, k_cur, v_cur, out, lse, g, rel,
-                                      block_q, block_k, scale, interpret)
+                                      _pair_seed(seed, r, src, cp),
+                                      block_q, block_k, scale, interpret,
+                                      dropout_p)
         dq_acc = dq_acc + dq_i.astype(jnp.float32)
         dk_buf = dk_buf + dk_i.astype(jnp.float32)
         dv_buf = dv_buf + dv_i.astype(jnp.float32)
@@ -269,7 +284,8 @@ def _ring_pallas_vjp_bwd(axis, block_q, block_k, scale, interpret, res, g):
     (dq, _, _, dk, dv), _ = lax.scan(
         step, (dq0, k, v, dkv0, jnp.zeros(v.shape, jnp.float32)),
         jnp.arange(cp))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            np.zeros(seed.shape, jax.dtypes.float0))
 
 
 _ring_pallas.defvjp(_ring_pallas_vjp_fwd, _ring_pallas_vjp_bwd)
@@ -279,13 +295,19 @@ def ring_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
                           axis: str = ps.CP_AXIS,
                           block_q: int = 128, block_k: int = 128,
                           scale: Optional[float] = None,
-                          interpret: Optional[bool] = None) -> jax.Array:
+                          interpret: Optional[bool] = None,
+                          dropout_p: float = 0.0,
+                          dropout_seed: Optional[jax.Array] = None,
+                          ) -> jax.Array:
     """Ring attention with the Pallas flash kernels fused into each ring
     step. Same contract as :func:`ring_attention` except: causal only (the
-    cross-chunk skip logic assumes causal) and no dropout plumbing — use
-    :func:`ring_attention` when ``dropout_p > 0`` (passing dropout kwargs
-    here is a TypeError, never a silent skip). Falls back to
-    :func:`ring_attention` when cp is absent or shapes don't tile."""
+    cross-chunk skip logic assumes causal), and dropout masks are the
+    in-kernel per-chunk draw — deterministic and fwd/bwd-consistent (the
+    (rank, chunk-home) pair is folded into the seed) but a DIFFERENT draw
+    from :func:`ring_attention`'s global-coordinate masks, which are the
+    ones bit-consistent with the cp=1 model. Falls back to
+    :func:`ring_attention` (forwarding the dropout arguments) when cp is
+    absent or shapes don't tile."""
     cp = comm._axis_size(axis)
     b, s_local, n, d = q.shape
     bq, bk = min(block_q, s_local), min(block_k, s_local)
@@ -297,6 +319,13 @@ def ring_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
     tiles = (s_local % bq == 0 and s_local % bk == 0 and d % 128 == 0
              and bq % align == 0 and bk % align == 0)
     if cp is None or cp == 1 or not tiles:
-        return ring_attention(q, k, v, axis=axis, causal=True, scale=scale)
+        return ring_attention(q, k, v, axis=axis, causal=True, scale=scale,
+                              dropout_p=dropout_p,
+                              dropout_seed=dropout_seed)
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
+    seed = (jnp.asarray(dropout_seed, jnp.uint32).reshape((1,))
+            if dropout_p > 0.0 else jnp.zeros((1,), jnp.uint32))
     scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
-    return _ring_pallas(q, k, v, axis, bq, bk, scale_, interpret)
+    return _ring_pallas(q, k, v, seed, axis, bq, bk, scale_, interpret,
+                        dropout_p)

@@ -342,3 +342,104 @@ def test_ulysses_dropout_decorrelated_across_ranks():
     distinct = sum(not np.array_equal(out[:, :, h], out[:, :, 0])
                    for h in range(1, n))
     assert distinct == n - 1, "dropout masks repeat across cp ranks"
+
+
+def test_ring_attention_pallas_dropout_matches_masked_dense():
+    """Pallas ring dropout (interpret mode): fwd and grads vs a dense
+    reference applying the identical per-(rank, chunk) in-kernel mask draw
+    — pins that the backward ring regenerates the forward's masks."""
+    from neuronx_distributed_tpu.ops.flash_attention import (
+        dropout_keep_mask, flat_bh)
+    from neuronx_distributed_tpu.ops.ring_attention import (
+        ring_attention_pallas)
+
+    cp, p = 4, 0.25
+    mesh = ps.initialize_model_parallel(context_parallel_size=cp)
+    b, s, n, d = 1, 128, 2, 128  # s_local = 32, tiles with 8-aligned blocks
+    s_local = s // cp
+    ks = jax.random.split(jax.random.key(8), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, n, d)) for kk in ks)
+    seed = jnp.uint32(21)
+
+    def dense_masked(q, k, v):
+        scale = 1.0 / np.sqrt(d)
+        scores = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        causal = (jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+                  )[None, None]
+        probs = jax.nn.softmax(jnp.where(causal, scores, -1e30), axis=-1)
+        # the kernel hashes chunk-LOCAL coords with the (r, src)-folded seed
+        bh = flat_bh(b, n)
+        keep = jnp.zeros((b, n, s, s), bool)
+        for r in range(cp):
+            for src in range(r + 1):
+                pair_seed = (seed + jnp.uint32(
+                    ((r * cp + src) * 0x9E3779B1) % (1 << 32)))
+                blk = dropout_keep_mask(
+                    pair_seed, bh,
+                    jnp.arange(s_local)[None, None, :, None],
+                    jnp.arange(s_local)[None, None, None, :], s_local, p)
+                keep = keep.at[:, :, r * s_local:(r + 1) * s_local,
+                               src * s_local:(src + 1) * s_local].set(blk)
+        out = jnp.einsum("bnqk,bknd->bqnd",
+                         jnp.where(keep, probs, 0.0) / (1.0 - p),
+                         v.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    ref = dense_masked(q, k, v)
+    out = jax.jit(ps.shard_map(
+        lambda q, k, v: ring_attention_pallas(
+            q, k, v, block_q=16, block_k=16, dropout_p=p,
+            dropout_seed=seed),
+        mesh, in_specs=(P(None, "cp", None, None),) * 3,
+        out_specs=P(None, "cp", None, None)))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    dense_g = jax.grad(lambda q, k, v: jnp.sum(
+        dense_masked(q, k, v) ** 2), argnums=(0, 1, 2))(q, k, v)
+
+    def inner(q, k, v):
+        return jax.grad(lambda q, k, v: jax.lax.pmean(jnp.sum(
+            ring_attention_pallas(q, k, v, block_q=16, block_k=16,
+                                  dropout_p=p, dropout_seed=seed) ** 2),
+            "cp"), argnums=(0, 1, 2))(q, k, v)
+
+    g = jax.jit(ps.shard_map(
+        inner, mesh, in_specs=(P(None, "cp", None, None),) * 3,
+        out_specs=(P(None, "cp", None, None),) * 3))(q, k, v)
+    for a, r in zip(g, dense_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=5e-5)
+
+
+def test_llama_cp_ring_pallas_config_dispatch():
+    """cp_attn_impl='ring_pallas' is accepted and dispatches (on the CPU
+    mesh the tiny head_dim falls back to the XLA ring, so outputs equal
+    the 'ring' impl exactly — including the forwarded dropout draw)."""
+    from flax.core import meta
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+
+    with pytest.raises(ValueError, match="cp_attn_impl"):
+        tiny_config(cp_attn_impl="nope")
+
+    mesh = ps.initialize_model_parallel(context_parallel_size=2)
+    outs = {}
+    for impl in ("ring", "ring_pallas"):
+        mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                           num_layers=1, cp_attn_impl=impl,
+                           attention_dropout=0.2)
+        model = LlamaForCausalLM(mcfg)
+        ids = jax.random.randint(jax.random.key(2), (2, 32), 0,
+                                 mcfg.vocab_size)
+        params = meta.unbox(model.init(jax.random.key(3), ids))
+
+        def fwd(ids):
+            return model.apply(params, ids,
+                               rngs={"dropout": jax.random.key(4)})
+
+        outs[impl] = np.asarray(jax.jit(ps.shard_map(
+            fwd, mesh, in_specs=P(None, "cp"),
+            out_specs=P(None, "cp")))(ids))
+    np.testing.assert_array_equal(outs["ring"], outs["ring_pallas"])
