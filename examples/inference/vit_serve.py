@@ -1,0 +1,75 @@
+"""ViT image-classification serving via the AOT ModelBuilder.
+
+Analogue of the reference's ``examples/inference/run_vit.py`` /
+``vit/vit_runner.py`` (IMAGE_ENC task): trace the image encoder once per
+batch bucket, AOT-compile, route incoming batches to the tightest bucket,
+report latency. Weights are random-initialised here; a real checkpoint
+loads through ``scripts.checkpoint_converter.convert_hf_vit_to_nxd``
+(ViT-Base/Large/Huge — the reference example's documented targets).
+
+    python examples/inference/vit_serve.py --model tiny --batch 2
+    python examples/inference/vit_serve.py --model base --buckets 1,4,8
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.core import meta
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.inference.model_builder import ModelBuilder
+from neuronx_distributed_tpu.models.vit import (VIT_BASE,
+                                                ViTForImageClassification,
+                                                tiny_vit_config)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny", choices=["tiny", "base"])
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--buckets", default="1,4",
+                    help="comma-separated batch buckets to AOT-compile")
+    ap.add_argument("--iters", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    nxd.neuronx_distributed_config(tensor_parallel_size=args.tp)
+    cfg = (tiny_vit_config(dtype=jnp.float32, param_dtype=jnp.float32)
+           if args.model == "tiny" else VIT_BASE)
+    model = ViTForImageClassification(cfg)
+    shape = (cfg.num_channels, cfg.image_size, cfg.image_size)
+    params = meta.unbox(model.init(
+        jax.random.key(0), jnp.zeros((1,) + shape, jnp.float32)))
+
+    buckets = sorted({int(b) for b in args.buckets.split(",")}
+                     | {args.batch})
+    builder = ModelBuilder()
+    builder.add(
+        "image_encoder",
+        lambda px: model.apply(params, px),
+        [(jax.ShapeDtypeStruct((b,) + shape, jnp.float32),)
+         for b in buckets],
+        priority_model=True)
+    t0 = time.perf_counter()
+    served = builder.trace().compile()
+    print(f"built {len(buckets)} buckets in "
+          f"{time.perf_counter() - t0:.1f}s: {buckets}")
+
+    px = jax.random.normal(jax.random.key(1), (args.batch,) + shape)
+    logits = served.forward("image_encoder", px)  # warm the routed bucket
+    jax.block_until_ready(logits)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        logits = served.forward("image_encoder", px)
+    jax.block_until_ready(logits)
+    dt = (time.perf_counter() - t0) / args.iters
+    top1 = np.asarray(jnp.argmax(logits, axis=-1))
+    print(f"top-1 {top1.tolist()}  latency {dt * 1e3:.2f} ms/batch  "
+          f"{args.batch / dt:.1f} images/s")
+
+
+if __name__ == "__main__":
+    main()

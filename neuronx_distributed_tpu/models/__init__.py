@@ -5,9 +5,10 @@ from . import gpt_neox
 from . import llama
 from . import llama_pipeline
 from . import mixtral
+from . import vit
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel
 from .mixtral import MixtralConfig, MixtralForCausalLM
 
-__all__ = ["bert", "gpt_neox", "llama", "llama_pipeline", "mixtral", "LlamaConfig",
+__all__ = ["bert", "gpt_neox", "llama", "llama_pipeline", "mixtral", "vit", "LlamaConfig",
            "LlamaForCausalLM", "LlamaModel", "MixtralConfig",
            "MixtralForCausalLM"]

@@ -1,0 +1,228 @@
+"""ViT model family (image encoder + classification head).
+
+Parity target: the reference's ViT inference example
+(``examples/inference/vit/neuron_modeling_vit.py`` — NeuronViTEmbeddings /
+NeuronViTLayer / NeuronViTEncoder wrapping HF ``ViTForImageClassification``).
+TPU-first design notes:
+
+* patch embedding is patch-extraction (a reshape/transpose, free under XLA)
+  followed by a single dense projection — the exact math of the reference's
+  stride-``p`` Conv2d (``neuron_modeling_vit.py:148``) but expressed as one
+  MXU matmul over ``[B*N, C*p*p] @ [C*p*p, H]`` instead of a convolution;
+* pre-LN transformer blocks on the shared parallel layers (TP column/row
+  pairs, bidirectional sdpa/flash attention — same kernels as BERT);
+* static shapes only: ``interpolate_pos_encoding`` is resolved at trace
+  time from the configured image size (dynamic interpolation would break
+  XLA's one-trace compilation model; resize offline instead).
+
+HF weight layout maps via ``scripts.checkpoint_converter.convert_hf_vit_to_nxd``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..modules import attention as attn_mod
+from ..modules.norms import LayerNorm
+from ..parallel import layers as pl
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    num_labels: int = 1000
+    layernorm_eps: float = 1e-12
+    # dropout (active iff a "dropout" rng is supplied to apply(), matching
+    # the BERT/llama convention); attention dropout shares the
+    # counter-based mask hash with the flash kernels
+    attention_dropout: float = 0.0
+    hidden_dropout: float = 0.0
+    use_flash_attention: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+    tp_size: Optional[int] = None
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def __post_init__(self) -> None:
+        if self.image_size % self.patch_size != 0:
+            raise ValueError(
+                f"image_size {self.image_size} must be divisible by "
+                f"patch_size {self.patch_size}")
+
+
+# ViT-Base/Large/Huge are the reference example's three documented targets
+# (run_vit.py:7-17)
+VIT_BASE = ViTConfig()
+VIT_LARGE = ViTConfig(hidden_size=1024, intermediate_size=4096,
+                      num_layers=24, num_heads=16)
+VIT_HUGE = ViTConfig(hidden_size=1280, intermediate_size=5120,
+                     num_layers=32, num_heads=16, patch_size=14)
+
+
+def tiny_vit_config(**kw) -> ViTConfig:
+    base = dict(image_size=16, patch_size=8, hidden_size=64,
+                intermediate_size=128, num_layers=2, num_heads=4,
+                num_labels=8)
+    base.update(kw)
+    return ViTConfig(**base)
+
+
+def patchify(pixel_values: jax.Array, patch: int) -> jax.Array:
+    """``[B, C, H, W]`` (HF channel-first convention) → ``[B, N, C*p*p]``
+    patch vectors, element order (c, i, j) matching a flattened HF Conv2d
+    kernel ``[hidden, C, p, p]``."""
+    b, c, h, w = pixel_values.shape
+    x = pixel_values.reshape(b, c, h // patch, patch, w // patch, patch)
+    x = x.transpose(0, 2, 4, 1, 3, 5)  # [B, Hp, Wp, C, p, p]
+    return x.reshape(b, (h // patch) * (w // patch), c * patch * patch)
+
+
+class ViTLayer(nn.Module):
+    """Pre-LN block: ``x + attn(LN(x))`` then ``x + mlp(LN(x))``
+    (reference ``NeuronViTLayer.forward``, ``neuron_modeling_vit.py:274``)."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        train = self.has_rng("dropout")
+        hd = cfg.hidden_size // cfg.num_heads
+        h = LayerNorm(eps=cfg.layernorm_eps, dtype=cfg.dtype,
+                      name="ln_before")(x)
+        q, k, v = pl.GQAQKVColumnParallelLinear(
+            num_heads=cfg.num_heads, num_kv_heads=cfg.num_heads,
+            head_dim=hd, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, tp_size=cfg.tp_size,
+            name="qkv")(h)
+        b, s = q.shape[0], q.shape[1]
+        n_local = q.shape[-1] // hd
+        q = q.reshape(b, s, n_local, hd)
+        k = k.reshape(b, s, n_local, hd)
+        v = v.reshape(b, s, n_local, hd)
+        dropout_p, dropout_seed = 0.0, None
+        if cfg.attention_dropout > 0.0 and train:
+            dropout_p = cfg.attention_dropout
+            dropout_seed = jax.random.bits(self.make_rng("dropout"), (),
+                                           jnp.uint32)
+        if cfg.use_flash_attention:
+            from ..ops.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=False,
+                                   dropout_p=dropout_p,
+                                   dropout_seed=dropout_seed)
+        else:
+            attn = attn_mod.sdpa_reference(q, k, v, causal=False,
+                                           dropout_p=dropout_p,
+                                           dropout_seed=dropout_seed)
+        attn = attn.reshape(b, s, n_local * hd)
+        attn = pl.RowParallelLinear(
+            features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="o_proj")(attn)
+        hidden_drop = nn.Dropout(rate=cfg.hidden_dropout)
+        x = x + hidden_drop(attn, deterministic=not train)
+        h = LayerNorm(eps=cfg.layernorm_eps, dtype=cfg.dtype,
+                      name="ln_after")(x)
+        h = pl.ColumnParallelLinear(
+            features=cfg.intermediate_size, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="up")(h)
+        h = nn.gelu(h, approximate=False)  # HF uses erf gelu
+        h = pl.RowParallelLinear(
+            features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="down")(h)
+        return x + hidden_drop(h, deterministic=not train)
+
+
+class _ViTScanBody(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        return ViTLayer(self.cfg, name="layer")(x), None
+
+
+class ViTForImageClassification(nn.Module):
+    """Patch embed + CLS token + pre-LN encoder + classifier on the CLS
+    position (HF ``ViTForImageClassification``; the reference serves this
+    via its IMAGE_ENC runner, ``run_vit.py:35``). ``method="encode"``
+    exposes the raw image-encoder states for feature-extraction serving."""
+
+    cfg: ViTConfig
+
+    def setup(self) -> None:
+        cfg = self.cfg
+        self.patch_proj = pl.ColumnParallelLinear(
+            features=cfg.hidden_size, use_bias=True, gather_output=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        self.cls_token = self.param(
+            "cls_token",
+            nn.with_partitioning(nn.initializers.zeros_init(),
+                                 (None, None, None)),
+            (1, 1, cfg.hidden_size), cfg.param_dtype)
+        self.position_embedding = self.param(
+            "position_embedding",
+            nn.with_partitioning(pl.default_embed_init, (None, None)),
+            (cfg.num_patches + 1, cfg.hidden_size), cfg.param_dtype)
+        self.embed_drop = nn.Dropout(rate=cfg.hidden_dropout)
+        if cfg.scan_layers:
+            body_cls = _ViTScanBody
+            if cfg.remat:
+                body_cls = nn.remat(
+                    body_cls, prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            self.layers = nn.scan(
+                body_cls, variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"})(cfg)
+        else:
+            self.layer_stack = [ViTLayer(cfg) for _ in range(cfg.num_layers)]
+        self.final_norm = LayerNorm(eps=cfg.layernorm_eps, dtype=cfg.dtype)
+        self.classifier = pl.ColumnParallelLinear(
+            features=cfg.num_labels, use_bias=True, gather_output=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+
+    def encode(self, pixel_values):
+        """``[B, C, H, W]`` → final hidden states ``[B, N+1, hidden]``."""
+        cfg = self.cfg
+        train = self.has_rng("dropout")
+        patches = patchify(pixel_values.astype(cfg.dtype), cfg.patch_size)
+        x = self.patch_proj(patches)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(self.cls_token.astype(cfg.dtype),
+                              (x.shape[0], 1, cfg.hidden_size)), x], axis=1)
+        x = x + self.position_embedding[None].astype(cfg.dtype)
+        x = self.embed_drop(x, deterministic=not train)
+        if cfg.scan_layers:
+            x, _ = self.layers(x)
+        else:
+            for layer in self.layer_stack:
+                x = layer(x)
+        return self.final_norm(x)
+
+    def __call__(self, pixel_values):
+        x = self.encode(pixel_values)
+        return self.classifier(x[:, 0]).astype(jnp.float32)
+
+    def loss(self, pixel_values, labels):
+        """Mean softmax cross-entropy over ``[B]`` integer labels."""
+        logits = self(pixel_values)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, labels[:, None], axis=-1))

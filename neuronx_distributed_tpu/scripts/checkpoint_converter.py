@@ -347,6 +347,65 @@ def convert_hf_bert_to_nxd(state_dict: Dict[str, Any], cfg) -> Dict:
     }}
 
 
+def convert_hf_vit_to_nxd(state_dict: Dict[str, Any], cfg) -> Dict:
+    """HF ``ViTForImageClassification`` state dict → our param tree
+    (``models.vit.ViTForImageClassification``). The stride-``p`` Conv2d
+    patch projection flattens to the dense kernel ``[C*p*p, hidden]`` in
+    (c, i, j) element order — see ``models.vit.patchify``."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    L = cfg.num_layers
+    pre = "vit.encoder.layer.{}."
+
+    def attn(part, what):
+        return _stack(sd, pre + f"attention.attention.{part}.{what}", L,
+                      _t if what == "weight" else _asnp)
+
+    def ln(which, what):
+        return _stack(sd, pre + f"layernorm_{which}.{what}", L, _asnp)
+
+    layers = {
+        "ln_before": {"scale": ln("before", "weight"),
+                      "bias": ln("before", "bias")},
+        "qkv": {
+            "q_kernel": attn("query", "weight"),
+            "k_kernel": attn("key", "weight"),
+            "v_kernel": attn("value", "weight"),
+            "q_bias": attn("query", "bias"),
+            "k_bias": attn("key", "bias"),
+            "v_bias": attn("value", "bias"),
+        },
+        "o_proj": {
+            "kernel": _stack(sd, pre + "attention.output.dense.weight", L),
+            "bias": _stack(sd, pre + "attention.output.dense.bias", L,
+                           _asnp),
+        },
+        "ln_after": {"scale": ln("after", "weight"),
+                     "bias": ln("after", "bias")},
+        "up": {
+            "kernel": _stack(sd, pre + "intermediate.dense.weight", L),
+            "bias": _stack(sd, pre + "intermediate.dense.bias", L, _asnp),
+        },
+        "down": {
+            "kernel": _stack(sd, pre + "output.dense.weight", L),
+            "bias": _stack(sd, pre + "output.dense.bias", L, _asnp),
+        },
+    }
+    proj = sd["vit.embeddings.patch_embeddings.projection.weight"]
+    return {"params": {
+        "patch_proj": {
+            "kernel": proj.reshape(proj.shape[0], -1).T,
+            "bias": sd["vit.embeddings.patch_embeddings.projection.bias"],
+        },
+        "cls_token": sd["vit.embeddings.cls_token"],
+        "position_embedding": sd["vit.embeddings.position_embeddings"][0],
+        "layers": {"layer": layers},
+        "final_norm": {"scale": sd["vit.layernorm.weight"],
+                       "bias": sd["vit.layernorm.bias"]},
+        "classifier": {"kernel": _t(sd["classifier.weight"]),
+                       "bias": sd["classifier.bias"]},
+    }}
+
+
 def main(argv=None) -> None:
     """CLI (reference: the ``CheckpointConverterBase`` argparse driver)."""
     import argparse

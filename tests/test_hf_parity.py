@@ -170,3 +170,38 @@ def test_hf_bert_logits_parity():
     ours = np.asarray(BertForPreTraining(cfg).apply(
         params, jnp.asarray(ids), jnp.asarray(types)))
     np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_vit_logits_parity():
+    """ViT family: HF ViTForImageClassification logits parity (reference
+    example examples/inference/vit/neuron_modeling_vit.py wraps this HF
+    model; its runner's check_accuracy_logits is the same gate)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from neuronx_distributed_tpu.models.vit import (ViTConfig,
+                                                    ViTForImageClassification)
+    from neuronx_distributed_tpu.scripts.checkpoint_converter import (
+        convert_hf_vit_to_nxd)
+
+    hf_cfg = transformers.ViTConfig(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=64, image_size=32, patch_size=16, num_labels=6,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    hf = transformers.ViTForImageClassification(hf_cfg).eval()
+
+    cfg = ViTConfig(image_size=32, patch_size=16, hidden_size=32,
+                    intermediate_size=64, num_layers=2, num_heads=4,
+                    num_labels=6, dtype=jnp.float32,
+                    param_dtype=jnp.float32)
+    ps.initialize_model_parallel()
+    params = convert_hf_vit_to_nxd(hf.state_dict(), cfg)
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    model = ViTForImageClassification(cfg)
+
+    px = np.random.RandomState(2).randn(2, 3, 32, 32).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(px)).logits.numpy()
+    ours = np.asarray(model.apply(params, jnp.asarray(px)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)

@@ -122,3 +122,53 @@ def test_bert_neox_flash_attention_parity():
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4,
                                    err_msg=ctor.__name__)
+
+
+@pytest.mark.slow
+def test_vit_trains():
+    """ViT family (reference examples/inference/vit): image classification
+    trains through the standard trainer with a pixel-batch loss_fn."""
+    from neuronx_distributed_tpu.models.vit import (ViTForImageClassification,
+                                                    tiny_vit_config)
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=2)
+    mcfg = tiny_vit_config(dtype=jnp.float32, param_dtype=jnp.float32)
+    model = ViTForImageClassification(mcfg)
+    px = jax.random.normal(jax.random.key(0), (8, 3, 16, 16))
+    labels = jax.random.randint(jax.random.key(1), (8,), 0, mcfg.num_labels)
+    batch = {"pixel_values": px, "labels": labels}
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(2), px)
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 3e-3)
+
+    def loss_fn(module, params, batch):
+        return module.apply(params, batch["pixel_values"], batch["labels"],
+                            method="loss")
+
+    step = make_train_step(pm, tx, sh, loss_fn=loss_fn)
+    losses = []
+    for _ in range(10):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+@pytest.mark.slow
+def test_vit_tp_shard_map_parity():
+    from neuronx_distributed_tpu.models.vit import (ViTForImageClassification,
+                                                    tiny_vit_config)
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=4)
+    mesh = ps.get_mesh()
+    mcfg = tiny_vit_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                           tp_size=4, num_layers=1)
+    model = ViTForImageClassification(mcfg)
+    px = jax.random.normal(jax.random.key(0), (2, 3, 16, 16))
+    labels = jax.random.randint(jax.random.key(1), (2,), 0, mcfg.num_labels)
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(2), px)
+    host = jax.tree_util.tree_map(np.asarray, params)
+    dense = model.apply(host, px, labels, method="loss")
+    sharded = jax.jit(ps.shard_map(
+        lambda p, x, l: model.apply(p, x, l, method="loss"), mesh,
+        in_specs=(pm.param_specs, P(), P()),
+        out_specs=P()))(params, px, labels)
+    np.testing.assert_allclose(float(sharded), float(dense), rtol=2e-4)

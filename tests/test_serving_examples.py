@@ -58,3 +58,8 @@ def test_dbrx_serve_smoke(capsys):
     _run("mixtral_serve.py", ["--model", "dbrx-tiny", "--max-new", "4",
                               "--prompt-len", "8"])
     assert "E=16 K=4" in capsys.readouterr().out
+
+
+def test_vit_serve_smoke(capsys):
+    _run("vit_serve.py", ["--model", "tiny", "--batch", "2", "--iters", "2"])
+    assert "images/s" in capsys.readouterr().out
