@@ -17,7 +17,8 @@ from . import speculative
 from .generation import decode_step, generate, pick_bucket, prefill
 from .kv_cache import KVCache, init_kv_cache
 from .model_builder import (ModelBuilder, NxDModel, bundle_generate,
-                            bundle_speculative_generate, shard_checkpoint)
+                            bundle_speculative_generate, generate_buckets,
+                            shard_checkpoint)
 from .sampling import SamplingConfig, sample
 from .speculative import make_speculation_round_fn
 
@@ -26,7 +27,7 @@ __all__ = [
     "benchmark", "speculative",
     "decode_step", "generate", "pick_bucket", "prefill",
     "KVCache", "init_kv_cache",
-    "ModelBuilder", "NxDModel", "shard_checkpoint",
+    "ModelBuilder", "NxDModel", "generate_buckets", "shard_checkpoint",
     "bundle_generate", "bundle_speculative_generate",
     "make_speculation_round_fn",
     "SamplingConfig", "sample",

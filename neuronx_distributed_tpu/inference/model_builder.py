@@ -79,6 +79,20 @@ class _ModelEntry:
     priority: bool = False
 
 
+def generate_buckets(min_length: int, max_length: int) -> List[int]:
+    """Log2-spaced bucket sizes from ``min_length`` up to ``max_length``
+    (reference ``examples/inference/modules/autobucketing.py:6`` —
+    ``round(log2(max))`` keeps the spacing optimal and avoids a bucket one
+    step under the max). The runtime half of autobucketing — routing an
+    input to the tightest compiled bucket with padding — is
+    :meth:`NxDModel.router` / ``forward(pad_inputs=True)``."""
+    if min_length >= max_length:
+        return [max_length]
+    lo = int(math.log2(min_length))
+    hi = round(math.log2(max_length))
+    return [2 ** i for i in range(lo, hi)] + [max_length]
+
+
 class ModelBuilder:
     """Multi-key, multi-bucket AOT builder (reference ``ModelBuilder``,
     ``model_builder.py:441``: ``add:495``, ``trace:526``, compile

@@ -45,6 +45,10 @@ class GPTNeoXConfig:
     # kernel dispatcher; the reference's NKI flash serves its whole zoo,
     # kernels/flash_attn.py:162)
     use_flash_attention: bool = False
+    # attention-probability dropout (HF gpt_neox attention_dropout; active
+    # iff a "dropout" rng is supplied — counter-based masks shared with
+    # the flash kernels, in-kernel on the Pallas path)
+    attention_dropout: float = 0.0
     tp_size: Optional[int] = None
 
     @property
@@ -95,12 +99,18 @@ class NeoXAttention(nn.Module):
             k = jnp.concatenate([
                 attn_mod.apply_rotary(k[..., :rot], cos, sin, positions),
                 k[..., rot:]], axis=-1)
+        dropout_p, dropout_seed = attn_mod.attention_dropout_seed(
+            self, cfg.attention_dropout)
         if cfg.use_flash_attention:
             from ..ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, k, v, causal=True,
+                                  dropout_p=dropout_p,
+                                  dropout_seed=dropout_seed)
         else:
-            out = attn_mod.sdpa_reference(q, k, v, causal=True)
+            out = attn_mod.sdpa_reference(q, k, v, causal=True,
+                                          dropout_p=dropout_p,
+                                          dropout_seed=dropout_seed)
         out = out.reshape(b, s, n_local * hd)
         return pl.RowParallelLinear(
             features=cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
@@ -176,7 +186,7 @@ class GPTNeoXForCausalLM(nn.Module):
                     policy=jax.checkpoint_policies.nothing_saveable)
             scanned = nn.scan(
                 body_cls, variable_axes={"params": 0},
-                split_rngs={"params": True},
+                split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"})(

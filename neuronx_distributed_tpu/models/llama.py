@@ -235,12 +235,8 @@ class LlamaAttention(nn.Module):
             # attention dropout: active iff the config rate > 0 AND the
             # caller supplied a "dropout" rng (training); eval calls without
             # the rng are deterministic with no flag-threading
-            dropout_p = 0.0
-            dropout_seed = None
-            if cfg.attention_dropout > 0.0 and self.has_rng("dropout"):
-                dropout_p = cfg.attention_dropout
-                dropout_seed = jax.random.bits(self.make_rng("dropout"), (),
-                                               jnp.uint32)
+            dropout_p, dropout_seed = attn_mod.attention_dropout_seed(
+                self, cfg.attention_dropout)
             cp = comm._axis_size(ps.CP_AXIS)
             if cp is not None and cp > 1 and cfg.cp_attn_impl == "ulysses":
                 # Ulysses moves the raw GQA kv heads through its

@@ -39,6 +39,13 @@ def pipelined_moe_loss_fn(cfg: MixtralConfig, num_microbatches: int,
     the MoE decoder; includes the router aux losses."""
     if not cfg.scan_layers:
         raise ValueError("pipeline path requires scan_layers=True")
+    if getattr(cfg, "attention_dropout", 0.0) > 0.0:
+        # no rng plumbing per microbatch through the pipeline engines — a
+        # silent skip would fake regularization (cf. the CP dropout guard
+        # history in models/llama.py)
+        raise ValueError(
+            "attention_dropout is not threaded through the pipeline "
+            "engines; set attention_dropout=0 for PP configs")
 
     embed_mod = pl.ParallelEmbedding(
         num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
@@ -178,6 +185,13 @@ def make_moe_1f1b_grad_fn(cfg: MixtralConfig, num_microbatches: int,
 
     if not cfg.scan_layers:
         raise ValueError("pipeline path requires scan_layers=True")
+    if getattr(cfg, "attention_dropout", 0.0) > 0.0:
+        # no rng plumbing per microbatch through the pipeline engines — a
+        # silent skip would fake regularization (cf. the CP dropout guard
+        # history in models/llama.py)
+        raise ValueError(
+            "attention_dropout is not threaded through the pipeline "
+            "engines; set attention_dropout=0 for PP configs")
     C = num_chunks
 
     embed_mod = pl.ParallelEmbedding(

@@ -116,11 +116,8 @@ class ViTLayer(nn.Module):
         q = q.reshape(b, s, n_local, hd)
         k = k.reshape(b, s, n_local, hd)
         v = v.reshape(b, s, n_local, hd)
-        dropout_p, dropout_seed = 0.0, None
-        if cfg.attention_dropout > 0.0 and train:
-            dropout_p = cfg.attention_dropout
-            dropout_seed = jax.random.bits(self.make_rng("dropout"), (),
-                                           jnp.uint32)
+        dropout_p, dropout_seed = attn_mod.attention_dropout_seed(
+            self, cfg.attention_dropout)
         if cfg.use_flash_attention:
             from ..ops.flash_attention import flash_attention
 

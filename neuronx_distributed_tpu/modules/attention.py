@@ -21,6 +21,19 @@ from ..parallel import layers as pl
 from ..parallel import mesh as ps
 
 
+def attention_dropout_seed(module: nn.Module, rate: float):
+    """``(dropout_p, dropout_seed)`` gate shared by every model family:
+    dropout is active iff ``rate > 0`` AND the module was given a
+    ``"dropout"`` rng (no deterministic-flag threading). The uint32 seed
+    feeds the counter-based mask hash
+    (:func:`..ops.flash_attention.dropout_keep_mask`) — one draw per
+    attention module, folded per layer by the scan rng split."""
+    if rate > 0.0 and module.has_rng("dropout"):
+        return rate, jax.random.bits(module.make_rng("dropout"), (),
+                                     jnp.uint32)
+    return 0.0, None
+
+
 def apply_rope_scaling(freqs: jax.Array,
                        scale_factor: float = 8.0,
                        low_freq_factor: float = 1.0,

@@ -220,3 +220,63 @@ def test_gqa_lora_dropout_matches_weight_space_at_p0():
     # with an rng the activation-space path engages and differs
     outd = l1.apply(params, x, rngs={"dropout": jax.random.key(2)})
     assert not np.array_equal(np.asarray(out0[0]), np.asarray(outd[0]))
+
+
+def test_neox_mixtral_attention_dropout_live():
+    """attention_dropout is live in the GPT-NeoX and Mixtral families
+    (HF carries the field on both configs): no rng -> deterministic
+    eval, distinct rngs -> distinct outputs (scanned layers split the
+    dropout rng per layer)."""
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.models.gpt_neox import (GPTNeoXForCausalLM,
+                                                         tiny_neox_config)
+    from neuronx_distributed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                        tiny_moe_config)
+
+    ps.initialize_model_parallel(tensor_model_parallel_size=1)
+    for model, cfg in [
+        (GPTNeoXForCausalLM, tiny_neox_config(
+            dtype=jnp.float32, param_dtype=jnp.float32,
+            attention_dropout=0.3)),
+        (MixtralForCausalLM, tiny_moe_config(
+            dtype=jnp.float32, param_dtype=jnp.float32,
+            attention_dropout=0.3)),
+    ]:
+        m = model(cfg)
+        ids = jax.random.randint(jax.random.key(0), (2, 16), 0,
+                                 cfg.vocab_size)
+        params = meta.unbox(m.init(jax.random.key(1), ids))
+        out = m.apply(params, ids)
+        ev_a, ev_b = np.asarray(out[0] if isinstance(out, tuple) else out), \
+            None
+        out_b = m.apply(params, ids)
+        ev_b = np.asarray(out_b[0] if isinstance(out_b, tuple) else out_b)
+        np.testing.assert_array_equal(ev_a, ev_b)
+        tr = m.apply(params, ids, rngs={"dropout": jax.random.key(2)})
+        tr_a = np.asarray(tr[0] if isinstance(tr, tuple) else tr)
+        tr2 = m.apply(params, ids, rngs={"dropout": jax.random.key(3)})
+        tr_b = np.asarray(tr2[0] if isinstance(tr2, tuple) else tr2)
+        assert not np.array_equal(tr_a, tr_b), model.__name__
+        assert not np.array_equal(tr_a, ev_a), model.__name__
+
+
+def test_pipeline_rejects_attention_dropout():
+    """The pipeline engines carry no per-microbatch rng plumbing; a PP
+    config with attention_dropout > 0 must fail loudly, not silently skip
+    regularization (review finding r5)."""
+    from neuronx_distributed_tpu.models.llama import tiny_config
+    from neuronx_distributed_tpu.models.llama_pipeline import (
+        make_1f1b_grad_fn, pipelined_loss_fn)
+    from neuronx_distributed_tpu.models.mixtral import tiny_moe_config
+    from neuronx_distributed_tpu.models.mixtral_pipeline import (
+        pipelined_moe_loss_fn)
+
+    cfg = tiny_config(attention_dropout=0.1)
+    with pytest.raises(ValueError, match="attention_dropout"):
+        pipelined_loss_fn(cfg, num_microbatches=2)
+    with pytest.raises(ValueError, match="attention_dropout"):
+        make_1f1b_grad_fn(cfg, num_microbatches=2, param_specs=None)
+    with pytest.raises(ValueError, match="attention_dropout"):
+        pipelined_moe_loss_fn(tiny_moe_config(attention_dropout=0.1),
+                              num_microbatches=2)

@@ -615,3 +615,16 @@ def test_decode_benchmark_suite_smoke(tiny_model):
                                  buckets=(8,))
     assert set(rep) == {"greedy", "speculative"}
     assert rep["greedy"]["tokens_per_sec"] > 0
+
+
+def test_generate_buckets():
+    """Log2-spaced bucket generation (reference autobucketing.py:6):
+    round(log2(max)) spacing never emits a bucket one step under max."""
+    from neuronx_distributed_tpu.inference import generate_buckets
+
+    assert generate_buckets(128, 128) == [128]
+    assert generate_buckets(256, 128) == [128]
+    assert generate_buckets(128, 1024) == [128, 256, 512, 1024]
+    # rounding: 513 -> log2 ~ 9.002 rounds to 9, so no 512 bucket crowding
+    assert generate_buckets(128, 513) == [128, 256, 513]
+    assert generate_buckets(128, 510) == [128, 256, 510]
