@@ -406,25 +406,84 @@ def convert_hf_vit_to_nxd(state_dict: Dict[str, Any], cfg) -> Dict:
     }}
 
 
+def _cli_config(family: str, **overrides):
+    """Family config with CLI shape overrides (None values dropped — the
+    converters read num_experts/num_heads/hidden_size off the config, so
+    non-default checkpoints must be able to set them). Overrides a family
+    has no field for raise instead of being silently ignored."""
+    import dataclasses
+
+    if family == "llama":
+        from ..models.llama import LlamaConfig as cls
+
+        extra = {}
+    elif family == "mixtral":
+        from ..models.mixtral import MixtralConfig as cls
+
+        extra = {}
+    elif family == "neox":
+        from ..models.gpt_neox import GPTNeoXConfig as cls
+
+        extra = {}
+    elif family == "bert":
+        from ..models.bert import BertConfig as cls
+
+        extra = {"mlm_transform": True}
+    elif family == "vit":
+        from ..models.vit import ViTConfig as cls
+
+        extra = {}
+    else:
+        raise ValueError(f"unknown family {family!r}")  # sync: _HF2NXD
+    kw = {k: v for k, v in overrides.items() if v is not None}
+    fields = {f.name for f in dataclasses.fields(cls)}
+    unknown = sorted(set(kw) - fields)
+    if unknown:
+        raise SystemExit(
+            f"--family {family} has no config field(s) {unknown}")
+    return cls(**extra, **kw)
+
+
+_HF2NXD = {"llama": convert_hf_llama_to_nxd,
+           "mixtral": convert_hf_mixtral_to_nxd,
+           "neox": convert_hf_neox_to_nxd,
+           "bert": convert_hf_bert_to_nxd,
+           "vit": convert_hf_vit_to_nxd}
+
+
 def main(argv=None) -> None:
-    """CLI (reference: the ``CheckpointConverterBase`` argparse driver)."""
+    """CLI (reference: the ``CheckpointConverterBase`` argparse driver,
+    one subclass per model family)."""
     import argparse
     import pickle
 
     ap = argparse.ArgumentParser(
-        description="Convert HF llama checkpoints to/from the framework "
+        description="Convert HF checkpoints to/from the framework "
                     "param-tree format")
     ap.add_argument("--input", required=True,
                     help=".safetensors / torch .bin / pickled tree")
     ap.add_argument("--output", required=True)
+    ap.add_argument("--family", choices=sorted(_HF2NXD), default="llama")
     ap.add_argument("--direction", choices=["hf2nxd", "nxd2hf"],
                     default="hf2nxd")
     ap.add_argument("--num-layers", type=int, required=True)
+    # shape fields the converters read off the config; defaults are each
+    # family's flagship shape — set them for any other checkpoint size
+    ap.add_argument("--hidden-size", type=int)
+    ap.add_argument("--intermediate-size", type=int)
+    ap.add_argument("--num-heads", type=int)
+    ap.add_argument("--num-kv-heads", type=int)
+    ap.add_argument("--num-experts", type=int)
+    ap.add_argument("--vocab-size", type=int)
     args = ap.parse_args(argv)
 
-    from ..models.llama import LlamaConfig
-
-    cfg = LlamaConfig(num_layers=args.num_layers)
+    cfg = _cli_config(args.family, num_layers=args.num_layers,
+                      hidden_size=args.hidden_size,
+                      intermediate_size=args.intermediate_size,
+                      num_heads=args.num_heads,
+                      num_kv_heads=args.num_kv_heads,
+                      num_experts=args.num_experts,
+                      vocab_size=args.vocab_size)
 
     if args.input.endswith(".safetensors"):
         from safetensors.numpy import load_file
@@ -434,8 +493,15 @@ def main(argv=None) -> None:
         with open(args.input, "rb") as f:
             sd = pickle.load(f)
 
-    out = (convert_hf_llama_to_nxd(sd, cfg) if args.direction == "hf2nxd"
-           else convert_nxd_to_hf_llama(sd, cfg))
+    if args.direction == "hf2nxd":
+        out = _HF2NXD[args.family](sd, cfg)
+    elif args.family == "llama":
+        out = convert_nxd_to_hf_llama(sd, cfg)
+    else:
+        raise SystemExit(
+            "nxd2hf is implemented for --family llama only (the other "
+            "families' hf2nxd maps are lossless layer stackings; invert "
+            "with the family converters in this module if needed)")
     with open(args.output, "wb") as f:
         pickle.dump(out, f)
     print(f"wrote {args.output}")

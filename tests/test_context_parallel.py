@@ -443,3 +443,63 @@ def test_llama_cp_ring_pallas_config_dispatch():
             fwd, mesh, in_specs=P(None, "cp"),
             out_specs=P(None, "cp")))(ids))
     np.testing.assert_array_equal(outs["ring"], outs["ring_pallas"])
+
+
+@pytest.mark.slow
+def test_llama_cp_ring_pallas_model_path():
+    """Full-model cp_attn_impl='ring_pallas' with head_dim=128 (the real
+    Pallas kernel in interpret mode, not the fallback): loss and grads
+    match the dense model without dropout; with dropout the step still
+    runs and differs from eval."""
+    from flax.core import meta
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+
+    mesh = ps.initialize_model_parallel(context_parallel_size=2)
+    mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                       num_layers=1, hidden_size=256, num_heads=2,
+                       num_kv_heads=2, max_seq_len=128,
+                       cp_attn_impl="ring_pallas")
+    assert mcfg.head_dim_ == 128
+    model = LlamaForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (2, 65), 0, mcfg.vocab_size)
+    batch_ids, labels = ids[:, :-1], ids[:, 1:]
+    params = meta.unbox(model.init(jax.random.key(1), batch_ids))
+    host = jax.tree_util.tree_map(np.asarray, params)
+    dense = float(model.apply(host, batch_ids, labels, method="loss"))
+
+    dense_loss, dense_grads = jax.value_and_grad(
+        lambda p: model.apply(p, batch_ids, labels, method="loss"))(host)
+    np.testing.assert_allclose(
+        float(dense_loss), dense, rtol=1e-6)
+
+    from neuronx_distributed_tpu.parallel import grads as grads_mod
+
+    def inner(p, i, l):
+        loss, g = jax.value_and_grad(lambda p: jax.lax.pmean(
+            model.apply(p, i, l, method="loss"), "cp"))(p)
+        return loss, grads_mod.allreduce_gradients(g)
+
+    sharded_loss, sharded_grads = jax.jit(ps.shard_map(
+        inner, mesh, in_specs=(P(), P(None, "cp"), P(None, "cp")),
+        out_specs=(P(), P())))(params, batch_ids, labels)
+    sharded = float(sharded_loss)
+    np.testing.assert_allclose(sharded, dense, rtol=2e-4)
+    flat_ref = dict(jax.tree_util.tree_leaves_with_path(dense_grads))
+    for path, g in jax.tree_util.tree_leaves_with_path(sharded_grads):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(flat_ref[path]), rtol=5e-3,
+            atol=5e-5, err_msg=jax.tree_util.keystr(path))
+
+    # dropout: per-chunk in-kernel masks — a different draw from eval
+    import dataclasses
+
+    dmodel = LlamaForCausalLM(
+        dataclasses.replace(mcfg, attention_dropout=0.2))
+    tr = jax.jit(ps.shard_map(
+        lambda p, i, l: jax.lax.pmean(
+            dmodel.apply(p, i, l, method="loss",
+                         rngs={"dropout": jax.random.key(5)}), "cp"),
+        mesh, in_specs=(P(), P(None, "cp"), P(None, "cp")),
+        out_specs=P()))(params, batch_ids, labels)
+    assert np.isfinite(float(tr)) and abs(float(tr) - sharded) > 1e-6

@@ -64,3 +64,31 @@ def test_tensor_capture_and_replacement():
 
     with pytest.raises(KeyError):
         tc.apply_with_replacements(model, params, {"params/nope": ids}, ids)
+
+
+def test_checkpoint_converter_cli_families(tmp_path):
+    """The converter CLI accepts every family (reference ships one
+    CheckpointConverterBase subclass per family); smoke vit end to end."""
+    import pickle
+
+    import torch
+    import transformers
+
+    from neuronx_distributed_tpu.scripts import checkpoint_converter as cc
+
+    hf_cfg = transformers.ViTConfig(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=64, image_size=32, patch_size=16, num_labels=4)
+    torch.manual_seed(0)
+    sd = {k: v.numpy() for k, v in
+          transformers.ViTForImageClassification(hf_cfg).state_dict().items()}
+    src = tmp_path / "vit_hf.pkl"
+    dst = tmp_path / "vit_nxd.pkl"
+    with open(src, "wb") as f:
+        pickle.dump(sd, f)
+    cc.main(["--input", str(src), "--output", str(dst), "--family", "vit",
+             "--num-layers", "2"])
+    with open(dst, "rb") as f:
+        tree = pickle.load(f)
+    assert tree["params"]["layers"]["layer"]["qkv"]["q_kernel"].shape == \
+        (2, 32, 32)
