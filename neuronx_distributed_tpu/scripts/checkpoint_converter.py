@@ -406,6 +406,196 @@ def convert_hf_vit_to_nxd(state_dict: Dict[str, Any], cfg) -> Dict:
     }}
 
 
+def convert_nxd_to_hf_mixtral(params: Dict, cfg) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`convert_hf_mixtral_to_nxd` (per-expert w1/w3/w2
+    unstacked from the fused ``gate_up``/``down`` banks)."""
+    p = params["params"]
+    layers = p["model"]["layers"]["layer"]
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(
+            p["model"]["embed"]["embedding"]),
+        "model.norm.weight": np.asarray(p["model"]["norm"]["scale"]),
+        "lm_head.weight": _t(p["lm_head"]["kernel"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        qkv = layers["attn"]["qkv"]
+        out[pre + "self_attn.q_proj.weight"] = _t(qkv["q_kernel"][i])
+        out[pre + "self_attn.k_proj.weight"] = _t(qkv["k_kernel"][i])
+        out[pre + "self_attn.v_proj.weight"] = _t(qkv["v_kernel"][i])
+        out[pre + "self_attn.o_proj.weight"] = _t(
+            layers["attn"]["o_proj"]["kernel"][i])
+        out[pre + "block_sparse_moe.gate.weight"] = _t(
+            layers["moe"]["router"]["kernel"][i])
+        gu = np.asarray(layers["moe"]["experts"]["gate_up"][i])  # [E,H,2,I]
+        dn = np.asarray(layers["moe"]["experts"]["down"][i])     # [E,I,H]
+        for e in range(cfg.num_experts):
+            epre = pre + f"block_sparse_moe.experts.{e}."
+            out[epre + "w1.weight"] = _t(gu[e, :, 0])
+            out[epre + "w3.weight"] = _t(gu[e, :, 1])
+            out[epre + "w2.weight"] = _t(dn[e])
+        out[pre + "input_layernorm.weight"] = np.asarray(
+            layers["input_norm"]["scale"][i])
+        out[pre + "post_attention_layernorm.weight"] = np.asarray(
+            layers["post_norm"]["scale"][i])
+    return out
+
+
+def convert_nxd_to_hf_neox(params: Dict, cfg) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`convert_hf_neox_to_nxd` (re-fuses q/k/v into the
+    HF head-major ``query_key_value`` layout ``[heads, 3, head_dim]``)."""
+    p = params["params"]
+    layers = p["layers"]["layer"]
+    n, hd, h = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    out: Dict[str, np.ndarray] = {
+        "gpt_neox.embed_in.weight": np.asarray(p["embed"]["embedding"]),
+        "gpt_neox.final_layer_norm.weight": np.asarray(
+            p["final_norm"]["scale"]),
+        "gpt_neox.final_layer_norm.bias": np.asarray(
+            p["final_norm"]["bias"]),
+        "embed_out.weight": _t(p["lm_head"]["kernel"]),
+    }
+    qkv = layers["attn"]["qkv"]
+    for i in range(cfg.num_layers):
+        pre = f"gpt_neox.layers.{i}."
+        w = np.stack([_t(qkv[f"{j}_kernel"][i]).reshape(n, hd, h)
+                      for j in ("q", "k", "v")], axis=1)  # [n, 3, hd, h]
+        out[pre + "attention.query_key_value.weight"] = w.reshape(
+            3 * n * hd, h)
+        b = np.stack([np.asarray(qkv[f"{j}_bias"][i]).reshape(n, hd)
+                      for j in ("q", "k", "v")], axis=1)
+        out[pre + "attention.query_key_value.bias"] = b.reshape(3 * n * hd)
+        out[pre + "attention.dense.weight"] = _t(
+            layers["attn"]["o_proj"]["kernel"][i])
+        out[pre + "attention.dense.bias"] = np.asarray(
+            layers["attn"]["o_proj"]["bias"][i])
+        out[pre + "mlp.dense_h_to_4h.weight"] = _t(
+            layers["mlp"]["up"]["kernel"][i])
+        out[pre + "mlp.dense_h_to_4h.bias"] = np.asarray(
+            layers["mlp"]["up"]["bias"][i])
+        out[pre + "mlp.dense_4h_to_h.weight"] = _t(
+            layers["mlp"]["down"]["kernel"][i])
+        out[pre + "mlp.dense_4h_to_h.bias"] = np.asarray(
+            layers["mlp"]["down"]["bias"][i])
+        for ours, hf in (("ln1", "input_layernorm"),
+                         ("ln2", "post_attention_layernorm")):
+            out[pre + hf + ".weight"] = np.asarray(layers[ours]["scale"][i])
+            out[pre + hf + ".bias"] = np.asarray(layers[ours]["bias"][i])
+    return out
+
+
+def convert_nxd_to_hf_bert(params: Dict, cfg) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`convert_hf_bert_to_nxd`; emits the tied
+    ``cls.predictions.decoder.*`` aliases HF checkpoints carry."""
+    p = params["params"]
+    layers = p["layers"]["layer"]
+    embed = np.asarray(p["embed"]["embedding"])
+    mlm_bias = np.asarray(p["mlm_bias"])
+    out: Dict[str, np.ndarray] = {
+        "bert.embeddings.word_embeddings.weight": embed,
+        "bert.embeddings.position_embeddings.weight": np.asarray(
+            p["position_embedding"]),
+        "bert.embeddings.token_type_embeddings.weight": np.asarray(
+            p["type_embedding"]),
+        "bert.embeddings.LayerNorm.weight": np.asarray(
+            p["embed_norm"]["scale"]),
+        "bert.embeddings.LayerNorm.bias": np.asarray(
+            p["embed_norm"]["bias"]),
+        "cls.predictions.transform.dense.weight": _t(
+            p["mlm_transform"]["kernel"]),
+        "cls.predictions.transform.dense.bias": np.asarray(
+            p["mlm_transform"]["bias"]),
+        "cls.predictions.transform.LayerNorm.weight": np.asarray(
+            p["mlm_norm"]["scale"]),
+        "cls.predictions.transform.LayerNorm.bias": np.asarray(
+            p["mlm_norm"]["bias"]),
+        "cls.predictions.bias": mlm_bias,
+        "cls.predictions.decoder.weight": embed,
+        "cls.predictions.decoder.bias": mlm_bias,
+    }
+    for i in range(cfg.num_layers):
+        pre = f"bert.encoder.layer.{i}."
+        qkv = layers["qkv"]
+        for j, part in (("q", "query"), ("k", "key"), ("v", "value")):
+            out[pre + f"attention.self.{part}.weight"] = _t(
+                qkv[f"{j}_kernel"][i])
+            out[pre + f"attention.self.{part}.bias"] = np.asarray(
+                qkv[f"{j}_bias"][i])
+        out[pre + "attention.output.dense.weight"] = _t(
+            layers["o_proj"]["kernel"][i])
+        out[pre + "attention.output.dense.bias"] = np.asarray(
+            layers["o_proj"]["bias"][i])
+        out[pre + "attention.output.LayerNorm.weight"] = np.asarray(
+            layers["ln_attn"]["scale"][i])
+        out[pre + "attention.output.LayerNorm.bias"] = np.asarray(
+            layers["ln_attn"]["bias"][i])
+        out[pre + "intermediate.dense.weight"] = _t(
+            layers["up"]["kernel"][i])
+        out[pre + "intermediate.dense.bias"] = np.asarray(
+            layers["up"]["bias"][i])
+        out[pre + "output.dense.weight"] = _t(layers["down"]["kernel"][i])
+        out[pre + "output.dense.bias"] = np.asarray(
+            layers["down"]["bias"][i])
+        out[pre + "output.LayerNorm.weight"] = np.asarray(
+            layers["ln_mlp"]["scale"][i])
+        out[pre + "output.LayerNorm.bias"] = np.asarray(
+            layers["ln_mlp"]["bias"][i])
+    return out
+
+
+def convert_nxd_to_hf_vit(params: Dict, cfg) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`convert_hf_vit_to_nxd` (dense patch kernel folds
+    back into the HF Conv2d layout ``[hidden, C, p, p]``)."""
+    p = params["params"]
+    layers = p["layers"]["layer"]
+    c, pp = cfg.num_channels, cfg.patch_size
+    out: Dict[str, np.ndarray] = {
+        "vit.embeddings.cls_token": np.asarray(p["cls_token"]),
+        "vit.embeddings.position_embeddings": np.asarray(
+            p["position_embedding"])[None],
+        "vit.embeddings.patch_embeddings.projection.weight": np.asarray(
+            p["patch_proj"]["kernel"]).T.reshape(
+                cfg.hidden_size, c, pp, pp),
+        "vit.embeddings.patch_embeddings.projection.bias": np.asarray(
+            p["patch_proj"]["bias"]),
+        "vit.layernorm.weight": np.asarray(p["final_norm"]["scale"]),
+        "vit.layernorm.bias": np.asarray(p["final_norm"]["bias"]),
+        "classifier.weight": _t(p["classifier"]["kernel"]),
+        "classifier.bias": np.asarray(p["classifier"]["bias"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"vit.encoder.layer.{i}."
+        qkv = layers["qkv"]
+        for j, part in (("q", "query"), ("k", "key"), ("v", "value")):
+            out[pre + f"attention.attention.{part}.weight"] = _t(
+                qkv[f"{j}_kernel"][i])
+            out[pre + f"attention.attention.{part}.bias"] = np.asarray(
+                qkv[f"{j}_bias"][i])
+        out[pre + "attention.output.dense.weight"] = _t(
+            layers["o_proj"]["kernel"][i])
+        out[pre + "attention.output.dense.bias"] = np.asarray(
+            layers["o_proj"]["bias"][i])
+        out[pre + "intermediate.dense.weight"] = _t(
+            layers["up"]["kernel"][i])
+        out[pre + "intermediate.dense.bias"] = np.asarray(
+            layers["up"]["bias"][i])
+        out[pre + "output.dense.weight"] = _t(layers["down"]["kernel"][i])
+        out[pre + "output.dense.bias"] = np.asarray(
+            layers["down"]["bias"][i])
+        for ours, hf in (("ln_before", "layernorm_before"),
+                         ("ln_after", "layernorm_after")):
+            out[pre + hf + ".weight"] = np.asarray(layers[ours]["scale"][i])
+            out[pre + hf + ".bias"] = np.asarray(layers[ours]["bias"][i])
+    return out
+
+
+_NXD2HF = {"llama": convert_nxd_to_hf_llama,
+           "mixtral": convert_nxd_to_hf_mixtral,
+           "neox": convert_nxd_to_hf_neox,
+           "bert": convert_nxd_to_hf_bert,
+           "vit": convert_nxd_to_hf_vit}
+
+
 def _cli_config(family: str, **overrides):
     """Family config with CLI shape overrides (None values dropped — the
     converters read num_experts/num_heads/hidden_size off the config, so
@@ -475,6 +665,10 @@ def main(argv=None) -> None:
     ap.add_argument("--num-kv-heads", type=int)
     ap.add_argument("--num-experts", type=int)
     ap.add_argument("--vocab-size", type=int)
+    ap.add_argument("--image-size", type=int)
+    ap.add_argument("--patch-size", type=int)
+    ap.add_argument("--num-channels", type=int)
+    ap.add_argument("--num-labels", type=int)
     args = ap.parse_args(argv)
 
     cfg = _cli_config(args.family, num_layers=args.num_layers,
@@ -483,7 +677,11 @@ def main(argv=None) -> None:
                       num_heads=args.num_heads,
                       num_kv_heads=args.num_kv_heads,
                       num_experts=args.num_experts,
-                      vocab_size=args.vocab_size)
+                      vocab_size=args.vocab_size,
+                      image_size=args.image_size,
+                      patch_size=args.patch_size,
+                      num_channels=args.num_channels,
+                      num_labels=args.num_labels)
 
     if args.input.endswith(".safetensors"):
         from safetensors.numpy import load_file
@@ -493,15 +691,8 @@ def main(argv=None) -> None:
         with open(args.input, "rb") as f:
             sd = pickle.load(f)
 
-    if args.direction == "hf2nxd":
-        out = _HF2NXD[args.family](sd, cfg)
-    elif args.family == "llama":
-        out = convert_nxd_to_hf_llama(sd, cfg)
-    else:
-        raise SystemExit(
-            "nxd2hf is implemented for --family llama only (the other "
-            "families' hf2nxd maps are lossless layer stackings; invert "
-            "with the family converters in this module if needed)")
+    out = (_HF2NXD if args.direction == "hf2nxd"
+           else _NXD2HF)[args.family](sd, cfg)
     with open(args.output, "wb") as f:
         pickle.dump(out, f)
     print(f"wrote {args.output}")

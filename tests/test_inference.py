@@ -628,3 +628,33 @@ def test_generate_buckets():
     # rounding: 513 -> log2 ~ 9.002 rounds to 9, so no 512 bucket crowding
     assert generate_buckets(128, 513) == [128, 256, 513]
     assert generate_buckets(128, 510) == [128, 256, 510]
+
+
+def test_bundle_roundtrip_vit(tmp_path):
+    """The serving bundle is model-agnostic: a ViT image encoder (no KV
+    cache, pixel inputs) saves and loads through the same NxDModel zip
+    path and reproduces logits exactly."""
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.models.vit import (ViTForImageClassification,
+                                                    tiny_vit_config)
+
+    ps.initialize_model_parallel()
+    cfg = tiny_vit_config(dtype=jnp.float32, param_dtype=jnp.float32)
+    model = ViTForImageClassification(cfg)
+    px = jax.random.normal(jax.random.key(0), (2, 3, 16, 16))
+    params = meta.unbox(model.init(jax.random.key(1), px))
+
+    def classify(params, px):
+        return model.apply(params, px)
+
+    served = (ModelBuilder()
+              .add("image_encoder", classify, [(params, px)])
+              .trace().compile())
+    ref = np.asarray(served.forward("image_encoder", params, px))
+    path = str(tmp_path / "vit_bundle.zip")
+    served.save(path, params=params)
+
+    loaded = NxDModel.load(path)
+    out = np.asarray(loaded.forward("image_encoder", loaded.params, px))
+    np.testing.assert_array_equal(out, ref)
