@@ -15,7 +15,8 @@ import numpy as np
 from flax.core import meta
 
 import neuronx_distributed_tpu as nxd
-from neuronx_distributed_tpu.inference import SamplingConfig, generate
+from neuronx_distributed_tpu.inference import (SamplingConfig, generate,
+                                               generate_buckets)
 from neuronx_distributed_tpu.models import llama
 
 
@@ -28,6 +29,10 @@ def main(argv=None):
     ap.add_argument("--prompt-len", type=int, default=12)
     ap.add_argument("--max-new", type=int, default=32)
     ap.add_argument("--temperature", type=float, default=0.0)
+    # autobucketing (reference autobucketing.py): log2-spaced prompt
+    # buckets between the two bounds; each bucket is one compiled prefill
+    ap.add_argument("--min-bucket", type=int, default=16)
+    ap.add_argument("--max-bucket", type=int, default=128)
     args = ap.parse_args(argv)
 
     nxd.neuronx_distributed_config(tensor_parallel_size=args.tp)
@@ -45,14 +50,20 @@ def main(argv=None):
     prompt_len = jnp.full((args.batch,), args.prompt_len, jnp.int32)
     sampling = (SamplingConfig(greedy=True) if args.temperature == 0
                 else SamplingConfig(temperature=args.temperature, top_k=50))
+    # clamp the ceiling so long prompts keep working with default flags
+    buckets = generate_buckets(args.min_bucket,
+                               max(args.max_bucket, args.prompt_len))
+    print(f"prompt buckets: {buckets}")
 
     # warmup (compile prefill + decode)
     toks = generate(mcfg, params, jnp.asarray(prompts), prompt_len,
-                    max_new_tokens=args.max_new, sampling=sampling)
+                    max_new_tokens=args.max_new, sampling=sampling,
+                    buckets=buckets)
     jax.block_until_ready(toks)
     t0 = time.perf_counter()
     toks = generate(mcfg, params, jnp.asarray(prompts), prompt_len,
-                    max_new_tokens=args.max_new, sampling=sampling)
+                    max_new_tokens=args.max_new, sampling=sampling,
+                    buckets=buckets)
     jax.block_until_ready(toks)
     dt = time.perf_counter() - t0
     total = args.batch * args.max_new

@@ -503,3 +503,56 @@ def test_llama_cp_ring_pallas_model_path():
         mesh, in_specs=(P(), P(None, "cp"), P(None, "cp")),
         out_specs=P()))(params, batch_ids, labels)
     assert np.isfinite(float(tr)) and abs(float(tr) - sharded) > 1e-6
+
+
+@pytest.mark.slow
+def test_mixtral_cp_training_matches_dense():
+    """CP x MoE: Mixtral (which reuses the llama attention CP dispatch)
+    under tp=2 x cp=2 matches the dense model's loss and grads. Dropless
+    (blockwise) dispatch is sharding-invariant, so parity is exact once
+    the load-balance aux loss is off — that term is NONLINEAR in the
+    token grouping (per-expert token fractions are computed per shard, as
+    in the reference's per-rank aux), so the cp-sharded aux legitimately
+    differs from the dense one by O(1e-3); the z-loss is a plain token
+    mean and stays on."""
+    from neuronx_distributed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                        tiny_moe_config)
+    from neuronx_distributed_tpu.parallel import grads as grads_mod
+    from neuronx_distributed_tpu.pipeline import spmd_engine as eng
+    from neuronx_distributed_tpu.trainer import initialize_parallel_model
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=2, context_parallel_size=2)
+    mesh = ps.get_mesh()
+    mcfg = tiny_moe_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                           num_layers=2, tp_size=2,
+                           moe_dispatch="blockwise", moe_block_size=16,
+                           router_aux_coef=0.0)
+    model = MixtralForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(30), (4, 33), 0,
+                             mcfg.vocab_size)
+    batch_ids, labels = ids[:, :-1], ids[:, 1:]
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(31),
+                                           batch_ids)
+    host = jax.tree_util.tree_map(np.asarray, params)
+    dense_loss, dense_grads = jax.value_and_grad(
+        lambda p: model.apply(p, batch_ids, labels, method="loss"))(host)
+
+    def inner(p, i, lb):
+        def local_loss(p):
+            return eng.data_parallel_mean(
+                model.apply(p, i, lb, method="loss"))
+
+        loss, g = jax.value_and_grad(local_loss)(p)
+        return loss, grads_mod.allreduce_gradients(g, specs=pm.param_specs)
+
+    loss, grads = jax.jit(ps.shard_map(
+        inner, mesh,
+        in_specs=(pm.param_specs, P("dp", "cp"), P("dp", "cp")),
+        out_specs=(P(), pm.param_specs)))(params, batch_ids, labels)
+    np.testing.assert_allclose(float(loss), float(dense_loss), rtol=2e-4)
+    flat_ref = dict(jax.tree_util.tree_leaves_with_path(dense_grads))
+    for path, g in jax.tree_util.tree_leaves_with_path(grads):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(flat_ref[path]), rtol=5e-3,
+            atol=5e-5, err_msg=jax.tree_util.keystr(path))
