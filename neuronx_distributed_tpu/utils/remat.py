@@ -17,6 +17,9 @@ Policy guide (v5e, 350M llama slice, bs=8 seq=2048, measured r3):
   ``ops/flash_attention.py::_flash_pallas_vjp_fwd``) so the backward skips
   re-running the attention forward kernel — the single biggest recompute
   item (~13% of step compute at bench shapes);
+* ``"dots_and_attention"`` — the union of "dots" and "save_attention"
+  (``save_from_both_policies``): both measured levers at once, for when
+  activation memory allows (``tpu_bench_sweep.py`` has its sweep column);
 * any other name resolves via ``getattr(jax.checkpoint_policies, name)``.
 """
 
@@ -35,9 +38,17 @@ _NAMED = {
     "save_attention": ("flash_out", "flash_lse"),
 }
 
+# unions of other registry entries (save_from_both_policies)
+_COMBINED = {
+    "dots_and_attention": ("dots", "save_attention"),
+}
+
 
 def resolve_remat_policy(name: str = "nothing"):
     """Policy name -> jax.checkpoint policy callable."""
+    if name in _COMBINED:
+        return jax.checkpoint_policies.save_from_both_policies(
+            *(resolve_remat_policy(part) for part in _COMBINED[name]))
     if name in _NAMED:
         return jax.checkpoint_policies.save_only_these_names(*_NAMED[name])
     resolved = _ALIASES.get(name, name)
@@ -46,8 +57,8 @@ def resolve_remat_policy(name: str = "nothing"):
     except AttributeError as e:
         raise ValueError(
             f"unknown remat policy {name!r} (aliases: "
-            f"{sorted(_ALIASES) + sorted(_NAMED)}; else any "
-            "jax.checkpoint_policies name)") from e
+            f"{sorted(_ALIASES) + sorted(_NAMED) + sorted(_COMBINED)}; "
+            "else any jax.checkpoint_policies name)") from e
 
 
 def validate_remat_policy(name: str) -> None:

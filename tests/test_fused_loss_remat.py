@@ -160,10 +160,11 @@ def _pallas_cfg(**kw):
     return tiny_config(**base)
 
 
-def test_save_attention_grads_match_nothing():
+@pytest.mark.parametrize("policy", ["save_attention", "dots_and_attention"])
+def test_remat_policy_grads_match_nothing(policy):
     ps.initialize_model_parallel(tensor_model_parallel_size=1)
     cfg_n = _pallas_cfg(remat_policy="nothing")
-    cfg_s = _pallas_cfg(remat_policy="save_attention")
+    cfg_s = _pallas_cfg(remat_policy=policy)
     ids, labels = _batch(cfg_n, b=1, s=64)
     from flax.core import meta
 
@@ -187,13 +188,15 @@ def _saved_residual_report(cfg, params, ids, labels):
     return buf.getvalue()
 
 
-def test_save_attention_saves_flash_residuals():
+@pytest.mark.parametrize("policy", ["save_attention", "dots_and_attention"])
+def test_remat_policy_saves_flash_residuals(policy):
     """The policy must actually pin the flash out+lse across fwd→bwd at
     MODEL level (not just in a direct kernel call) — the silent-no-op
-    regression mode flagged in VERDICT r4 weak #3 / ADVICE r4 #3."""
+    regression mode flagged in VERDICT r4 weak #3 / ADVICE r4 #3. The
+    combined dots_and_attention union must keep the named residuals."""
     ps.initialize_model_parallel(tensor_model_parallel_size=1)
     cfg_n = _pallas_cfg(remat_policy="nothing")
-    cfg_s = _pallas_cfg(remat_policy="save_attention")
+    cfg_s = _pallas_cfg(remat_policy=policy)
     ids, labels = _batch(cfg_n, b=1, s=64)
     from flax.core import meta
 
@@ -207,7 +210,7 @@ def test_save_attention_saves_flash_residuals():
     assert "f32[2,1,2,64]" not in rep_n and "f32[2,1,64,2,128]" not in rep_n
     assert "f32[2,1,2,64]" in rep_s, rep_s
     assert "f32[2,1,64,2,128]" in rep_s, rep_s
-    # save_attention strictly grows the saved set
+    # the policy strictly grows the saved set
     assert len(rep_s.splitlines()) > len(rep_n.splitlines())
 
 

@@ -73,5 +73,7 @@ if __name__ == "__main__":
         (False, 8, 2048, "nothing", 512),
         (True, 16, 2048, "save_attention", 512),
         (True, 32, 2048, "save_attention", 512),
+        (True, 8, 2048, "dots", 512),
+        (True, 8, 2048, "dots_and_attention", 512),
     ]:
         run_config(remat, batch, seq, remat_policy=pol, loss_chunk=chunk)
