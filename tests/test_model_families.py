@@ -124,6 +124,27 @@ def test_bert_neox_flash_attention_parity():
                                    err_msg=ctor.__name__)
 
 
+def test_vit_flash_attention_parity():
+    """ViT: bidirectional, odd sequence length (N patches + CLS = 5) —
+    same logits with use_flash_attention on and off."""
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.models.vit import (ViTForImageClassification,
+                                                    tiny_vit_config)
+
+    nxd.neuronx_distributed_config()
+    base = tiny_vit_config(dtype=jnp.float32, param_dtype=jnp.float32)
+    flash = tiny_vit_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                            use_flash_attention=True)
+    px = jax.random.normal(jax.random.key(2), (2, 3, 16, 16))
+    params = meta.unbox(
+        ViTForImageClassification(base).init(jax.random.key(3), px))
+    ref = ViTForImageClassification(base).apply(params, px)
+    got = ViTForImageClassification(flash).apply(params, px)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4, err_msg="ViT")
+
+
 @pytest.mark.slow
 def test_vit_trains():
     """ViT family (reference examples/inference/vit): image classification
