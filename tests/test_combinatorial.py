@@ -103,16 +103,19 @@ def _cp_grad_fn(model, pm):
 
 
 CP_MATRIX = [
-    # (tp, cp, zero1, remat)
-    (1, 2, True, False),   # cp x zero1 (opt state over dp x cp)
-    (2, 2, True, True),
-    (1, 4, False, False),
-    (2, 4, False, False),
+    # (tp, cp, zero1, remat, impl)
+    (1, 2, True, False, "ring"),   # cp x zero1 (opt state over dp x cp)
+    (2, 2, True, True, "ring"),
+    (1, 4, False, False, "ring"),
+    (2, 4, False, False, "ring"),
+    (2, 2, True, False, "ulysses"),     # cp impl x zero1 interactions
+    (2, 2, False, True, "ring_pallas"),  # falls back on tiny head_dim;
+                                         # pins config x remat plumbing
 ]
 
 
-@pytest.mark.parametrize("tp,cp,zero1,remat", CP_MATRIX)
-def test_cp_matrix_one_step(tp, cp, zero1, remat):
+@pytest.mark.parametrize("tp,cp,zero1,remat,impl", CP_MATRIX)
+def test_cp_matrix_one_step(tp, cp, zero1, remat, impl):
     from jax.sharding import PartitionSpec as P
 
     cfg = nxd.neuronx_distributed_config(
@@ -121,7 +124,8 @@ def test_cp_matrix_one_step(tp, cp, zero1, remat):
         activation_checkpoint_config=nxd.ActivationCheckpointConfig(
             mode="full" if remat else "none"))
     mcfg = nxd.configure_model(cfg, tiny_config(
-        dtype=jnp.float32, param_dtype=jnp.float32, num_layers=2))
+        dtype=jnp.float32, param_dtype=jnp.float32, num_layers=2,
+        cp_attn_impl=impl))
     model = LlamaForCausalLM(mcfg)
     dp = 8 // (tp * cp)
     ids = jax.random.randint(jax.random.key(0), (max(2, 2 * dp), 33), 0,
@@ -133,7 +137,7 @@ def test_cp_matrix_one_step(tp, cp, zero1, remat):
     step = make_train_step(pm, tx, sh, grad_fn=_cp_grad_fn(model, pm),
                            batch_spec=P("dp", "cp"))
     state, metrics = step(state, batch)
-    assert np.isfinite(float(metrics["loss"])), (tp, cp, zero1, remat)
+    assert np.isfinite(float(metrics["loss"])), (tp, cp, zero1, remat, impl)
 
 
 EP_MATRIX = [
