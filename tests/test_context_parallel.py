@@ -13,24 +13,26 @@ from neuronx_distributed_tpu.ops.ring_attention import ring_attention
 from neuronx_distributed_tpu.parallel import mesh as ps
 
 
-def test_ring_attention_matches_dense():
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(causal):
     mesh = ps.initialize_model_parallel(context_parallel_size=4)
     b, s, n, d = 2, 32, 4, 8
     ks = jax.random.split(jax.random.key(0), 3)
     q = jax.random.normal(ks[0], (b, s, n, d))
     k = jax.random.normal(ks[1], (b, s, n, d))
     v = jax.random.normal(ks[2], (b, s, n, d))
-    ref = sdpa_reference(q, k, v, causal=True)
+    ref = sdpa_reference(q, k, v, causal=causal)
 
     out = jax.jit(ps.shard_map(
-        lambda q, k, v: ring_attention(q, k, v), mesh,
+        lambda q, k, v: ring_attention(q, k, v, causal=causal), mesh,
         in_specs=(P(None, "cp", None, None),) * 3,
         out_specs=P(None, "cp", None, None)))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
 
-def test_ring_attention_grads_match_dense():
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_grads_match_dense(causal):
     mesh = ps.initialize_model_parallel(context_parallel_size=2)
     b, s, n, d = 1, 16, 2, 4
     ks = jax.random.split(jax.random.key(1), 3)
@@ -39,7 +41,7 @@ def test_ring_attention_grads_match_dense():
     v = jax.random.normal(ks[2], (b, s, n, d))
 
     dense_g = jax.grad(lambda q, k, v: jnp.sum(
-        sdpa_reference(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(
+        sdpa_reference(q, k, v, causal=causal) ** 2), argnums=(0, 1, 2))(
             q, k, v)
 
     def inner(q, k, v):
@@ -47,7 +49,7 @@ def test_ring_attention_grads_match_dense():
         # pmean-over-data-axes convention (see parallel/grads.py): ct = 1
         # per shard, so grads equal the dense sum-loss grads exactly
         return jax.grad(lambda q, k, v: jax.lax.pmean(jnp.sum(
-            ring_attention(q, k, v) ** 2), "cp"),
+            ring_attention(q, k, v, causal=causal) ** 2), "cp"),
             argnums=(0, 1, 2))(q, k, v)
 
     g = jax.jit(ps.shard_map(
