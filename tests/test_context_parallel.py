@@ -166,9 +166,11 @@ def test_ring_attention_pallas_matches_xla():
                                    rtol=1e-4, atol=5e-5)
 
 
-def test_ulysses_attention_matches_dense():
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_dense(causal):
     """All-to-all (Ulysses) context parallelism: fwd + grads == dense
-    (the second CP strategy next to ring attention)."""
+    (the second CP strategy next to ring attention; causal=False is the
+    BERT-style bidirectional variant)."""
     from neuronx_distributed_tpu.ops.ulysses import ulysses_attention
 
     mesh = ps.initialize_model_parallel(context_parallel_size=4)
@@ -177,22 +179,22 @@ def test_ulysses_attention_matches_dense():
     q = jax.random.normal(ks[0], (b, s, n, d))
     k = jax.random.normal(ks[1], (b, s, n, d))
     v = jax.random.normal(ks[2], (b, s, n, d))
-    ref = sdpa_reference(q, k, v, causal=True)
+    ref = sdpa_reference(q, k, v, causal=causal)
 
     out = jax.jit(ps.shard_map(
-        lambda q, k, v: ulysses_attention(q, k, v), mesh,
+        lambda q, k, v: ulysses_attention(q, k, v, causal=causal), mesh,
         in_specs=(P(None, "cp", None, None),) * 3,
         out_specs=P(None, "cp", None, None)))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
     dense_g = jax.grad(lambda q, k, v: jnp.sum(
-        sdpa_reference(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(
+        sdpa_reference(q, k, v, causal=causal) ** 2), argnums=(0, 1, 2))(
             q, k, v)
 
     def inner(q, k, v):
         return jax.grad(lambda q, k, v: jax.lax.pmean(jnp.sum(
-            ulysses_attention(q, k, v) ** 2), "cp"),
+            ulysses_attention(q, k, v, causal=causal) ** 2), "cp"),
             argnums=(0, 1, 2))(q, k, v)
 
     g = jax.jit(ps.shard_map(
