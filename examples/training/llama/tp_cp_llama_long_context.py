@@ -1,0 +1,117 @@
+"""Llama long-context pretraining with TP x CP (ring attention).
+
+The long-context analogue of the reference's llama launchers: the sequence
+is sliced over the ``cp`` mesh axis and attention runs as a KV ring
+(``ops/ring_attention.py``; reference ``kernels/ring_attention_kernel.py``)
+or Ulysses all-to-all resharding — so max_seq_len scales with the cp
+degree at fixed per-chip activation memory.
+
+    python examples/training/llama/tp_cp_llama_long_context.py \
+        --model 7b --tp 4 --cp 2 --batch 2 --seq 16384 --steps 100
+    python examples/training/llama/tp_cp_llama_long_context.py \
+        --cp-impl ulysses --attention-dropout 0.1
+
+Synthetic data; for real token streams see the native-loader plumbing in
+``tp_zero1_llama_pretrain.py`` (the batch layout is identical — the CP
+slice happens inside the sharded step via ``batch_spec=P("dp", "cp")``).
+"""
+
+import argparse
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.models import llama
+from neuronx_distributed_tpu.parallel import grads as grads_mod
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.pipeline import spmd_engine as eng
+from neuronx_distributed_tpu.trainer import (initialize_parallel_model,
+                                             initialize_parallel_optimizer,
+                                             make_train_step)
+from neuronx_distributed_tpu.trainer.loop import MetricsLogger, Trainer
+
+MODELS = {
+    "tiny": llama.tiny_config(),
+    "7b": llama.LLAMA2_7B,
+    "8b": llama.LLAMA3_8B,
+    "70b": llama.LLAMA2_70B,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny", choices=sorted(MODELS))
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--cp", type=int, default=2)
+    ap.add_argument("--cp-impl", default="ring",
+                    choices=["ring", "ring_pallas", "ulysses"])
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--attention-dropout", type=float, default=0.0)
+    args = ap.parse_args(argv)
+
+    cfg = nxd.neuronx_distributed_config(
+        tensor_parallel_size=args.tp, context_parallel_size=args.cp,
+        optimizer_config=nxd.OptimizerConfig(zero_one_enabled=True),
+        activation_checkpoint_config=nxd.ActivationCheckpointConfig(
+            mode="full"))
+    mcfg = nxd.configure_model(cfg, MODELS[args.model])
+    mcfg = dataclasses.replace(mcfg, max_seq_len=args.seq,
+                               cp_attn_impl=args.cp_impl,
+                               attention_dropout=args.attention_dropout)
+    model = llama.LlamaForCausalLM(mcfg)
+    mesh = ps.get_mesh()
+
+    rng = np.random.RandomState(0)
+
+    def batches():
+        while True:
+            ids = rng.randint(0, mcfg.vocab_size,
+                              (args.batch, args.seq + 1))
+            yield {"input_ids": jnp.asarray(ids[:, :-1]),
+                   "labels": jnp.asarray(ids[:, 1:])}
+
+    data = batches()
+    sample = next(data)
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(0),
+                                           sample["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, args.lr)
+
+    # CP slices the sequence INSIDE the sharded step: grads are computed
+    # per shard under shard_map then averaged over the data axes (same
+    # pattern the cp dryrun phase and tests pin)
+    def grad_fn(p, batch):
+        def inner(p, i, lb):
+            def local_loss(p):
+                apply_kw = {}
+                if args.attention_dropout > 0.0:
+                    apply_kw["rngs"] = {"dropout": jax.random.key(7)}
+                return eng.data_parallel_mean(
+                    model.apply(p, i, lb, method="loss", **apply_kw))
+
+            loss, g = jax.value_and_grad(local_loss)(p)
+            return loss, grads_mod.allreduce_gradients(
+                g, specs=pm.param_specs)
+
+        return ps.shard_map(
+            inner, mesh,
+            in_specs=(pm.param_specs, P("dp", "cp"), P("dp", "cp")),
+            out_specs=(P(), pm.param_specs))(
+                p, batch["input_ids"], batch["labels"])
+
+    step = make_train_step(pm, tx, sh, grad_fn=grad_fn,
+                           batch_spec=P("dp", "cp"))
+    trainer = Trainer(step, state, callbacks=[MetricsLogger(every=5)])
+    trainer.fit(data, max_steps=args.steps)
+    print(f"done: cp={args.cp} impl={args.cp_impl} seq={args.seq} "
+          f"(S/chip={args.seq // args.cp})")
+
+
+if __name__ == "__main__":
+    main()

@@ -79,21 +79,29 @@ def test_gpt_neox_tp_shard_map_parity():
     np.testing.assert_allclose(float(sharded), float(dense), rtol=2e-4)
 
 
+def _run_example(subpath, argv):
+    """Load an examples/ launcher by path and run its main(argv)
+    (cf. tests/test_serving_examples.py::_run for the inference side)."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        *subpath.split("/"))
+    spec = importlib.util.spec_from_file_location(
+        os.path.basename(path)[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main(argv)
+
+
 @pytest.mark.slow
 def test_dbrx_launcher_smoke():
     """The DBRX example launcher (VERDICT r2 missing #10; reference
     examples/training/dbrx): TP x PP(1F1B) x dropless experts runs end to
     end at tiny scale."""
-    import importlib.util
-    import os
-
-    path = os.path.join(os.path.dirname(__file__), "..", "examples",
-                        "training", "dbrx", "tp_pp_ep_dbrx_pretrain.py")
-    spec = importlib.util.spec_from_file_location("dbrx_launcher", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    mod.main(["--tiny", "--tp", "2", "--pp", "2", "--microbatches", "2",
-              "--batch", "8", "--seq", "32", "--steps", "2"])
+    _run_example("training/dbrx/tp_pp_ep_dbrx_pretrain.py",
+                 ["--tiny", "--tp", "2", "--pp", "2", "--microbatches", "2",
+                  "--batch", "8", "--seq", "32", "--steps", "2"])
 
 
 def test_bert_neox_flash_attention_parity():
@@ -193,3 +201,17 @@ def test_vit_tp_shard_map_parity():
         in_specs=(pm.param_specs, P(), P()),
         out_specs=P()))(params, px, labels)
     np.testing.assert_allclose(float(sharded), float(dense), rtol=2e-4)
+
+
+@pytest.mark.slow
+def test_cp_launcher_smoke(capsys):
+    """The long-context TP x CP example launcher runs end to end at tiny
+    scale for both ring and ulysses impls (with dropout on the ring run)."""
+    _run_example("training/llama/tp_cp_llama_long_context.py",
+                 ["--tp", "2", "--cp", "2", "--batch", "4", "--seq", "64",
+                  "--steps", "3", "--attention-dropout", "0.1"])
+    assert "cp=2 impl=ring" in capsys.readouterr().out
+    _run_example("training/llama/tp_cp_llama_long_context.py",
+                 ["--tp", "2", "--cp", "2", "--cp-impl", "ulysses",
+                  "--batch", "4", "--seq", "64", "--steps", "3"])
+    assert "impl=ulysses" in capsys.readouterr().out
