@@ -1,0 +1,28 @@
+"""nxdlint — JAX/SPMD-aware static analysis for neuronx_distributed_tpu.
+
+An AST-based linter for the stringly-typed invariants the Python toolchain
+never checks: mesh-axis names, trace-safety of host operations, custom_vjp
+fwd/bwd pairing, and jit recompilation hazards. See ``docs/analysis.md``.
+
+Run it::
+
+    python -m neuronx_distributed_tpu.analysis neuronx_distributed_tpu/
+
+Suppress a finding in code::
+
+    x = np.float32(scale)  # nxdlint: disable=trace-safety  -- host constant
+"""
+
+from .core import (DEFAULT_AXES, Finding, LintContext, Rule, all_rules,
+                   analyze_paths, analyze_source, parse_suppressions)
+
+__all__ = [
+    "DEFAULT_AXES",
+    "Finding",
+    "LintContext",
+    "Rule",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+    "parse_suppressions",
+]

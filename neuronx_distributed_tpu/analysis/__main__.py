@@ -1,0 +1,74 @@
+"""CLI for nxdlint: ``python -m neuronx_distributed_tpu.analysis [paths]``.
+
+Exit status: 0 when no unsuppressed findings, 1 when findings remain,
+2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .core import all_rules, analyze_paths
+
+
+def _split(csv: Optional[str]) -> Optional[List[str]]:
+    if csv is None:
+        return None
+    return [s.strip() for s in csv.split(",") if s.strip()]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m neuronx_distributed_tpu.analysis",
+        description="nxdlint: JAX/SPMD-aware static analysis "
+                    "(mesh-axis, trace-safety, custom-vjp, "
+                    "recompile-hazard)")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to lint")
+    parser.add_argument("--select", metavar="RULES",
+                        help="comma-separated rules to run (default: all)")
+    parser.add_argument("--disable", metavar="RULES", default=None,
+                        help="comma-separated rules to skip")
+    parser.add_argument("--extra-axes", metavar="AXES", default=None,
+                        help="comma-separated additional canonical axis "
+                             "names (also settable via [tool.nxdlint] "
+                             "extra_axes in pyproject.toml)")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print registered rules and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, rule in sorted(all_rules().items()):
+            print(f"{name}: {rule.description}")
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        print("error: no paths given", file=sys.stderr)
+        return 2
+
+    try:
+        findings = analyze_paths(
+            args.paths,
+            select=_split(args.select),
+            disable=_split(args.disable) or (),
+            extra_axes=_split(args.extra_axes) or ())
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    active = [f for f in findings if not f.suppressed]
+    shown = findings if args.show_suppressed else active
+    for f in shown:
+        print(f.format())
+    n_sup = len(findings) - len(active)
+    print(f"nxdlint: {len(active)} finding(s), {n_sup} suppressed",
+          file=sys.stderr)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Shared AST helpers for the nxdlint rules.
+
+Everything here is stdlib-only: the analyzer must be able to lint a file
+without importing it (a file whose import would initialise a TPU backend,
+or one with a syntax error two lines below the bug being reported).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Tuple, Union
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.lax.psum`` for an Attribute chain, ``psum`` for a Name, else
+    None (calls of calls, subscripts, ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def tail_name(node: ast.AST) -> Optional[str]:
+    """Last component of a dotted callable name: ``jax.lax.psum`` -> ``psum``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """First component of a dotted name: ``np.sum`` -> ``np``."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def call_tail(call: ast.Call) -> Optional[str]:
+    return tail_name(call.func)
+
+
+def iter_str_constants(expr: ast.AST) -> Iterator[ast.Constant]:
+    """Every string-literal node inside ``expr`` (descends tuples/lists but
+    not into nested calls — a nested call is its own site)."""
+    if isinstance(expr, ast.Constant):
+        if isinstance(expr.value, str):
+            yield expr
+    elif isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        for e in expr.elts:
+            yield from iter_str_constants(e)
+
+
+# Names whose decoration means "this function's body is traced by JAX".
+JIT_NAMES = frozenset({"jit", "pjit"})
+
+
+def _is_jit_callable_ref(node: ast.AST) -> bool:
+    return tail_name(node) in JIT_NAMES
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    """@jit / @jax.jit / @nn.jit / @partial(jax.jit, ...) /
+    @jax.jit(static_argnums=...)."""
+    if _is_jit_callable_ref(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_callable_ref(dec.func):
+            return True  # @jax.jit(static_argnums=...)
+        if tail_name(dec.func) == "partial" and dec.args \
+                and _is_jit_callable_ref(dec.args[0]):
+            return True
+    return False
+
+
+def int_tuple_values(expr: Optional[ast.AST]) -> Optional[List[int]]:
+    """Literal ints from a tuple/list/bare-int expression, else None."""
+    if expr is None:
+        return None
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for e in expr.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return [expr.value]
+    return None
+
+
+def str_tuple_values(expr: Optional[ast.AST]) -> List[str]:
+    if expr is None:
+        return []
+    return [c.value for c in iter_str_constants(expr)]
+
+
+def jit_static_param_names(dec: ast.AST, func: FuncNode) -> set:
+    """Parameter names a jit-like decorator marks static
+    (``static_argnames`` / ``static_argnums`` on ``@jax.jit(...)`` or
+    ``@partial(jax.jit, ...)``)."""
+    if not isinstance(dec, ast.Call):
+        return set()
+    names = set(str_tuple_values(get_kwarg(dec, "static_argnames")))
+    nums = int_tuple_values(get_kwarg(dec, "static_argnums")) or []
+    params = positional_args(func)
+    for i in nums:
+        if 0 <= i < len(params):
+            names.add(params[i].arg)
+    return names
+
+
+def get_kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def positional_args(func: FuncNode) -> List[ast.arg]:
+    a = func.args
+    return list(a.posonlyargs) + list(a.args)
+
+
+def arg_names(func: FuncNode) -> List[str]:
+    a = func.args
+    names = [x.arg for x in positional_args(func)] + \
+            [x.arg for x in a.kwonlyargs]
+    return names
+
+
+def walk_stop_at_functions(node: ast.AST) -> Iterator[ast.AST]:
+    """Like ast.walk over a function body, but does not descend into nested
+    function/class definitions (their scopes are analyzed separately)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                          ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))

@@ -1,0 +1,298 @@
+"""nxdlint core: findings, rule registry, suppressions, config, file walk.
+
+The analyzer is purely syntactic (``ast``): it never imports the code under
+analysis, so it can lint files whose import would initialise an accelerator
+backend, and it runs in milliseconds in CI. Rules register themselves into
+:data:`_RULES` via :func:`register`; :func:`analyze_paths` is the single
+entry point used by both the CLI (``__main__``) and the self-lint test.
+
+Suppressions
+------------
+``# nxdlint: disable=<rule>[,<rule>...]`` on the offending line (or on a
+standalone comment line directly above it) marks findings of those rules on
+that line as suppressed. ``disable=all`` suppresses every rule.
+``# nxdlint: disable-file=<rule>`` anywhere in the file suppresses the rule
+for the whole file. Suppressed findings are retained (``Finding.suppressed``)
+so tooling can audit them, but they do not fail the CLI.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import (Callable, Dict, FrozenSet, Iterable, Iterator, List,
+                    Optional, Sequence, Set, Tuple)
+
+#: Fallback canonical mesh-axis names, kept in sync with
+#: ``parallel/mesh.py`` — used only when the scanned tree does not contain
+#: a ``parallel/mesh.py`` to read the ``*_AXIS`` constants from.
+DEFAULT_AXES: FrozenSet[str] = frozenset(
+    {"pp", "dp", "cp", "tp", "ep", "dp_exp"})
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}{tag}")
+
+
+@dataclasses.dataclass
+class LintContext:
+    """Per-file state handed to every rule."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    axes: FrozenSet[str]
+
+
+RuleFn = Callable[[LintContext], Iterator[Finding]]
+
+
+@dataclasses.dataclass
+class Rule:
+    name: str
+    description: str
+    check: RuleFn
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(name: str, description: str) -> Callable[[RuleFn], RuleFn]:
+    def deco(fn: RuleFn) -> RuleFn:
+        if name in _RULES:
+            raise ValueError(f"duplicate rule name {name!r}")
+        _RULES[name] = Rule(name, description, fn)
+        return fn
+    return deco
+
+
+def all_rules() -> Dict[str, Rule]:
+    _ensure_rules_loaded()
+    return dict(_RULES)
+
+
+def _ensure_rules_loaded() -> None:
+    # Import for side effect (registration). Local import breaks the cycle
+    # core -> rules -> core.
+    from . import (rules_custom_vjp,  # noqa: F401
+                   rules_mesh_axes,  # noqa: F401
+                   rules_recompile,  # noqa: F401
+                   rules_trace_safety)  # noqa: F401
+
+
+# --------------------------------------------------------------------------
+# Suppression comments
+# --------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*nxdlint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+def parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """``(per_line, file_level)``; ``per_line`` maps 1-based line numbers to
+    the set of rule names disabled there."""
+    per_line: Dict[int, Set[str]] = {}
+    file_level: Set[str] = set()
+    for i, ln in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(ln)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        if m.group("file"):
+            file_level |= rules
+        else:
+            per_line.setdefault(i, set()).update(rules)
+            if ln.lstrip().startswith("#"):
+                # standalone comment line: also covers the next line
+                per_line.setdefault(i + 1, set()).update(rules)
+    return per_line, file_level
+
+
+def _is_suppressed(f: Finding, per_line: Dict[int, Set[str]],
+                   file_level: Set[str]) -> bool:
+    def hit(rules: Set[str]) -> bool:
+        return f.rule in rules or "all" in rules
+
+    if hit(file_level):
+        return True
+    return hit(per_line.get(f.line, set()))
+
+
+# --------------------------------------------------------------------------
+# Canonical axis discovery + pyproject config
+# --------------------------------------------------------------------------
+
+def axes_from_mesh_source(source: str) -> FrozenSet[str]:
+    """Extract ``X_AXIS = "name"`` constants from ``parallel/mesh.py``."""
+    axes: Set[str] = set()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return frozenset()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Name) and tgt.id.endswith("_AXIS")
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                axes.add(node.value.value)
+    return frozenset(axes)
+
+
+def _find_mesh_py(paths: Sequence[str]) -> Optional[str]:
+    """Locate ``parallel/mesh.py`` under (or next to) the scanned paths so
+    the canonical axis set tracks the source of truth automatically."""
+    seen: Set[str] = set()
+    for p in paths:
+        root = p if os.path.isdir(p) else os.path.dirname(p) or "."
+        # look in the scan root and up to two parents (linting a submodule
+        # like ops/ still finds the sibling parallel/mesh.py)
+        for up in range(3):
+            cand = os.path.join(root, "parallel", "mesh.py")
+            if cand not in seen:
+                seen.add(cand)
+                if os.path.isfile(cand):
+                    return cand
+            root = os.path.dirname(root) or "."
+    return None
+
+
+_TOML_LIST_RE = re.compile(r"^\s*(?P<key>[A-Za-z_]+)\s*=\s*\[(?P<body>[^\]]*)\]")
+
+
+def load_pyproject_config(start: str) -> Dict[str, List[str]]:
+    """Minimal ``[tool.nxdlint]`` reader (py3.10: no tomllib). Supported
+    keys: ``extra_axes``, ``disable`` — both lists of strings."""
+    d = os.path.abspath(start if os.path.isdir(start)
+                        else os.path.dirname(start) or ".")
+    pyproject = None
+    while True:
+        cand = os.path.join(d, "pyproject.toml")
+        if os.path.isfile(cand):
+            pyproject = cand
+            break
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    cfg: Dict[str, List[str]] = {}
+    if pyproject is None:
+        return cfg
+    in_section = False
+    try:
+        with open(pyproject, "r", encoding="utf-8") as fh:
+            for ln in fh:
+                s = ln.strip()
+                if s.startswith("["):
+                    in_section = (s == "[tool.nxdlint]")
+                    continue
+                if not in_section:
+                    continue
+                m = _TOML_LIST_RE.match(ln)
+                if m:
+                    vals = re.findall(r"[\"']([^\"']+)[\"']",
+                                      m.group("body"))
+                    cfg[m.group("key")] = vals
+    except OSError:
+        pass
+    return cfg
+
+
+# --------------------------------------------------------------------------
+# Analysis entry points
+# --------------------------------------------------------------------------
+
+def analyze_source(source: str, path: str, axes: FrozenSet[str],
+                   rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    _ensure_rules_loaded()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, e.offset or 0, "parse-error",
+                        f"syntax error: {e.msg}")]
+    ctx = LintContext(path=path, source=source, tree=tree, axes=axes)
+    per_line, file_level = parse_suppressions(source)
+    active = (_RULES.keys() if rules is None else rules)
+    findings: List[Finding] = []
+    for name in active:
+        rule = _RULES[name]
+        for f in rule.check(ctx):
+            f.suppressed = _is_suppressed(f, per_line, file_level)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(".")
+                                 and d != "__pycache__")
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def analyze_paths(paths: Sequence[str],
+                  select: Optional[Iterable[str]] = None,
+                  disable: Iterable[str] = (),
+                  extra_axes: Iterable[str] = ()) -> List[Finding]:
+    """Lint every ``.py`` file under ``paths``. Returns ALL findings; the
+    caller decides what to do with suppressed ones."""
+    _ensure_rules_loaded()
+    if not paths:
+        raise ValueError("no paths to analyze")
+    cfg = load_pyproject_config(paths[0])
+    axes: Set[str] = set(DEFAULT_AXES)
+    mesh_py = _find_mesh_py(paths)
+    if mesh_py is not None:
+        try:
+            with open(mesh_py, "r", encoding="utf-8") as fh:
+                found = axes_from_mesh_source(fh.read())
+            if found:
+                axes = set(found)
+        except OSError:
+            pass
+    axes.update(cfg.get("extra_axes", ()))
+    axes.update(extra_axes)
+
+    names = set(select) if select is not None else set(_RULES)
+    names -= set(disable)
+    names -= set(cfg.get("disable", ()))
+    unknown = names - set(_RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {sorted(unknown)}; "
+                         f"known: {sorted(_RULES)}")
+
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(path, 1, 0, "parse-error",
+                                    f"cannot read file: {e}"))
+            continue
+        findings.extend(analyze_source(src, path, frozenset(axes),
+                                       rules=sorted(names)))
+    return findings

@@ -1,0 +1,163 @@
+"""Rule ``custom-vjp``: every ``jax.custom_vjp`` must be completed with a
+``defvjp`` call, and the backward's returned tuple must match the primal's
+differentiable-argument count.
+
+A ``custom_vjp`` without ``defvjp`` raises only when someone first
+differentiates through it; a bwd returning the wrong arity raises a shape
+error deep inside backprop on the config that reaches it. Both are paired by
+hand in ``parallel/mappings.py`` and the Pallas kernels — exactly the
+string-typed drift this linter exists to catch.
+
+Checked forms::
+
+    @jax.custom_vjp                      # or @partial(jax.custom_vjp,
+    def f(x, axis): ...                  #       nondiff_argnums=(1,))
+    f.defvjp(f_fwd, f_bwd)
+
+    g = jax.custom_vjp(fn, nondiff_argnums=(0,))
+
+The bwd arity check fires only when the bwd function is defined in the same
+file and returns a literal tuple; anything dynamic is skipped (no false
+positives from conservatively unknown code).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from . import astutil
+from .core import Finding, LintContext, register
+
+
+@dataclasses.dataclass
+class _Primal:
+    name: str
+    node: ast.AST          # def or assignment site (for the finding location)
+    n_args: Optional[int]  # None when unknown (e.g. *args)
+    nondiff: int
+    has_defvjp: bool = False
+    bwd_name: Optional[str] = None
+
+
+def _int_tuple_len(expr: Optional[ast.AST]) -> Optional[int]:
+    """len of a literal tuple/list of ints, 1 for a bare int, else None."""
+    if expr is None:
+        return 0
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return len(expr.elts)
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return 1
+    return None
+
+
+def _custom_vjp_decorator(dec: ast.AST) -> Optional[Optional[int]]:
+    """Returns the nondiff count when ``dec`` is a custom_vjp decorator
+    (0 when none given, None-inner when unparseable), or raises StopIteration
+    semantics via a sentinel: returns None when not a custom_vjp decorator.
+    """
+    if astutil.tail_name(dec) == "custom_vjp":
+        return 0
+    if isinstance(dec, ast.Call):
+        if astutil.tail_name(dec.func) == "custom_vjp":
+            return _int_tuple_len(astutil.get_kwarg(dec, "nondiff_argnums"))
+        if astutil.tail_name(dec.func) == "partial" and dec.args and \
+                astutil.tail_name(dec.args[0]) == "custom_vjp":
+            return _int_tuple_len(astutil.get_kwarg(dec, "nondiff_argnums"))
+    return None
+
+
+def _def_arity(fn: ast.AST) -> Optional[int]:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    if fn.args.vararg is not None:
+        return None
+    return len(astutil.positional_args(fn))
+
+
+def _literal_return_lens(fn: ast.AST) -> List[Tuple[ast.Return, int]]:
+    """(return-node, tuple-len) for every literal-tuple return directly in
+    ``fn`` (nested defs excluded)."""
+    out: List[Tuple[ast.Return, int]] = []
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return out
+    for node in astutil.walk_stop_at_functions(fn):
+        if isinstance(node, ast.Return) and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            out.append((node, len(node.value.elts)))
+    return out
+
+
+@register(
+    "custom-vjp",
+    "jax.custom_vjp primals must call defvjp, and the bwd must return a "
+    "tuple matching the primal's differentiable-argument count")
+def check(ctx: LintContext) -> Iterator[Finding]:
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+
+    primals: Dict[str, _Primal] = {}
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                nd = _custom_vjp_decorator(dec)
+                if nd is None:
+                    continue
+                primals[node.name] = _Primal(
+                    node.name, node, _def_arity(node), nd or 0)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if astutil.tail_name(call.func) != "custom_vjp":
+                continue
+            if len(node.targets) != 1 or \
+                    not isinstance(node.targets[0], ast.Name):
+                continue
+            tgt = node.targets[0].id
+            nd = _int_tuple_len(astutil.get_kwarg(call, "nondiff_argnums"))
+            n_args = None
+            if call.args and isinstance(call.args[0], ast.Name):
+                n_args = _def_arity(defs.get(call.args[0].id))
+            primals[tgt] = _Primal(tgt, node, n_args, nd or 0)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not isinstance(node.func, ast.Attribute) or \
+                node.func.attr != "defvjp":
+            continue
+        owner = node.func.value
+        if not isinstance(owner, ast.Name) or owner.id not in primals:
+            continue
+        p = primals[owner.id]
+        p.has_defvjp = True
+        bwd = astutil.get_kwarg(node, "bwd")
+        if bwd is None and len(node.args) >= 2:
+            bwd = node.args[1]
+        if isinstance(bwd, ast.Name):
+            p.bwd_name = bwd.id
+
+    for p in primals.values():
+        if not p.has_defvjp:
+            yield Finding(
+                ctx.path, p.node.lineno, p.node.col_offset, "custom-vjp",
+                f"custom_vjp {p.name!r} never calls {p.name}.defvjp(fwd, "
+                "bwd) — differentiating through it will raise at trace time")
+            continue
+        if p.n_args is None or p.bwd_name is None:
+            continue
+        bwd_def = defs.get(p.bwd_name)
+        if bwd_def is None:
+            continue
+        expected = p.n_args - p.nondiff
+        for ret, n in _literal_return_lens(bwd_def):
+            if n != expected:
+                yield Finding(
+                    ctx.path, ret.lineno, ret.col_offset, "custom-vjp",
+                    f"bwd {p.bwd_name!r} of custom_vjp {p.name!r} returns a "
+                    f"{n}-tuple but the primal has {expected} "
+                    f"differentiable arg(s) ({p.n_args} args, {p.nondiff} "
+                    "nondiff) — cotangent arity mismatch")

@@ -1,0 +1,132 @@
+"""Rule ``mesh-axis``: every string literal used as a mesh-axis name must be
+one of the canonical axis constants from ``parallel/mesh.py``.
+
+Axis names are stringly-typed invariants threaded through ``PartitionSpec``s,
+``psum``/``axis_index`` calls and ``shard_map`` specs; a typo (``"tp "``,
+``"dp_ep"``) trips only at trace time on the one config that exercises that
+spec. This rule checks, purely syntactically:
+
+* ``PartitionSpec(...)`` / ``P(...)`` arguments (including nested tuples),
+* the axis argument of the named-axis collectives
+  (``psum``/``pmean``/``pmax``/``pmin``/``all_gather``/``ppermute``/
+  ``all_to_all``/``psum_scatter``/``pbroadcast``/``axis_index`` and the
+  in-repo ``comm.*`` wrappers),
+* string literals passed to ``named_sharding`` / ``with_sharding_constraint``
+  (this repo's helpers take bare spec entries),
+* ``Mesh(devices, (...))`` axis-name tuples and ``shard_map`` spec kwargs.
+
+Code that passes an axis through a *variable* (``axis=ps.TP_AXIS``, the
+dominant idiom here) is untouched — the constant definition site is the
+single point of truth.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from . import astutil
+from .core import Finding, LintContext, register
+
+# call-name -> positional index of the axis argument
+_COLLECTIVE_AXIS_POS = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "psum_scatter": 1,
+    "ppermute": 1,
+    "pbroadcast": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "all_reduce": 1,
+    "reduce_scatter": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+}
+
+_AXIS_KWARGS = ("axis_name", "axis")
+
+# every string literal among the args is an axis name
+_SPEC_CALLS = frozenset({"PartitionSpec", "P", "named_sharding",
+                         "with_sharding_constraint"})
+
+_SPEC_KWARG_CALLS = frozenset({"shard_map"})  # in_specs / out_specs kwargs
+
+
+def _check_literal(node: ast.Constant, ctx: LintContext,
+                   where: str) -> Optional[Finding]:
+    name = node.value
+    if name in ctx.axes:
+        return None
+    hint = ""
+    stripped = name.strip()
+    if stripped != name and stripped in ctx.axes:
+        hint = f" (did you mean {stripped!r}?)"
+    return Finding(
+        ctx.path, node.lineno, node.col_offset, "mesh-axis",
+        f"{name!r} used as a mesh-axis name in {where} is not a canonical "
+        f"axis {sorted(ctx.axes)}{hint}")
+
+
+def _check_expr(expr: ast.AST, ctx: LintContext,
+                where: str) -> Iterator[Finding]:
+    for lit in astutil.iter_str_constants(expr):
+        f = _check_literal(lit, ctx, where)
+        if f is not None:
+            yield f
+
+
+@register(
+    "mesh-axis",
+    "string literals used as mesh-axis names must match the canonical axis "
+    "constants exported by parallel/mesh.py")
+def check(ctx: LintContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = astutil.call_tail(node)
+        if tail is None:
+            continue
+
+        if tail in _SPEC_CALLS:
+            skip_first = tail == "with_sharding_constraint"
+            args = node.args[1:] if skip_first else node.args
+            for a in args:
+                yield from _check_expr(a, ctx, f"{tail}(...)")
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                yield from _check_expr(kw.value, ctx, f"{tail}(...)")
+            continue
+
+        if tail == "Mesh":
+            # Mesh(devices, axis_names) / Mesh(devices, ("dp", "tp"))
+            cand = (astutil.get_kwarg(node, "axis_names")
+                    or (node.args[1] if len(node.args) > 1 else None))
+            if cand is not None:
+                yield from _check_expr(cand, ctx, "Mesh axis_names")
+            continue
+
+        if tail in _SPEC_KWARG_CALLS:
+            for kwname in ("in_specs", "out_specs"):
+                kw = astutil.get_kwarg(node, kwname)
+                if kw is None:
+                    continue
+                # raw strings inside spec trees (P(...) calls inside are
+                # their own sites, caught by the _SPEC_CALLS branch)
+                yield from _check_expr(kw, ctx, f"shard_map {kwname}")
+            continue
+
+        if tail in _COLLECTIVE_AXIS_POS:
+            axis_expr: Optional[ast.AST] = None
+            for kwname in _AXIS_KWARGS:
+                axis_expr = astutil.get_kwarg(node, kwname)
+                if axis_expr is not None:
+                    break
+            if axis_expr is None:
+                pos = _COLLECTIVE_AXIS_POS[tail]
+                if len(node.args) > pos:
+                    axis_expr = node.args[pos]
+            if axis_expr is not None:
+                yield from _check_expr(axis_expr, ctx, f"{tail}(...) axis")

@@ -1,0 +1,133 @@
+"""Rule ``recompile-hazard``: jit cache poisons that recompile (or crash)
+per call instead of per shape.
+
+Two shapes:
+
+* **non-hashable / array-valued default arguments** on a jitted function —
+  a ``list``/``dict``/``set`` default crashes when the argument is marked
+  static, and an ``np.array(...)``/``jnp.zeros(...)`` default bakes a fresh
+  constant identity into the signature;
+* **jitted functions reading module-level mutable globals** — the traced
+  value is frozen at first compile, so later mutation silently diverges
+  from eager semantics (or forces a retrace with ``static_argnums``-style
+  hashing of an unhashable).
+
+Only syntactically jit-decorated functions are checked; the factory idiom
+(returning a closure that the caller jits) is out of scope here, and
+captured *immutable* globals (ints, tuples, constants) are fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from . import astutil
+from .core import Finding, LintContext, register
+
+_MUTABLE_DISPLAYS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+
+_MUTABLE_CTORS = frozenset({"list", "dict", "set", "defaultdict",
+                            "OrderedDict", "deque", "Counter"})
+
+_ARRAY_CTORS = frozenset({"array", "asarray", "zeros", "ones", "full",
+                          "arange", "linspace", "empty", "eye"})
+
+_ARRAY_ROOTS = frozenset({"np", "numpy", "jnp", "jax"})
+
+
+def _is_mutable_value(expr: ast.AST) -> bool:
+    if isinstance(expr, _MUTABLE_DISPLAYS):
+        return True
+    if isinstance(expr, ast.Call):
+        return astutil.tail_name(expr.func) in _MUTABLE_CTORS
+    return False
+
+
+def _is_array_value(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call) and \
+            astutil.tail_name(expr.func) in _ARRAY_CTORS:
+        root = astutil.root_name(expr.func)
+        return root in _ARRAY_ROOTS or root is None
+    return False
+
+
+def _jitted_defs(tree: ast.AST) -> List[ast.FunctionDef]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                any(astutil.is_jit_decorator(d) for d in node.decorator_list):
+            out.append(node)
+    return out
+
+
+@register(
+    "recompile-hazard",
+    "non-hashable or array-valued defaults on jitted functions, and jitted "
+    "functions capturing module-level mutable globals")
+def check(ctx: LintContext) -> Iterator[Finding]:
+    # module-level mutable bindings: name -> assignment line
+    mutable_globals: Dict[str, int] = {}
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and _is_mutable_value(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    mutable_globals[tgt.id] = node.lineno
+        elif isinstance(node, ast.AnnAssign) and node.value is not None and \
+                _is_mutable_value(node.value) and \
+                isinstance(node.target, ast.Name):
+            mutable_globals[node.target.id] = node.lineno
+
+    for fn in _jitted_defs(ctx.tree):
+        args = astutil.positional_args(fn)
+        defaults = fn.args.defaults
+        # defaults align with the tail of the positional args
+        for arg, dflt in zip(args[len(args) - len(defaults):], defaults):
+            if _is_mutable_value(dflt):
+                yield Finding(
+                    ctx.path, dflt.lineno, dflt.col_offset,
+                    "recompile-hazard",
+                    f"jitted function {fn.name!r} has a non-hashable "
+                    f"(mutable) default for {arg.arg!r} — unhashable as a "
+                    "static arg and shared across calls")
+            elif _is_array_value(dflt):
+                yield Finding(
+                    ctx.path, dflt.lineno, dflt.col_offset,
+                    "recompile-hazard",
+                    f"jitted function {fn.name!r} has an array-valued "
+                    f"default for {arg.arg!r} — a fresh constant identity "
+                    "per import, a retrace per distinct identity")
+        for arg, dflt in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if dflt is None:
+                continue
+            if _is_mutable_value(dflt) or _is_array_value(dflt):
+                yield Finding(
+                    ctx.path, dflt.lineno, dflt.col_offset,
+                    "recompile-hazard",
+                    f"jitted function {fn.name!r} has a non-hashable or "
+                    f"array-valued default for keyword {arg.arg!r}")
+
+        if not mutable_globals:
+            continue
+        local_names: Set[str] = {a.arg for a in args}
+        local_names.update(a.arg for a in fn.args.kwonlyargs)
+        for node in astutil.walk_stop_at_functions(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = (node.targets if isinstance(node, ast.Assign)
+                        else [node.target])
+                for t in tgts:
+                    if isinstance(t, ast.Name):
+                        local_names.add(t.id)
+        for node in astutil.walk_stop_at_functions(fn):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in mutable_globals and \
+                    node.id not in local_names:
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset,
+                    "recompile-hazard",
+                    f"jitted function {fn.name!r} reads module-level "
+                    f"mutable global {node.id!r} (defined line "
+                    f"{mutable_globals[node.id]}) — its value is frozen "
+                    "into the compiled program at first trace")

@@ -1,0 +1,407 @@
+"""Rule ``trace-safety``: host-side operations on traced values.
+
+Inside a function that JAX traces (``jit``/``shard_map``/``scan``/``cond``/
+``grad``/``checkpoint``/... bodies), values that dataflow from the function's
+parameters are tracers. Calling ``.item()`` / ``float()`` / ``int()`` /
+``bool()`` on them, handing them to ``np.*``, or branching Python control
+flow on them either raises ``TracerConversionError`` at trace time on the
+one config that reaches the line, or silently constant-folds (``np.*`` on a
+concrete-looking tracer aval).
+
+Detection is a conservative name-level taint analysis:
+
+* a function is *traced* when it is decorated with ``jit``-likes, or passed
+  as a callable to a tracing consumer (``shard_map``, ``lax.scan``,
+  ``lax.cond``, ``jax.vjp``, ``jax.checkpoint``/``remat``, ``grad``...);
+* its parameters are tainted; taint propagates through assignments;
+* static accessors sanitize: ``.shape``/``.dtype``/``.ndim``/``.size``,
+  ``len()``, ``jnp.shape()``, ``isinstance()``, ``x is None``, ... — so
+  ``if x.shape[0] % 2:`` is fine while ``if x[0] > 0:`` is flagged.
+
+Functions only *returned* to callers that jit them later (the factory idiom)
+are out of scope — taint starts at the syntactic tracing boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Set
+
+from . import astutil
+from .core import Finding, LintContext, register
+
+# call-name -> positional indices holding traced callables
+_CALLABLE_CONSUMERS: Dict[str, Sequence[int]] = {
+    "jit": (0,),
+    "pjit": (0,),
+    "shard_map": (0,),
+    "scan": (0,),
+    "associative_scan": (0,),
+    "cond": (1, 2),
+    "switch": (1,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "vjp": (0,),
+    "jvp": (0,),
+    "linearize": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+    "eval_shape": (0,),
+    "pallas_call": (0,),
+    "custom_vjp": (0,),
+    "custom_jvp": (0,),
+}
+
+# attribute accesses that yield static (host) values from a tracer
+_STATIC_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "aval",
+                           "sharding", "itemsize", "nbytes", "weak_type"})
+
+# calls whose result is host-static even on tainted args
+_SANITIZING_CALLS = frozenset({"len", "isinstance", "type", "hasattr",
+                               "callable", "shape", "result_type",
+                               "eval_shape", "ndim", "format", "repr",
+                               "str", "id"})
+
+_HOST_COERCIONS = frozenset({"float", "int", "bool", "complex"})
+
+_NUMPY_ROOTS = frozenset({"np", "numpy", "onp"})
+
+# numpy calls that are fine on tracers (metadata / dtype queries)
+_NUMPY_STATIC = frozenset({"dtype", "shape", "ndim", "result_type", "issubdtype",
+                           "iinfo", "finfo", "prod"})
+
+
+def _collect_defs(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def _custom_vjp_nondiff(dec: ast.AST) -> Optional[List[int]]:
+    """nondiff_argnums of a custom_vjp/custom_jvp decorator, [] when the
+    decorator carries none, None when ``dec`` is not such a decorator."""
+    tail = astutil.tail_name(dec)
+    if tail in ("custom_vjp", "custom_jvp"):
+        return []
+    if isinstance(dec, ast.Call):
+        f_tail = astutil.tail_name(dec.func)
+        inner = None
+        if f_tail in ("custom_vjp", "custom_jvp"):
+            inner = dec
+        elif f_tail == "partial" and dec.args and \
+                astutil.tail_name(dec.args[0]) in ("custom_vjp",
+                                                   "custom_jvp"):
+            inner = dec
+        if inner is not None:
+            return astutil.int_tuple_values(
+                astutil.get_kwarg(inner, "nondiff_argnums")) or []
+    return None
+
+
+def _traced_function_nodes(tree: ast.AST) -> Dict[int, Set[str]]:
+    """Map from id(FunctionDef/Lambda) of every JAX-traced function to the
+    set of parameter NAMES that are static (not traced): jit
+    static_argnames/static_argnums, custom_vjp nondiff_argnums, and the
+    leading nondiff args of a defvjp bwd."""
+    defs = _collect_defs(tree)
+    traced: Dict[int, Set[str]] = {}
+
+    def mark_callable(expr: ast.AST,
+                      static_of: Optional[Callable] = None) -> None:
+        # unwrap partial(f, ...) / functools.partial(f, ...)
+        if isinstance(expr, ast.Call) and \
+                astutil.tail_name(expr.func) == "partial" and expr.args:
+            expr = expr.args[0]
+        if isinstance(expr, ast.Lambda):
+            traced.setdefault(id(expr), set())
+        elif isinstance(expr, ast.Name):
+            for d in defs.get(expr.id, ()):
+                statics = static_of(d) if static_of is not None else set()
+                traced.setdefault(id(d), set()).update(statics)
+
+    # primal name -> nondiff indices (for defvjp fwd/bwd statics)
+    primal_nondiff: Dict[str, List[int]] = {}
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if astutil.is_jit_decorator(dec):
+                    traced.setdefault(id(node), set()).update(
+                        astutil.jit_static_param_names(dec, node))
+                nondiff = _custom_vjp_nondiff(dec)
+                if nondiff is not None:
+                    params = astutil.positional_args(node)
+                    statics = {params[i].arg for i in nondiff
+                               if 0 <= i < len(params)}
+                    traced.setdefault(id(node), set()).update(statics)
+                    primal_nondiff[node.name] = nondiff
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                astutil.tail_name(node.value.func) == "custom_vjp" and \
+                len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            nondiff = astutil.int_tuple_values(
+                astutil.get_kwarg(node.value, "nondiff_argnums")) or []
+            primal_nondiff[node.targets[0].id] = nondiff
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = astutil.call_tail(node)
+        if tail in _CALLABLE_CONSUMERS:
+            for pos in _CALLABLE_CONSUMERS[tail]:
+                if len(node.args) > pos:
+                    mark_callable(node.args[pos])
+        if tail == "defvjp" and isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name):
+            nondiff = primal_nondiff.get(node.func.value.id, [])
+
+            def fwd_statics(d, _nd=nondiff):
+                params = astutil.positional_args(d)
+                return {params[i].arg for i in _nd if 0 <= i < len(params)}
+
+            def bwd_statics(d, _n=len(nondiff)):
+                # bwd signature: (*nondiff_args, residuals, cotangent)
+                params = astutil.positional_args(d)
+                return {p.arg for p in params[:_n]}
+
+            if len(node.args) > 0:
+                mark_callable(node.args[0], fwd_statics)
+            if len(node.args) > 1:
+                mark_callable(node.args[1], bwd_statics)
+    return traced
+
+
+class _Scope:
+    def __init__(self, tainted: Set[str]):
+        self.tainted = set(tainted)
+
+
+def _expr_tainted(expr: ast.AST, scope: _Scope) -> bool:
+    """Conservative: does ``expr`` (possibly) evaluate to a traced value?"""
+    if isinstance(expr, ast.Name):
+        return expr.id in scope.tainted
+    if isinstance(expr, ast.Constant):
+        return False
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return False
+        return _expr_tainted(expr.value, scope)
+    if isinstance(expr, ast.Subscript):
+        return _expr_tainted(expr.value, scope)
+    if isinstance(expr, ast.Call):
+        tail = astutil.tail_name(expr.func)
+        if tail in _SANITIZING_CALLS:
+            return False
+        if tail in _NUMPY_STATIC and \
+                astutil.root_name(expr.func) in _NUMPY_ROOTS:
+            return False
+        args_tainted = any(_expr_tainted(a, scope) for a in expr.args) or \
+            any(_expr_tainted(kw.value, scope) for kw in expr.keywords)
+        if isinstance(expr.func, ast.Attribute) and \
+                _expr_tainted(expr.func.value, scope):
+            return True  # method on a traced value
+        return args_tainted
+    if isinstance(expr, ast.BinOp):
+        return _expr_tainted(expr.left, scope) or \
+            _expr_tainted(expr.right, scope)
+    if isinstance(expr, ast.UnaryOp):
+        return _expr_tainted(expr.operand, scope)
+    if isinstance(expr, ast.BoolOp):
+        return any(_expr_tainted(v, scope) for v in expr.values)
+    if isinstance(expr, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+            return False  # identity checks are host-safe
+        return _expr_tainted(expr.left, scope) or \
+            any(_expr_tainted(c, scope) for c in expr.comparators)
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        return any(_expr_tainted(e, scope) for e in expr.elts)
+    if isinstance(expr, ast.Dict):
+        return any(_expr_tainted(v, scope) for v in expr.values
+                   if v is not None)
+    if isinstance(expr, ast.IfExp):
+        return _expr_tainted(expr.body, scope) or \
+            _expr_tainted(expr.orelse, scope)
+    if isinstance(expr, ast.Starred):
+        return _expr_tainted(expr.value, scope)
+    if isinstance(expr, (ast.JoinedStr, ast.FormattedValue)):
+        return False
+    return False
+
+
+def _target_names(target: ast.AST) -> Iterator[str]:
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from _target_names(e)
+    elif isinstance(target, ast.Starred):
+        yield from _target_names(target.value)
+
+
+def _check_violations(expr: ast.AST, scope: _Scope, ctx: LintContext,
+                      out: List[Finding]) -> None:
+    """Scan one expression tree for host-side ops on tainted values."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = astutil.tail_name(node.func)
+        if tail in ("item", "tolist") and \
+                isinstance(node.func, ast.Attribute) and \
+                _expr_tainted(node.func.value, scope):
+            out.append(Finding(
+                ctx.path, node.lineno, node.col_offset, "trace-safety",
+                f".{tail}() on a traced value forces a host sync and fails "
+                "under jit/shard_map tracing"))
+        elif tail in _HOST_COERCIONS and isinstance(node.func, ast.Name) \
+                and len(node.args) == 1 and \
+                _expr_tainted(node.args[0], scope):
+            out.append(Finding(
+                ctx.path, node.lineno, node.col_offset, "trace-safety",
+                f"{tail}() coercion of a traced value raises "
+                "TracerConversionError inside traced code"))
+        elif astutil.root_name(node.func) in _NUMPY_ROOTS and \
+                isinstance(node.func, ast.Attribute) and \
+                tail not in _NUMPY_STATIC and \
+                (any(_expr_tainted(a, scope) for a in node.args)
+                 or any(_expr_tainted(kw.value, scope)
+                        for kw in node.keywords)):
+            out.append(Finding(
+                ctx.path, node.lineno, node.col_offset, "trace-safety",
+                f"np.{tail}() on a traced value escapes the trace (use the "
+                "jnp equivalent)"))
+
+
+def _analyze_function(fn: astutil.FuncNode, inherited: Set[str],
+                      traced_ids: Dict[int, Set[str]], ctx: LintContext,
+                      out: List[Finding]) -> None:
+    tainted = set(inherited)
+    if id(fn) in traced_ids:
+        statics = traced_ids[id(fn)]
+        tainted.update(a.arg for a in astutil.positional_args(fn)
+                       if a.arg not in statics)
+    scope = _Scope(tainted)
+
+    if isinstance(fn, ast.Lambda):
+        _check_violations(fn.body, scope, ctx, out)
+        return
+
+    body: Sequence[ast.stmt] = fn.body
+    # two passes: the first settles assignment taint (handles simple
+    # use-before-def ordering), the second reports violations
+    for reporting in (False, True):
+        for stmt in body:
+            _walk_stmt(stmt, scope, traced_ids, ctx, out, reporting)
+
+
+def _walk_stmt(stmt: ast.stmt, scope: _Scope,
+               traced_ids: Dict[int, Set[str]],
+               ctx: LintContext, out: List[Finding],
+               reporting: bool) -> None:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        if reporting:
+            _analyze_function(stmt, scope.tainted, traced_ids, ctx, out)
+        return
+    if isinstance(stmt, ast.ClassDef):
+        return
+
+    if isinstance(stmt, ast.Assign):
+        if _expr_tainted(stmt.value, scope):
+            for t in stmt.targets:
+                scope.tainted.update(_target_names(t))
+        if reporting:
+            _check_violations(stmt.value, scope, ctx, out)
+        return
+    if isinstance(stmt, ast.AugAssign):
+        if _expr_tainted(stmt.value, scope):
+            scope.tainted.update(_target_names(stmt.target))
+        if reporting:
+            _check_violations(stmt.value, scope, ctx, out)
+        return
+    if isinstance(stmt, ast.AnnAssign):
+        if stmt.value is not None:
+            if _expr_tainted(stmt.value, scope):
+                scope.tainted.update(_target_names(stmt.target))
+            if reporting:
+                _check_violations(stmt.value, scope, ctx, out)
+        return
+
+    if isinstance(stmt, (ast.If, ast.While)):
+        if reporting:
+            if _expr_tainted(stmt.test, scope):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                out.append(Finding(
+                    ctx.path, stmt.lineno, stmt.col_offset, "trace-safety",
+                    f"Python `{kind}` on a traced value — data-dependent "
+                    "control flow must use lax.cond/lax.select/jnp.where"))
+            _check_violations(stmt.test, scope, ctx, out)
+        for s in stmt.body + stmt.orelse:
+            _walk_stmt(s, scope, traced_ids, ctx, out, reporting)
+        return
+
+    if isinstance(stmt, ast.For):
+        if _expr_tainted(stmt.iter, scope):
+            scope.tainted.update(_target_names(stmt.target))
+        if reporting:
+            _check_violations(stmt.iter, scope, ctx, out)
+        for s in stmt.body + stmt.orelse:
+            _walk_stmt(s, scope, traced_ids, ctx, out, reporting)
+        return
+
+    if isinstance(stmt, ast.With):
+        for s in stmt.body:
+            _walk_stmt(s, scope, traced_ids, ctx, out, reporting)
+        return
+
+    if isinstance(stmt, ast.Try):
+        for s in stmt.body + stmt.orelse + stmt.finalbody:
+            _walk_stmt(s, scope, traced_ids, ctx, out, reporting)
+        for h in stmt.handlers:
+            for s in h.body:
+                _walk_stmt(s, scope, traced_ids, ctx, out, reporting)
+        return
+
+    if isinstance(stmt, (ast.Return, ast.Expr)):
+        if reporting and stmt.value is not None:
+            _check_violations(stmt.value, scope, ctx, out)
+        return
+    # Raise/Assert/Pass/Import/...: nothing traced-unsafe to report beyond
+    # calls, which only appear inside the expressions handled above.
+
+
+@register(
+    "trace-safety",
+    "host-side ops (.item(), float()/int()/bool(), np.*, Python if/while) "
+    "on values that dataflow from traced function parameters")
+def check(ctx: LintContext) -> Iterator[Finding]:
+    traced_ids = _traced_function_nodes(ctx.tree)
+    out: List[Finding] = []
+    seen: Set[int] = set()
+
+    def visit_defs(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if id(child) not in seen:
+                    seen.add(id(child))
+                    _analyze_function(child, set(), traced_ids, ctx, out)
+            elif isinstance(child, ast.Lambda):
+                if id(child) in traced_ids and id(child) not in seen:
+                    seen.add(id(child))
+                    _analyze_function(child, set(), traced_ids, ctx, out)
+                continue
+            else:
+                visit_defs(child)
+
+    visit_defs(ctx.tree)
+    # nested defs are analyzed by _analyze_function recursion; dedupe
+    # findings that could be emitted twice via the two-pass walk
+    uniq = {}
+    for f in out:
+        uniq[(f.line, f.col, f.message)] = f
+    yield from uniq.values()

@@ -45,12 +45,15 @@ def pipelined_loss_fn(cfg: LlamaConfig, num_microbatches: int,
     if not cfg.scan_layers:
         raise ValueError("pipeline path requires scan_layers=True")
     if getattr(cfg, "attention_dropout", 0.0) > 0.0:
-        # no rng plumbing per microbatch through the pipeline engines — a
-        # silent skip would fake regularization (cf. the CP dropout guard
-        # history in models/llama.py)
+        # the GPipe engine differentiates one scanned forward and has no
+        # per-microbatch rng channel; the explicit-VJP executor does — a
+        # silent skip here would fake regularization (cf. the CP dropout
+        # guard history in models/llama.py)
         raise ValueError(
-            "attention_dropout is not threaded through the pipeline "
-            "engines; set attention_dropout=0 for PP configs")
+            "attention_dropout under PP requires the 1F1B executor "
+            "(make_pipeline_grad_fn(..., schedule='1f1b' or "
+            "'interleaved')); the GPipe schedule has no per-microbatch "
+            "rng channel")
 
     embed_mod = pl.ParallelEmbedding(
         num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
@@ -157,7 +160,8 @@ def make_pipeline_grad_fn(cfg: LlamaConfig, num_microbatches: int,
                           ignore_index: int = -100,
                           schedule: str = "gpipe",
                           num_chunks: int = 1,
-                          vocab_pp: bool = False):
+                          vocab_pp: bool = False,
+                          dropout_seed: int = 0):
     """Build ``grad_fn(params, batch) -> (loss, grads)`` for
     :func:`..trainer.make_train_step`.
 
@@ -182,7 +186,8 @@ def make_pipeline_grad_fn(cfg: LlamaConfig, num_microbatches: int,
     if schedule in ("1f1b", "interleaved"):
         return make_1f1b_grad_fn(
             cfg, num_microbatches, param_specs, num_chunks=num_chunks,
-            ignore_index=ignore_index, vocab_pp=vocab_pp)
+            ignore_index=ignore_index, vocab_pp=vocab_pp,
+            dropout_seed=dropout_seed)
     if schedule != "gpipe":
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if vocab_pp:
@@ -260,7 +265,8 @@ def deinterleave_pipeline_params(variables: Any, cfg: LlamaConfig,
 
 def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
                       param_specs: Any, num_chunks: int = 1,
-                      ignore_index: int = -100, vocab_pp: bool = False):
+                      ignore_index: int = -100, vocab_pp: bool = False,
+                      dropout_seed: int = 0):
     """1F1B / interleaved executor (:mod:`..pipeline.engine_1f1b`).
 
     Unlike the GPipe path, forward and backward interleave explicitly and
@@ -282,21 +288,28 @@ def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
     (``pipeline/model.py:750,791``), at the cost of ~3 act-sized pp psums
     per embed/head tick.
 
+    With ``cfg.attention_dropout > 0`` the dropout rng IS threaded through
+    this executor: each stage folds the engine's microbatch slot σ(f,c)
+    (identical in the forward tick and the vjp recompute — see
+    ``engine_1f1b.pipeline_1f1b_grads(stage_takes_slot=...)``) plus its pp
+    index into ``jax.random.key(dropout_seed)``, and ``nn.scan`` splits the
+    result per layer — masks are distinct per (microbatch, chunk, stage,
+    layer) and bit-identical between forward and backward recompute. Masks
+    are a pure function of ``(dropout_seed, step, slot, stage)``: they vary
+    across optimizer steps only when the caller puts an integer
+    ``batch["dropout_step"]`` in the batch (``make_train_step``'s grad_fn
+    contract has no rng channel, so the step must ride the batch).
+
     NOTE: :func:`.mixtral_pipeline.make_moe_1f1b_grad_fn` mirrors this
     scaffolding (adding router-aux seeding); keep the two in sync.
     """
+    from ..parallel import comm
     from ..parallel import grads as grads_mod
     from ..pipeline import engine_1f1b as e1
 
     if not cfg.scan_layers:
         raise ValueError("pipeline path requires scan_layers=True")
-    if getattr(cfg, "attention_dropout", 0.0) > 0.0:
-        # no rng plumbing per microbatch through the pipeline engines — a
-        # silent skip would fake regularization (cf. the CP dropout guard
-        # history in models/llama.py)
-        raise ValueError(
-            "attention_dropout is not threaded through the pipeline "
-            "engines; set attention_dropout=0 for PP configs")
+    use_dropout = getattr(cfg, "attention_dropout", 0.0) > 0.0
     C = num_chunks
     vocab_axis = (ps.PP_AXIS, ps.TP_AXIS) if vocab_pp else ps.TP_AXIS
 
@@ -314,7 +327,7 @@ def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
         axis=vocab_axis,
         dtype=cfg.dtype, param_dtype=cfg.param_dtype)
 
-    def inner(params, ids, labels):
+    def inner(params, ids, labels, dstep):
         p = params["params"]
         S = ps.get_pipeline_model_parallel_size()
         M = num_microbatches
@@ -354,14 +367,34 @@ def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
         body = nn.scan(
             _ScanBody,
             variable_axes={"params": 0},
-            split_rngs={"params": True},
+            split_rngs={"params": True, "dropout": True},
             in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
             length=lv,
         )(cfg)
 
-        def stage_fn(chunk_p, act):
-            out, _ = body.apply({"params": chunk_p}, act, cos, sin, None)
-            return out
+        if use_dropout:
+            pp_bound = comm._axis_size(ps.PP_AXIS)
+
+            def stage_fn(chunk_p, act, slot):
+                # mask = f(seed, step, slot, stage): slot decorrelates
+                # microbatches/chunks and repeats exactly in the engine's
+                # bwd recompute; the pp index decorrelates stages (same
+                # slot, same layer shapes — without it every stage would
+                # reuse stage 0's masks)
+                my = (jax.lax.axis_index(ps.PP_AXIS) if pp_bound
+                      else jnp.zeros((), jnp.int32))
+                key = jax.random.key(dropout_seed)
+                key = jax.random.fold_in(key, dstep)
+                key = jax.random.fold_in(key, slot)
+                key = jax.random.fold_in(key, my)
+                out, _ = body.apply({"params": chunk_p}, act, cos, sin,
+                                    None, rngs={"dropout": key})
+                return out
+        else:
+            def stage_fn(chunk_p, act):
+                out, _ = body.apply({"params": chunk_p}, act, cos, sin,
+                                    None)
+                return out
 
         if cfg.remat:
             stage_fn = jax.checkpoint(
@@ -414,7 +447,8 @@ def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
         loss, g = e1.pipeline_1f1b_grads(
             embed_fn, stage_fn, head_loss_fn, eng_params, ids_mb, labels_mb,
             num_stages=S, num_microbatches=m_run, num_chunks=C,
-            num_real_microbatches=M, vocab_parallel_pp=vocab_pp)
+            num_real_microbatches=M, vocab_parallel_pp=vocab_pp,
+            stage_takes_slot=use_dropout)
 
         # local [C*lv] grads exit through out_spec P('pp') as the padded
         # [l_pad] stack; grad_fn slices the pad rows off outside
@@ -479,11 +513,15 @@ def make_1f1b_grad_fn(cfg: LlamaConfig, num_microbatches: int,
             params = map_layers(params, pad,
                                 run_specs["params"]["model"]["layers"])
             padded_here = True
+        # optional per-step dropout decorrelation: grad_fn's contract has
+        # no rng channel, so a step counter may ride the batch
+        dstep = jnp.asarray(batch.get("dropout_step", 0), jnp.int32)
         loss, grads = ps.shard_map(
             inner, mesh,
-            in_specs=(run_specs, P(ps.DP_AXIS, None), P(ps.DP_AXIS, None)),
+            in_specs=(run_specs, P(ps.DP_AXIS, None), P(ps.DP_AXIS, None),
+                      P()),
             out_specs=(P(), run_specs))(
-                params, batch["input_ids"], batch["labels"])
+                params, batch["input_ids"], batch["labels"], dstep)
         if l_pad != L:
             if padded_here:
                 grads = map_layers(grads, lambda x: x[:L])

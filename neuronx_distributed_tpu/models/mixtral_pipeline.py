@@ -40,12 +40,12 @@ def pipelined_moe_loss_fn(cfg: MixtralConfig, num_microbatches: int,
     if not cfg.scan_layers:
         raise ValueError("pipeline path requires scan_layers=True")
     if getattr(cfg, "attention_dropout", 0.0) > 0.0:
-        # no rng plumbing per microbatch through the pipeline engines — a
-        # silent skip would fake regularization (cf. the CP dropout guard
-        # history in models/llama.py)
+        # the MoE pipeline paths carry no per-microbatch rng channel yet
+        # (the llama 1F1B executor does — llama_pipeline.make_1f1b_grad_fn
+        # slot-keys the masks); a silent skip would fake regularization
         raise ValueError(
-            "attention_dropout is not threaded through the pipeline "
-            "engines; set attention_dropout=0 for PP configs")
+            "attention_dropout is not threaded through the MoE pipeline "
+            "engines; set attention_dropout=0 for MoE PP configs")
 
     embed_mod = pl.ParallelEmbedding(
         num_embeddings=cfg.vocab_size, features=cfg.hidden_size,
@@ -186,12 +186,13 @@ def make_moe_1f1b_grad_fn(cfg: MixtralConfig, num_microbatches: int,
     if not cfg.scan_layers:
         raise ValueError("pipeline path requires scan_layers=True")
     if getattr(cfg, "attention_dropout", 0.0) > 0.0:
-        # no rng plumbing per microbatch through the pipeline engines — a
-        # silent skip would fake regularization (cf. the CP dropout guard
-        # history in models/llama.py)
+        # the MoE 1F1B path does not pass the engine's slot through its
+        # stage_fn yet; adopt llama_pipeline.make_1f1b_grad_fn's slot-keyed
+        # rng (stage_takes_slot=True) before lifting this guard — a silent
+        # skip would fake regularization
         raise ValueError(
-            "attention_dropout is not threaded through the pipeline "
-            "engines; set attention_dropout=0 for PP configs")
+            "attention_dropout is not threaded through the MoE pipeline "
+            "engines; set attention_dropout=0 for MoE PP configs")
     C = num_chunks
 
     embed_mod = pl.ParallelEmbedding(

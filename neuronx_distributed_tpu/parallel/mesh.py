@@ -474,6 +474,14 @@ def with_sharding_constraint(x, *spec: Any):
     return jax.lax.with_sharding_constraint(x, named_sharding(*spec))
 
 
+try:
+    _SHARD_MAP_IMPL = jax.shard_map
+    _SHARD_MAP_CHECK_KW = "check_vma"
+except AttributeError:  # jax < 0.6: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _SHARD_MAP_IMPL
+    _SHARD_MAP_CHECK_KW = "check_rep"
+
+
 def shard_map(f, mesh: Optional[Mesh] = None, *, in_specs, out_specs,
               check_vma: bool = False, **kw):
     """``jax.shard_map`` over the global mesh.
@@ -481,9 +489,11 @@ def shard_map(f, mesh: Optional[Mesh] = None, *, in_specs, out_specs,
     ``check_vma`` defaults to False: TP-style programs routinely all-gather a
     sharded value and treat the result as replicated (e.g. the output of
     ``gather_from_tensor_parallel_region``), which JAX's static
-    varying-manual-axes analysis cannot prove replicated.
+    varying-manual-axes analysis cannot prove replicated. (On pre-0.6 jax
+    the same switch is spelled ``check_rep``.)
     """
     if mesh is None:
         mesh = get_mesh()
-    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=check_vma, **kw)
+    kw[_SHARD_MAP_CHECK_KW] = check_vma
+    return _SHARD_MAP_IMPL(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kw)

@@ -105,6 +105,7 @@ def pipeline_1f1b_grads(
     aux_weight: Optional[jax.Array] = None,
     num_real_microbatches: Optional[int] = None,
     vocab_parallel_pp: bool = False,
+    stage_takes_slot: bool = False,
 ) -> Tuple[jax.Array, Dict[str, Any]]:
     """Run the full 1F1B (or interleaved, ``num_chunks>1``) fwd+bwd pipeline.
 
@@ -116,7 +117,8 @@ def pipeline_1f1b_grads(
       stage_fn: ``(chunk_params, act) -> act`` — one chunk of this stage's
         layer stack; ``chunk_params`` has the chunk dim already selected.
         With ``aux_weight`` it returns ``(act, aux [A])`` — per-chunk
-        auxiliary scalars (MoE router losses).
+        auxiliary scalars (MoE router losses). With ``stage_takes_slot``
+        the signature is ``(chunk_params, act, slot) -> act``.
       head_loss_fn: ``(head_params, act, labels [mb, seq]) -> scalar`` —
         last-stage epilogue returning this microbatch's *contribution to the
         local mean loss* (i.e. already divided by the local batch token
@@ -146,6 +148,13 @@ def pipeline_1f1b_grads(
         reference gets from placing shared weights on owning stages only
         (``pipeline/model.py:750,791``). Costs ~3 extra act-sized pp psums
         per firing tick (embed fwd, head act broadcast, embed bwd seed).
+      stage_takes_slot: ``stage_fn`` additionally receives the microbatch
+        slot ``σ(f,c) = (f//S)·SC + c·S + f%S`` (an int32 scalar, unique per
+        (microbatch, chunk) within a step). The SAME slot is passed in the
+        forward tick and in the backward recompute-from-saved-input, so a
+        stage that folds it into an RNG key (per-microbatch dropout) gets
+        bit-identical masks in fwd and the vjp recompute — the correctness
+        requirement recompute-based 1F1B puts on any stochastic layer.
 
     Returns ``(local_loss, grads)`` with ``grads`` shaped like ``params``
     (pp-replicated leaves already psum'd over pp; data-axis sync is the
@@ -198,13 +207,15 @@ def pipeline_1f1b_grads(
 
     has_aux = aux_weight is not None
 
-    def stage_call(chunk_p, act):
-        res = stage_fn(chunk_p, act)
+    def stage_call(chunk_p, act, slot):
+        res = (stage_fn(chunk_p, act, slot) if stage_takes_slot
+               else stage_fn(chunk_p, act))
         return res if has_aux else (res, jnp.zeros((0,), jnp.float32))
 
     # shape/dtype of one stage_call output, for the bubble-tick zero branch
     chunk0_p = jax.tree_util.tree_map(lambda p: p[0], layers_p)
-    stage_out_sd = jax.eval_shape(stage_call, chunk0_p, zero_act)
+    stage_out_sd = jax.eval_shape(stage_call, chunk0_p, zero_act,
+                                  jnp.zeros((), jnp.int32))
     zero_stage_out = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), stage_out_sd)
 
@@ -239,8 +250,8 @@ def pipeline_1f1b_grads(
         # (reference schedules simply emit no task; in the scanned SPMD
         # program the tick exists but its compute is cond-skipped)
         out, aux_f = lax.cond(
-            fvalid, stage_call, lambda cp, a: zero_stage_out,
-            pick_chunk(c_f), inp)
+            fvalid, stage_call, lambda cp, a, s: zero_stage_out,
+            pick_chunk(c_f), inp, sigma_f.astype(jnp.int32))
         aux_acc = aux_acc + (aux_f.astype(jnp.float32)
                              * (f < M_real).astype(jnp.float32))
         prev_in_slot = lax.dynamic_index_in_dim(buf, sigma_f % W, 0,
@@ -302,7 +313,12 @@ def pipeline_1f1b_grads(
                                             keepdims=False)
 
         def bwd_run(cp, saved, dout_):
-            _, s_vjp = jax.vjp(stage_call, cp, saved)
+            # slot closed over, not a vjp primal: the recompute re-derives
+            # the forward's dropout masks from sigma_b == sigma_f(b, c_b)
+            _, s_vjp = jax.vjp(
+                lambda cp_, a_: stage_call(cp_, a_,
+                                           sigma_b.astype(jnp.int32)),
+                cp, saved)
             aux_ct = (aux_weight.astype(jnp.float32)
                       * (b < M_real).astype(jnp.float32) if has_aux
                       else jnp.zeros((0,), jnp.float32))

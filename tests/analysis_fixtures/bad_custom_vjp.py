@@ -1,0 +1,44 @@
+# nxdlint fixture: custom-vjp violations.
+# NOT imported by anything — parsed by tests/test_analysis.py.
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+@jax.custom_vjp
+def never_paired(a, b):          # custom_vjp without defvjp
+    return a * b
+
+
+@jax.custom_vjp
+def wrong_arity(a, b, c):
+    return a * b + c
+
+
+def _wrong_arity_fwd(a, b, c):
+    return a * b + c, (a, b)
+
+
+def _wrong_arity_bwd(res, ct):
+    a, b = res
+    return (ct * b, ct * a)      # primal has 3 diff args, bwd returns 2
+
+
+wrong_arity.defvjp(_wrong_arity_fwd, _wrong_arity_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def nondiff_arity(n, x, y):
+    return x * y * n
+
+
+def _nondiff_fwd(n, x, y):
+    return x * y * n, (x, y)
+
+
+def _nondiff_bwd(n, res, ct):
+    x, y = res
+    return (ct * y * n,)         # 2 diff args, bwd returns 1
+
+
+nondiff_arity.defvjp(_nondiff_fwd, _nondiff_bwd)

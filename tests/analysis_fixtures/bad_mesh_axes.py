@@ -1,0 +1,25 @@
+# nxdlint fixture: every finding here is a mesh-axis violation.
+# NOT imported by anything — parsed by tests/test_analysis.py.
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+spec_typo = P("dpp", None)                      # not a canonical axis
+spec_ws = P("tp ", None)                        # whitespace typo, hint fires
+
+
+def collective(x):
+    a = jax.lax.psum(x, "tpp")                  # typo in collective axis
+    b = jax.lax.all_gather(x, axis_name="dq")   # kwarg form
+    i = jax.lax.axis_index("pp2")               # first positional
+    return a + b + i
+
+
+def build_mesh(devices):
+    return Mesh(devices, axis_names=("dp", "tq"))  # one bad name
+
+
+def shard_specs(f, mesh):
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh,
+                     in_specs=P("db", None),     # bad in_specs
+                     out_specs=P("dp", None))

@@ -1,0 +1,27 @@
+# nxdlint fixture: recompile-hazard violations.
+# NOT imported by anything — parsed by tests/test_analysis.py.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_SCALE_TABLE = {"a": 1.0}        # module-level mutable global
+
+
+@jax.jit
+def mutable_default(x, cfg=[1, 2]):      # list default on a jitted fn
+    return x * cfg[0]
+
+
+@jax.jit
+def array_default(x, w=np.ones(4)):      # array default: fresh identity
+    return x * w
+
+
+@jax.jit
+def dict_kw_default(x, *, opts={}):      # keyword-only mutable default
+    return x
+
+
+@jax.jit
+def reads_global(x):
+    return x * _SCALE_TABLE["a"]         # frozen at first trace

@@ -1,0 +1,40 @@
+# nxdlint fixture: trace-safety violations — host ops on traced values.
+# NOT imported by anything — parsed by tests/test_analysis.py.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def coercions(x):
+    s = float(x)                 # coercion of a tracer
+    n = int(x.sum())             # coercion of a tracer-derived value
+    return s + n
+
+
+@jax.jit
+def host_sync(x):
+    y = x * 2
+    return y.item()              # .item() forces a host sync
+
+
+@jax.jit
+def numpy_escape(x):
+    return np.sum(x)             # np.* on a tracer escapes the trace
+
+
+@jax.jit
+def control_flow(x):
+    if x > 0:                    # Python `if` on a tracer
+        return x
+    while x < 1:                 # Python `while` on a tracer
+        x = x + 1
+    return x
+
+
+def consumer(x):
+    def body(carry, v):
+        return carry + float(v), None    # traced via lax.scan
+
+    out, _ = jax.lax.scan(body, 0.0, x)
+    return out

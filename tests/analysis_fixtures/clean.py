@@ -1,0 +1,51 @@
+# nxdlint fixture: idiomatic code the linter must stay silent on.
+# NOT imported by anything — parsed by tests/test_analysis.py.
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+TP_AXIS = "tp"
+
+spec_ok = P("dp", "tp")
+spec_const = P(TP_AXIS, None)            # names via constants, not literals
+
+_LIMITS = (4, 8)                          # immutable global is fine
+
+
+@functools.partial(jax.jit, static_argnames=("block", "causal"))
+def static_params_ok(x, block, causal):
+    # block/causal are static python values: host ops on them are legal
+    nb = int(np.ceil(x.shape[0] / block))
+    if causal:
+        x = x * 2
+    return x, nb
+
+
+@jax.jit
+def metadata_ok(x):
+    # shape/dtype accessors sanitize; `is None` comparisons are host-safe
+    if x.shape[0] % 2 == 0 and x.dtype == jnp.float32:
+        x = x + 1
+    if x is not None:
+        x = x * _LIMITS[0]
+    return x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def paired(n, x):
+    return x * n
+
+
+def _paired_fwd(n, x):
+    return x * n, (x,)
+
+
+def _paired_bwd(n, res, ct):
+    del res
+    return (ct * n,)                      # 1 diff arg, 1 cotangent
+
+
+paired.defvjp(_paired_fwd, _paired_bwd)

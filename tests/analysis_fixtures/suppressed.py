@@ -1,0 +1,14 @@
+# nxdlint fixture: violations silenced by suppression comments.
+# NOT imported by anything — parsed by tests/test_analysis.py.
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+spec = P("zz", None)  # nxdlint: disable=mesh-axis  -- test-only axis name
+
+
+@jax.jit
+def f(x):
+    # nxdlint: disable=trace-safety  -- exercised under eager only
+    y = float(x)
+    return np.sum(x)  # nxdlint: disable=all  -- wildcard suppression

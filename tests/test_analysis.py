@@ -1,0 +1,171 @@
+"""nxdlint unit tests: every rule family fires on its fixture, stays
+silent on the clean fixture, and suppression comments work.
+
+The fixtures under ``tests/analysis_fixtures/`` are parsed, never imported
+— the analyzer is stdlib-AST only.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from neuronx_distributed_tpu.analysis import (DEFAULT_AXES, analyze_paths,
+                                              analyze_source,
+                                              parse_suppressions)
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "analysis_fixtures")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(fname, **kw):
+    return analyze_paths([os.path.join(FIXTURES, fname)], **kw)
+
+
+def _rules(findings):
+    return {f.rule for f in findings if not f.suppressed}
+
+
+# ---------------------------------------------------------------------------
+# per-rule firing
+# ---------------------------------------------------------------------------
+
+def test_mesh_axis_fires_on_fixture():
+    fs = _lint("bad_mesh_axes.py")
+    assert _rules(fs) == {"mesh-axis"}
+    bad = {m for f in fs for m in ("dpp", "tpp", "dq", "pp2", "tq", "db")
+           if f"'{m}'" in f.message}
+    assert bad == {"dpp", "tpp", "dq", "pp2", "tq", "db"}
+    # whitespace typo carries a did-you-mean hint
+    assert any("did you mean 'tp'" in f.message for f in fs)
+
+
+def test_trace_safety_fires_on_fixture():
+    fs = _lint("bad_trace_safety.py")
+    assert _rules(fs) == {"trace-safety"}
+    msgs = " | ".join(f.message for f in fs)
+    assert ".item()" in msgs
+    assert "float() coercion" in msgs
+    assert "int() coercion" in msgs
+    assert "np.sum()" in msgs
+    assert "`if` on a traced value" in msgs
+    assert "`while` on a traced value" in msgs
+    # the lax.scan body (callable-consumer form) is traced too
+    assert any(f.line > 33 for f in fs)
+
+
+def test_custom_vjp_fires_on_fixture():
+    fs = _lint("bad_custom_vjp.py", select=["custom-vjp"])
+    msgs = " | ".join(f.message for f in fs)
+    assert "never_paired" in msgs and "never calls" in msgs
+    assert "wrong_arity" in msgs and "cotangent arity" in msgs
+    # nondiff_argnums adjusts the expected arity (2 diff args, not 3)
+    assert "2 differentiable arg(s)" in msgs
+
+
+def test_recompile_hazard_fires_on_fixture():
+    fs = _lint("bad_recompile.py")
+    assert _rules(fs) == {"recompile-hazard"}
+    msgs = " | ".join(f.message for f in fs)
+    assert "mutable) default for 'cfg'" in msgs
+    assert "array-valued default for 'w'" in msgs
+    assert "keyword 'opts'" in msgs
+    assert "_SCALE_TABLE" in msgs
+
+
+# ---------------------------------------------------------------------------
+# silence on clean code
+# ---------------------------------------------------------------------------
+
+def test_clean_fixture_is_silent():
+    assert _lint("clean.py") == []
+
+
+def test_static_argnames_not_tainted():
+    src = (
+        "import jax, numpy as np\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(1,),"
+        " static_argnames=('mode',))\n"
+        "def f(x, block, mode):\n"
+        "    n = int(block) if mode else 0\n"
+        "    return x * n\n")
+    assert analyze_source(src, "m.py", axes=DEFAULT_AXES) == []
+
+
+def test_nondiff_bwd_args_not_tainted():
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.custom_vjp, nondiff_argnums=(0, 1))\n"
+        "def f(a, b, x):\n"
+        "    return x * a * b\n"
+        "def fwd(a, b, x):\n"
+        "    return x * a * b, (x,)\n"
+        "def bwd(a, b, res, ct):\n"
+        "    k = float(a) * int(b)\n"   # statics: host math is fine
+        "    return (ct * k,)\n"
+        "f.defvjp(fwd, bwd)\n")
+    assert analyze_source(src, "m.py", axes=DEFAULT_AXES) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_comments():
+    fs = _lint("suppressed.py")
+    assert fs, "violations must still be detected"
+    assert all(f.suppressed for f in fs)
+    rules = {f.rule for f in fs}
+    assert "mesh-axis" in rules and "trace-safety" in rules
+
+
+def test_parse_suppressions_forms():
+    src = ("x = 1  # nxdlint: disable=mesh-axis\n"
+           "# nxdlint: disable=trace-safety,custom-vjp\n"
+           "y = 2\n"
+           "# nxdlint: disable-file=recompile-hazard\n")
+    line_sup, file_sup = parse_suppressions(src)
+    assert "mesh-axis" in line_sup[1]
+    # a standalone suppression comment covers the next line
+    assert {"trace-safety", "custom-vjp"} <= line_sup[3]
+    assert "recompile-hazard" in file_sup
+
+
+def test_extra_axes_whitelist():
+    src = "from jax.sharding import PartitionSpec as P\nspec = P('mp')\n"
+    assert analyze_source(src, "m.py", axes=DEFAULT_AXES | {"mp"}) == []
+    bad = analyze_source(src, "m.py", axes=DEFAULT_AXES)
+    assert [f.rule for f in bad] == ["mesh-axis"]
+
+
+# ---------------------------------------------------------------------------
+# CLI contract (the CI gate): nonzero on the corpus, zero on clean input
+# ---------------------------------------------------------------------------
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "neuronx_distributed_tpu.analysis", *args],
+        cwd=REPO, capture_output=True, text=True)
+
+
+def test_cli_nonzero_on_fixture_corpus():
+    r = _cli(FIXTURES)
+    assert r.returncode == 1
+    out_rules = {line.split("[")[1].split("]")[0]
+                 for line in r.stdout.splitlines() if "[" in line}
+    assert out_rules == {"mesh-axis", "trace-safety", "custom-vjp",
+                         "recompile-hazard"}
+
+
+def test_cli_zero_on_clean_file():
+    r = _cli(os.path.join(FIXTURES, "clean.py"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout == ""
+
+
+def test_cli_usage_error_without_paths():
+    assert _cli().returncode == 2

@@ -261,13 +261,15 @@ def test_neox_mixtral_attention_dropout_live():
         assert not np.array_equal(tr_a, ev_a), model.__name__
 
 
-def test_pipeline_rejects_attention_dropout():
-    """The pipeline engines carry no per-microbatch rng plumbing; a PP
-    config with attention_dropout > 0 must fail loudly, not silently skip
-    regularization (review finding r5)."""
+def test_gpipe_rejects_attention_dropout():
+    """The GPipe engine differentiates one scanned forward and carries no
+    per-microbatch rng channel; a PP config with attention_dropout > 0 must
+    fail loudly there, not silently skip regularization (review finding
+    r5). The 1F1B executor threads the rng — see
+    test_1f1b_attention_dropout_threaded."""
     from neuronx_distributed_tpu.models.llama import tiny_config
     from neuronx_distributed_tpu.models.llama_pipeline import (
-        make_1f1b_grad_fn, pipelined_loss_fn)
+        pipelined_loss_fn)
     from neuronx_distributed_tpu.models.mixtral import tiny_moe_config
     from neuronx_distributed_tpu.models.mixtral_pipeline import (
         pipelined_moe_loss_fn)
@@ -276,7 +278,50 @@ def test_pipeline_rejects_attention_dropout():
     with pytest.raises(ValueError, match="attention_dropout"):
         pipelined_loss_fn(cfg, num_microbatches=2)
     with pytest.raises(ValueError, match="attention_dropout"):
-        make_1f1b_grad_fn(cfg, num_microbatches=2, param_specs=None)
-    with pytest.raises(ValueError, match="attention_dropout"):
         pipelined_moe_loss_fn(tiny_moe_config(attention_dropout=0.1),
                               num_microbatches=2)
+
+
+def test_1f1b_attention_dropout_threaded():
+    """The 1F1B executor threads a dropout rng keyed on the engine's
+    microbatch slot (identical in forward and the vjp recompute) plus the
+    pp index: the step trains, is deterministic per (seed, step), masks
+    decorrelate across steps via batch['dropout_step'], and the dropout
+    actually bites (loss differs from the rate-0 model)."""
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models import llama_pipeline as lpp
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+    from neuronx_distributed_tpu.trainer import initialize_parallel_model
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=2,
+                                         pipeline_parallel_size=2)
+    kw = dict(dtype=jnp.float32, param_dtype=jnp.float32, num_layers=4,
+              tp_size=2)
+    mcfg = tiny_config(attention_dropout=0.5, **kw)
+    model = LlamaForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (8, 17), 0, mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(
+        cfg, model, jax.random.key(1), batch["input_ids"],
+        logical_axis_rules=lpp.PIPELINE_LOGICAL_RULES)
+
+    grad_fn = lpp.make_pipeline_grad_fn(
+        mcfg, num_microbatches=4, param_specs=pm.param_specs,
+        schedule="1f1b")
+    l1, g1 = jax.jit(grad_fn)(params, batch)
+    l2, g2 = jax.jit(grad_fn)(params, batch)
+    assert np.isfinite(float(l1))
+    assert float(l1) == float(l2), "masks must be deterministic per seed"
+    leaf1 = np.asarray(jax.tree_util.tree_leaves(g1)[0])
+    leaf2 = np.asarray(jax.tree_util.tree_leaves(g2)[0])
+    np.testing.assert_array_equal(leaf1, leaf2)
+
+    l3, _ = jax.jit(grad_fn)(params, dict(batch, dropout_step=1))
+    assert float(l3) != float(l1), "dropout_step must decorrelate masks"
+
+    grad_fn0 = lpp.make_pipeline_grad_fn(
+        tiny_config(attention_dropout=0.0, **kw), num_microbatches=4,
+        param_specs=pm.param_specs, schedule="1f1b")
+    l0, _ = jax.jit(grad_fn0)(params, batch)
+    assert float(l0) != float(l1), "dropout must actually perturb the loss"

@@ -1,0 +1,33 @@
+"""The linter gates its own package: zero unsuppressed findings over
+``neuronx_distributed_tpu/``.
+
+This is the CI wiring the round-5 dropout/PP regression motivated (see
+docs/analysis.md): the stringly-typed invariants nxdlint checks are exactly
+the ones the test suite only catches one config at a time.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "neuronx_distributed_tpu")
+
+
+def test_package_lints_clean():
+    r = subprocess.run(
+        [sys.executable, "-m", "neuronx_distributed_tpu.analysis", PACKAGE],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 0, (
+        "nxdlint found unsuppressed findings in the package:\n"
+        + r.stdout + r.stderr)
+
+
+def test_fixture_corpus_stays_bad():
+    """Guards the gate itself: if the analyzer regresses to finding nothing,
+    the self-lint above would pass vacuously."""
+    r = subprocess.run(
+        [sys.executable, "-m", "neuronx_distributed_tpu.analysis",
+         os.path.join(REPO, "tests", "analysis_fixtures")],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 1
