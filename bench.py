@@ -61,7 +61,8 @@ def _init_backend_with_watchdog(timeout_s: float = 180.0):
     env["NXD_BENCH_CPU_FALLBACK"] = "1"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                         + " --xla_force_host_platform_device_count=8").strip()
-    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
               env)
 
 
@@ -69,7 +70,7 @@ jax = _init_backend_with_watchdog()
 import jax.numpy as jnp  # noqa: E402
 
 
-def main():
+def main(chaos_spec=None):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -190,6 +191,18 @@ def main():
 
         traceback.print_exc()
         print(f"bench: decode metric failed: {e!r}", file=sys.stderr)
+
+    # resilience drill (docs/resilience.md): kill a tiny training run
+    # mid-step with a real SIGTERM, time the emergency-save -> resume ->
+    # next-step path, and report how many optimizer steps the preemption
+    # cost. With --chaos, storage faults are injected throughout.
+    try:
+        aux.update(resilience_metric(platform, chaos_spec))
+    except Exception as e:  # pragma: no cover
+        import traceback
+
+        traceback.print_exc()
+        print(f"bench: resilience metric failed: {e!r}", file=sys.stderr)
 
     print(json.dumps({
         "metric": f"llama_train_tokens_per_sec_per_chip_{platform}{n_dev}",
@@ -368,5 +381,114 @@ def _bundle_cold_start_ms() -> float:
     return (time.perf_counter() - t0) * 1e3
 
 
+def resilience_metric(platform: str, chaos_spec=None) -> dict:
+    """Preemption drill: train a tiny llama with periodic checkpointing,
+    deliver a real SIGTERM mid-run, catch the resumable exit, then resume
+    and run one more step. Reports ``recovery_time_s`` (SIGTERM delivery to
+    first post-resume step) and ``steps_lost`` (optimizer steps the
+    preemption cost — 0 when the emergency save landed). ``chaos_spec``
+    (--chaos) additionally injects storage faults per the FaultPlan DSL for
+    the whole drill; retries must heal transient ones."""
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+    from neuronx_distributed_tpu.resilience import (FaultPlan,
+                                                    PreemptionGuard,
+                                                    TrainingPreempted)
+    from neuronx_distributed_tpu.resilience.chaos import wrapper_for_plan
+    from neuronx_distributed_tpu.trainer import (
+        checkpoint_storage as cs,
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+        make_train_step,
+    )
+    from neuronx_distributed_tpu.trainer.loop import (Callback,
+                                                      CheckpointCallback,
+                                                      Trainer)
+
+    plan = None
+    if chaos_spec:
+        plan = FaultPlan.parse(chaos_spec)
+        cs.install_storage_wrapper(
+            wrapper_for_plan(plan, base_delay=0.01, max_delay=0.05))
+    ckpt_dir = tempfile.mkdtemp(prefix="nxd_bench_resilience_")
+    guard = PreemptionGuard(checkpoint_path=ckpt_dir, grace_s=120.0)
+    try:
+        cfg = nxd.neuronx_distributed_config(tensor_parallel_size=1)
+        mcfg = tiny_config(num_layers=2, dtype=jnp.float32,
+                           param_dtype=jnp.float32)
+        model = LlamaForCausalLM(mcfg)
+        # batch divisible by the dp axis (= all devices at tp=1)
+        ids = jax.random.randint(jax.random.key(0),
+                                 (len(jax.devices()), 17), 0,
+                                 mcfg.vocab_size)
+        batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+        pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                               batch["input_ids"])
+        tx, state, sh = initialize_parallel_optimizer(pm, params, 1e-3)
+        step = make_train_step(pm, tx, sh, donate=False)
+
+        kill_at = 3
+
+        class Kill(Callback):
+            def on_step_end(self, trainer, metrics):
+                if trainer.host_step == kill_at:
+                    os.kill(os.getpid(), _signal.SIGTERM)
+
+        trainer = Trainer(step, state, callbacks=[
+            CheckpointCallback(ckpt_dir, every=100), Kill(),
+        ], preemption_guard=guard)
+        t_kill = None
+        try:
+            trainer.fit(iter([batch] * 10), max_steps=10)
+        except TrainingPreempted:
+            t_kill = time.perf_counter()
+        if t_kill is None:
+            raise RuntimeError("SIGTERM drill never raised "
+                               "TrainingPreempted")
+        trainer2 = Trainer(step, state, resume_path=ckpt_dir)
+        steps_lost = kill_at - int(trainer2.state.step)
+        trainer2.fit(iter([batch] * 1), max_steps=int(trainer2.state.step)
+                     + 1)
+        recovery_s = time.perf_counter() - t_kill
+    finally:
+        guard.uninstall()
+        if chaos_spec:
+            cs.clear_storage_wrapper()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    aux = {
+        f"resilience_recovery_time_s_{platform}": {
+            "value": round(recovery_s, 3), "unit": "s", "vs_baseline": 1.0},
+        f"resilience_steps_lost_{platform}": {
+            "value": int(steps_lost), "unit": "steps", "vs_baseline": 1.0},
+    }
+    if plan is not None:
+        aux[f"resilience_faults_injected_{platform}"] = {
+            "value": plan.fire_count(), "unit": "faults",
+            "vs_baseline": 1.0}
+    print(f"bench: resilience drill recovery={recovery_s:.3f}s "
+          f"steps_lost={steps_lost}"
+          + (f" faults_injected={plan.fire_count()}" if plan else ""),
+          file=sys.stderr)
+    return aux
+
+
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    _p = argparse.ArgumentParser(description=__doc__)
+    _p.add_argument(
+        "--chaos", nargs="?", metavar="SPEC",
+        const="seed=0; save_text|* : transient, times=2; "
+              "load_text|* : transient, times=1",
+        default=None,
+        help="inject storage faults during the resilience drill; optional "
+             "SPEC is a FaultPlan DSL string (docs/resilience.md), default "
+             "a deterministic transient-fault mix (first saves/loads fail "
+             "once, then heal through the retry path)")
+    _args = _p.parse_args()
+    main(chaos_spec=_args.chaos)

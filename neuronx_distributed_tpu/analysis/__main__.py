@@ -24,7 +24,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m neuronx_distributed_tpu.analysis",
         description="nxdlint: JAX/SPMD-aware static analysis "
                     "(mesh-axis, trace-safety, custom-vjp, "
-                    "recompile-hazard)")
+                    "recompile-hazard, resilience)")
     parser.add_argument("paths", nargs="*",
                         help="files or directories to lint")
     parser.add_argument("--select", metavar="RULES",

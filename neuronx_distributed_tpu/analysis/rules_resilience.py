@@ -1,0 +1,88 @@
+"""Rule ``resilience``: signal-handler and sleep hygiene.
+
+Two failure classes the resilience subsystem exists to prevent creep back
+in easily:
+
+* **Bare ``signal.signal`` registration outside ``resilience/``** — ad-hoc
+  handlers silently replace :class:`PreemptionGuard`'s, so SIGTERM stops
+  producing the emergency checkpoint + resumable exit contract
+  (``docs/resilience.md``). All signal registration must go through the
+  guard (or live in the resilience package itself).
+
+* **``time.sleep`` inside JAX-traced code** — a sleep in a ``jit``/
+  ``shard_map``/``scan`` body runs at *trace* time only: the compiled
+  program contains no delay, so the backoff/pacing the author intended
+  silently does nothing (and retrace pauses show up at random). Host-side
+  retry loops (``checkpoint_storage.retry_with_backoff``) are fine — the
+  rule only fires inside syntactically-traced functions, reusing the
+  trace-safety detector.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from . import astutil
+from .core import Finding, LintContext, register
+from .rules_trace_safety import _traced_function_nodes
+
+
+def _in_resilience_package(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return "/resilience/" in norm or norm.startswith("resilience/")
+
+
+def _is_signal_signal(call: ast.Call) -> bool:
+    # signal.signal(...) or `from signal import signal; signal(...)`
+    tail = astutil.tail_name(call.func)
+    root = astutil.root_name(call.func)
+    return tail == "signal" and root == "signal"
+
+
+def _is_time_sleep(call: ast.Call) -> bool:
+    tail = astutil.tail_name(call.func)
+    root = astutil.root_name(call.func)
+    # time.sleep(...) or `from time import sleep; sleep(...)`
+    return (tail == "sleep" and root == "time") or \
+        (tail == "sleep" and root == "sleep")
+
+
+@register(
+    "resilience",
+    "bare signal.signal registration outside resilience/ (bypasses "
+    "PreemptionGuard) and time.sleep inside JAX-traced code (no-op in the "
+    "compiled program)")
+def check(ctx: LintContext) -> Iterator[Finding]:
+    findings: List[Finding] = []
+
+    if not _in_resilience_package(ctx.path):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_signal_signal(node):
+                findings.append(Finding(
+                    ctx.path, node.lineno, node.col_offset, "resilience",
+                    "bare signal.signal registration outside resilience/ — "
+                    "route signal handling through "
+                    "resilience.PreemptionGuard so SIGTERM keeps the "
+                    "emergency-checkpoint + resumable-exit contract"))
+
+    traced = _traced_function_nodes(ctx.tree)
+    if traced:
+        seen = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                continue
+            if id(node) not in traced:
+                continue
+            body = node.body if isinstance(node, ast.Lambda) else node
+            for sub in ast.walk(body):
+                if isinstance(sub, ast.Call) and _is_time_sleep(sub) \
+                        and id(sub) not in seen:
+                    seen.add(id(sub))
+                    findings.append(Finding(
+                        ctx.path, sub.lineno, sub.col_offset, "resilience",
+                        "time.sleep inside a JAX-traced function runs at "
+                        "trace time only — the compiled program contains "
+                        "no delay; move pacing to the host side"))
+    yield from findings

@@ -14,6 +14,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 from typing import Iterator, Optional
 
 import numpy as np
@@ -21,6 +22,12 @@ import numpy as np
 _BUILD_LOCK = threading.Lock()
 _LIB = None
 _LIB_FAILED = False
+
+
+class DataLoaderStallError(RuntimeError):
+    """``next_batch`` produced nothing within ``stall_timeout_s`` — the
+    producer threads are wedged (dead filesystem, mmap fault) rather than
+    slow. The resilience watchdog treats this as a stall, not a crash."""
 
 
 def _csrc_path() -> str:
@@ -69,11 +76,18 @@ class TokenBatchLoader:
 
     def __init__(self, path: str, batch: int, seqlen: int, seed: int = 0,
                  dtype: str = "uint16", nthreads: int = 2,
-                 capacity: int = 8, force_python: bool = False):
+                 capacity: int = 8, force_python: bool = False,
+                 stall_timeout_s: Optional[float] = None):
         self.path = path
         self.batch = batch
         self.seqlen = seqlen
         self.seed = seed
+        # wall-clock budget per next_batch (None = block forever); the
+        # blocking produce runs on a helper thread so a wedged native ring
+        # buffer surfaces as DataLoaderStallError instead of a silent hang
+        self.stall_timeout_s = stall_timeout_s
+        # heartbeat for external stall detection (resilience.Watchdog)
+        self.last_batch_at = time.monotonic()
         self.dtype = np.dtype(dtype)
         if self.dtype.itemsize not in (2, 4):
             raise ValueError("token dtype must be uint16 or uint32")
@@ -105,7 +119,7 @@ class TokenBatchLoader:
             self._rng = np.random.RandomState(seed)
             self.native = False
 
-    def next_batch(self) -> dict:
+    def _produce(self) -> np.ndarray:
         n = self.batch * (self.seqlen + 1)
         if self._handle is not None:
             out = np.empty((n,), np.int32)
@@ -114,13 +128,39 @@ class TokenBatchLoader:
                     ctypes.POINTER(ctypes.c_int32)))
             if rc != 0:
                 raise RuntimeError("native loader stopped")
-            ids = out.reshape(self.batch, self.seqlen + 1)
+            return out.reshape(self.batch, self.seqlen + 1)
+        idx = self._rng.randint(0, self.num_sequences, self.batch)
+        per = self.seqlen + 1
+        return np.stack([
+            np.asarray(self._tokens[i * per:(i + 1) * per],
+                       dtype=np.int32) for i in idx])
+
+    def next_batch(self) -> dict:
+        if self.stall_timeout_s is None:
+            ids = self._produce()
         else:
-            idx = self._rng.randint(0, self.num_sequences, self.batch)
-            per = self.seqlen + 1
-            ids = np.stack([
-                np.asarray(self._tokens[i * per:(i + 1) * per],
-                           dtype=np.int32) for i in idx])
+            box = {}
+
+            def run():
+                try:
+                    box["ids"] = self._produce()
+                except BaseException as e:  # re-raised on the caller
+                    box["err"] = e
+
+            # daemon: a wedged producer blocked in C must not pin the
+            # interpreter open after the caller gave up on it
+            t = threading.Thread(target=run, daemon=True,
+                                 name="nxd-loader-next")
+            t.start()
+            t.join(timeout=self.stall_timeout_s)
+            if t.is_alive():
+                raise DataLoaderStallError(
+                    f"data loader produced no batch within "
+                    f"{self.stall_timeout_s:.1f}s (native={self.native})")
+            if "err" in box:
+                raise box["err"]
+            ids = box["ids"]
+        self.last_batch_at = time.monotonic()
         return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
 
     def __iter__(self) -> Iterator[dict]:

@@ -1,0 +1,45 @@
+"""Resilience subsystem: fault injection, preemption-safe checkpointing,
+training watchdog, and verified resume.
+
+The reference NxD stack treats durability as a first-class concern (async
+commit protocol with done-markers, tenacity-style storage retries,
+``finalize_checkpoint`` atexit flush). This package makes those guarantees
+*provable* and *actionable*:
+
+* :mod:`chaos` — :class:`FaultPlan` / :class:`ChaosCheckpointStorage`:
+  deterministic, seed-driven fault injection over any
+  ``BaseCheckpointStorage`` so the retry/backoff and commit-protocol
+  invariants are testable (and exercisable from ``bench.py --chaos``).
+* :mod:`preemption` — :class:`PreemptionGuard`: SIGTERM/SIGINT turns into a
+  synchronous emergency checkpoint at the next step boundary, then a
+  resumable exit (:data:`EXIT_PREEMPTED`), with a grace deadline.
+* :mod:`watchdog` — :class:`Watchdog`: non-finite loss/grad detection with
+  ``halt`` / ``skip_step`` / ``rewind`` policies, loss-spike z-score
+  detection, and a host-side stall timer for hung collectives or stalled
+  data loaders.
+* :mod:`manifest` — per-tag save manifests (file list + sizes + metadata
+  checksum) behind verified resume: ``load_checkpoint`` falls back to the
+  newest *prior* complete tag on corruption.
+
+See ``docs/resilience.md``.
+"""
+
+from .chaos import ChaosCheckpointStorage, FaultPlan, FaultRule, InjectedFault
+from .manifest import (MANIFEST_FILE, build_manifest, verify_manifest)
+from .preemption import (EXIT_PREEMPTED, PreemptionGuard, TrainingPreempted)
+from .watchdog import Watchdog, WatchdogHalt
+
+__all__ = [
+    "ChaosCheckpointStorage",
+    "FaultPlan",
+    "FaultRule",
+    "InjectedFault",
+    "MANIFEST_FILE",
+    "build_manifest",
+    "verify_manifest",
+    "EXIT_PREEMPTED",
+    "PreemptionGuard",
+    "TrainingPreempted",
+    "Watchdog",
+    "WatchdogHalt",
+]

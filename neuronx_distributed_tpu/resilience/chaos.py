@@ -1,0 +1,256 @@
+"""Deterministic fault injection for checkpoint storage.
+
+:class:`ChaosCheckpointStorage` wraps any ``BaseCheckpointStorage`` and
+injects faults according to a :class:`FaultPlan` — a small, seed-driven DSL
+of :class:`FaultRule` entries. Faults are *deterministic* for a given
+(seed, op sequence): the same plan replayed over the same operations injects
+the same faults, so chaos tests are reproducible bit-for-bit.
+
+Three fault kinds:
+
+* ``transient`` — raises :class:`InjectedFault` (a ``ConnectionError``
+  subclass carrying a throttle marker) that ``_is_transient`` classifies as
+  retriable; proves the retry/backoff path heals real hiccups.
+* ``permanent`` — raises ``OSError(ENOSPC)``, a deterministic local
+  condition that must surface immediately (no retries burned).
+* ``latency`` — sleeps ``latency_s`` before the op (host-side only; never
+  inside traced code).
+
+The plan is buildable programmatically or parsed from a compact spec string
+usable from the CLI (``bench.py --chaos``)::
+
+    seed=7; save_text|*/checkpoint : transient, p=0.5, times=2; * : latency=0.01
+
+Each ``;``-separated clause is ``op[|pathglob] : kind-and-options`` where
+options are ``p=<prob>``, ``after=<n calls>``, ``times=<max fires>``,
+``latency=<seconds>``. A leading ``seed=<int>`` clause seeds the RNG.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import fnmatch
+import random
+import threading
+import time
+from typing import Any, List, Optional
+
+from ..trainer.checkpoint_storage import (BaseCheckpointStorage,
+                                          retry_with_backoff)
+
+
+class InjectedFault(ConnectionError):
+    """A chaos-injected transient fault. The message carries a throttle
+    marker so ``_is_transient`` classifies it exactly like a real S3
+    503 slow-down."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One injection rule; all matching is AND-ed.
+
+    ``op``/``path`` are ``fnmatch`` globs over the storage method name and
+    its path argument. ``after`` skips the first N matching calls; ``times``
+    caps how often the rule fires (-1 = unlimited); ``prob`` is the
+    per-matching-call fire probability drawn from the plan's seeded RNG.
+    """
+
+    op: str = "*"
+    path: str = "*"
+    kind: str = "transient"  # transient | permanent | latency
+    prob: float = 1.0
+    after: int = 0
+    times: int = -1
+    latency_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.kind not in ("transient", "permanent", "latency"):
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             "(transient | permanent | latency)")
+        if not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"prob must be in [0, 1], got {self.prob}")
+
+    def matches(self, op: str, path: str) -> bool:
+        return (fnmatch.fnmatch(op, self.op)
+                and fnmatch.fnmatch(path, self.path))
+
+
+class FaultPlan:
+    """A seeded sequence of :class:`FaultRule` with per-rule fire state.
+
+    Thread-safe: async commit threads and the training thread hit the same
+    storage object concurrently, so match counting and the RNG draw are
+    serialized under one lock.
+    """
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0):
+        self.rules = list(rules)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._matched = [0] * len(self.rules)
+        self._fired = [0] * len(self.rules)
+        self.injected: List[str] = []  # audit log: "kind op path"
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse the spec DSL (see module docstring)."""
+        seed = 0
+        rules: List[FaultRule] = []
+        for clause in (c.strip() for c in spec.split(";")):
+            if not clause:
+                continue
+            if clause.replace(" ", "").startswith("seed="):
+                seed = int(clause.split("=", 1)[1])
+                continue
+            if ":" not in clause:
+                raise ValueError(
+                    f"bad fault clause {clause!r}: expected "
+                    "'op[|pathglob] : kind-and-options'")
+            target, opts = (s.strip() for s in clause.split(":", 1))
+            op, _, path = (s.strip() for s in target.partition("|"))
+            kw: dict = {"op": op or "*", "path": path or "*"}
+            kind = None
+            for item in (o.strip() for o in opts.split(",")):
+                if not item:
+                    continue
+                if "=" in item:
+                    k, v = (s.strip() for s in item.split("=", 1))
+                    if k == "p":
+                        kw["prob"] = float(v)
+                    elif k == "after":
+                        kw["after"] = int(v)
+                    elif k == "times":
+                        kw["times"] = int(v)
+                    elif k == "latency":
+                        kw["latency_s"] = float(v)
+                        kind = kind or "latency"
+                    else:
+                        raise ValueError(f"unknown fault option {k!r}")
+                else:
+                    kind = item
+            kw["kind"] = kind or "transient"
+            rules.append(FaultRule(**kw))
+        return cls(rules, seed=seed)
+
+    def fire_count(self) -> int:
+        with self._lock:
+            return sum(self._fired)
+
+    def apply(self, op: str, path: str) -> None:
+        """Consult every rule for this (op, path); raise/sleep as directed.
+
+        The first raising rule wins; latency rules sleep and keep going so a
+        latency+transient combination behaves like a slow failing store.
+        """
+        to_raise: Optional[BaseException] = None
+        sleep_s = 0.0
+        with self._lock:
+            for i, rule in enumerate(self.rules):
+                if not rule.matches(op, path):
+                    continue
+                self._matched[i] += 1
+                if self._matched[i] <= rule.after:
+                    continue
+                if rule.times >= 0 and self._fired[i] >= rule.times:
+                    continue
+                if rule.prob < 1.0 and self._rng.random() >= rule.prob:
+                    continue
+                self._fired[i] += 1
+                self.injected.append(f"{rule.kind} {op} {path}")
+                if rule.kind == "latency":
+                    sleep_s = max(sleep_s, rule.latency_s)
+                elif to_raise is None and rule.kind == "transient":
+                    to_raise = InjectedFault(
+                        f"chaos: injected transient fault on {op}({path!r}) "
+                        "— 503 slow down")
+                elif to_raise is None:
+                    to_raise = OSError(
+                        errno.ENOSPC,
+                        f"chaos: injected permanent fault on {op}({path!r})"
+                        " — no space left on device")
+        if sleep_s > 0:
+            time.sleep(sleep_s)
+        if to_raise is not None:
+            raise to_raise
+
+
+class ChaosCheckpointStorage(BaseCheckpointStorage):
+    """Fault-injecting wrapper over any storage backend.
+
+    Every control-plane op consults the plan *before* delegating, then runs
+    under the same ``retry_with_backoff`` policy the object-store backend
+    uses — injected transients heal through real retries, injected
+    permanents surface immediately, exercising the full classification
+    path (``retries=False`` bypasses the retry layer to observe raw
+    faults).
+    """
+
+    def __init__(self, inner: BaseCheckpointStorage, plan: FaultPlan,
+                 retries: bool = True, **retry_kwargs: Any):
+        super().__init__(inner.dirname())
+        self.inner = inner
+        self.plan = plan
+        self._retries = retries
+        self._retry_kwargs = retry_kwargs
+
+    def _run(self, op: str, path: str, fn):
+        def attempt():
+            self.plan.apply(op, path)
+            return fn()
+        if self._retries:
+            return retry_with_backoff(**self._retry_kwargs)(attempt)()
+        return attempt()
+
+    def dir_exists(self, dirname: str) -> bool:
+        return self._run("dir_exists", dirname,
+                         lambda: self.inner.dir_exists(dirname))
+
+    def file_exists(self, filename: str) -> bool:
+        return self._run("file_exists", filename,
+                         lambda: self.inner.file_exists(filename))
+
+    def create_dir(self, dirname: str) -> None:
+        return self._run("create_dir", dirname,
+                         lambda: self.inner.create_dir(dirname))
+
+    def list_dirs(self, dirname: str) -> List[str]:
+        return self._run("list_dirs", dirname,
+                         lambda: self.inner.list_dirs(dirname))
+
+    def list_files(self, dirname: str):
+        return self._run("list_files", dirname,
+                         lambda: self.inner.list_files(dirname))
+
+    def file_size(self, filename: str):
+        return self._run("file_size", filename,
+                         lambda: self.inner.file_size(filename))
+
+    def remove_dir(self, dirname: str) -> None:
+        return self._run("remove_dir", dirname,
+                         lambda: self.inner.remove_dir(dirname))
+
+    def remove_file(self, filename: str) -> None:
+        return self._run("remove_file", filename,
+                         lambda: self.inner.remove_file(filename))
+
+    def save_text(self, text: str, filename: str) -> None:
+        return self._run("save_text", filename,
+                         lambda: self.inner.save_text(text, filename))
+
+    def load_text(self, filename: str) -> str:
+        return self._run("load_text", filename,
+                         lambda: self.inner.load_text(filename))
+
+
+def wrapper_for_plan(plan: FaultPlan, retries: bool = True,
+                     **retry_kwargs: Any):
+    """A factory suitable for ``checkpoint_storage.install_storage_wrapper``
+    — every storage the engine creates gets chaos-wrapped with ``plan``."""
+    def wrap(inner: BaseCheckpointStorage) -> ChaosCheckpointStorage:
+        if isinstance(inner, ChaosCheckpointStorage):
+            return inner  # never stack chaos on chaos
+        return ChaosCheckpointStorage(inner, plan, retries=retries,
+                                      **retry_kwargs)
+    return wrap

@@ -1,0 +1,238 @@
+"""Training watchdog: anomaly detection with recovery policies.
+
+A NaN loss, an exploding gradient, or a hung collective each wedge a run in
+a different way: the first two silently destroy the model while steps keep
+"succeeding"; the last produces no steps at all. :class:`Watchdog` is a
+``Trainer`` callback covering all three:
+
+* **non-finite loss / grad-norm** → configurable policy:
+
+  - ``halt``: raise :class:`WatchdogHalt` (default — fail loudly);
+  - ``skip_step``: roll ``trainer.state`` back to the pre-step snapshot and
+    continue with the next batch (requires a non-donating ``step_fn``; the
+    on-device equivalent is ``make_train_step(skip_nonfinite=True)``);
+  - ``rewind``: restore the newest complete checkpoint from
+    ``checkpoint_path`` and continue from there.
+
+* **loss spikes** — rolling z-score over the last ``spike_window`` finite
+  losses; a spike logs a machine-parseable event (and optionally applies
+  the anomaly policy when ``spike_is_anomaly=True``).
+
+* **stalls** — a host-side daemon thread watches a heartbeat updated at
+  every step boundary; a step exceeding ``stall_timeout_s`` wall-clock
+  (hung collective, stalled ``data/native_loader`` iterator) fires
+  ``on_stall`` — by default logging CRITICAL and interrupting the main
+  thread so the run dies visibly instead of burning a reservation.
+
+The watchdog reads ``float(metrics[...])`` and is therefore *the* host sync
+point of the loop — by design: anomaly detection needs the value, and a
+single fetch per step is the price of catching divergence the step it
+happens.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..utils.logger import get_logger, log_event
+
+logger = get_logger(__name__)
+
+_POLICIES = ("halt", "skip_step", "rewind")
+
+
+class WatchdogHalt(RuntimeError):
+    """Training halted by the watchdog (non-finite metrics with policy
+    ``halt``, or a recovery policy that ran out of budget)."""
+
+
+def _state_step(state) -> Optional[int]:
+    step = getattr(state, "step", None)
+    if step is None and isinstance(state, dict):
+        step = state.get("step")
+    try:
+        return None if step is None else int(step)
+    except Exception:
+        return None
+
+
+class Watchdog:
+    """See module docstring. Construct and pass via ``callbacks=[...]``."""
+
+    #: tells Trainer.fit to keep a pre-step state snapshot for skip_step
+    needs_prev_state = True
+
+    def __init__(self, policy: str = "halt",
+                 checkpoint_path: Optional[str] = None,
+                 max_consecutive_skips: int = 5,
+                 max_rewinds: int = 3,
+                 spike_window: int = 32,
+                 spike_zscore: float = 8.0,
+                 spike_min_steps: int = 8,
+                 spike_is_anomaly: bool = False,
+                 stall_timeout_s: Optional[float] = None,
+                 on_stall: Optional[Callable] = None):
+        if policy not in _POLICIES:
+            raise ValueError(f"unknown watchdog policy {policy!r}; "
+                             f"expected one of {_POLICIES}")
+        if policy == "rewind" and checkpoint_path is None:
+            raise ValueError("policy='rewind' requires checkpoint_path")
+        self.policy = policy
+        self.checkpoint_path = checkpoint_path
+        self.max_consecutive_skips = max_consecutive_skips
+        self.max_rewinds = max_rewinds
+        self.spike_window = spike_window
+        self.spike_zscore = spike_zscore
+        self.spike_min_steps = max(spike_min_steps, 2)
+        self.spike_is_anomaly = spike_is_anomaly
+        self.stall_timeout_s = stall_timeout_s
+        self._on_stall = on_stall or self._default_on_stall
+        self._losses: collections.deque = collections.deque(
+            maxlen=spike_window)
+        self._consecutive_skips = 0
+        self._rewinds = 0
+        self.anomalies = 0
+        self.spikes = 0
+        self.stalls = 0
+        self._heartbeat = time.monotonic()
+        self._stop = threading.Event()
+        self._stall_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- stalls
+
+    def _default_on_stall(self, trainer) -> None:
+        logger.critical(
+            "watchdog: step exceeded the %.1fs wall-clock budget — hung "
+            "collective or stalled data loader; interrupting the run",
+            self.stall_timeout_s)
+        import _thread
+
+        _thread.interrupt_main()
+
+    def _stall_loop(self) -> None:
+        assert self.stall_timeout_s is not None
+        poll = min(1.0, self.stall_timeout_s / 4.0)
+        fired_for = None
+        while not self._stop.wait(poll):
+            hb = self._heartbeat
+            if time.monotonic() - hb > self.stall_timeout_s:
+                if fired_for == hb:
+                    continue  # one shot per stalled step
+                fired_for = hb
+                self.stalls += 1
+                log_event(logger, "watchdog_stall",
+                          budget_s=self.stall_timeout_s,
+                          stalled_for_s=round(time.monotonic() - hb, 3))
+                try:
+                    self._on_stall(self._trainer)
+                except Exception:
+                    logger.exception("watchdog: on_stall callback failed")
+
+    # ---------------------------------------------------- Callback hooks
+
+    def on_train_start(self, trainer) -> None:
+        self._trainer = trainer
+        self._heartbeat = time.monotonic()
+        if self.stall_timeout_s is not None and self._stall_thread is None:
+            self._stop.clear()
+            self._stall_thread = threading.Thread(
+                target=self._stall_loop, daemon=True,
+                name="nxd-watchdog-stall")
+            self._stall_thread.start()
+
+    def on_step_end(self, trainer, metrics: Dict) -> None:
+        self._heartbeat = time.monotonic()
+        loss = float(metrics.get("loss", float("nan")))
+        grad_norm = float(metrics.get("grad_norm", 0.0))
+        if not (math.isfinite(loss) and math.isfinite(grad_norm)):
+            self._anomaly(trainer,
+                          f"non-finite metrics at step {trainer.host_step}: "
+                          f"loss={loss} grad_norm={grad_norm}")
+            return
+        self._consecutive_skips = 0
+        self._check_spike(trainer, loss)
+        self._losses.append(loss)
+
+    def on_eval_end(self, trainer, metrics: Dict) -> None: ...
+
+    def on_train_end(self, trainer) -> None:
+        self._stop.set()
+        if self._stall_thread is not None:
+            self._stall_thread.join(timeout=5.0)
+            self._stall_thread = None
+
+    # ----------------------------------------------------------- spikes
+
+    def _check_spike(self, trainer, loss: float) -> None:
+        if len(self._losses) < self.spike_min_steps:
+            return
+        mean = sum(self._losses) / len(self._losses)
+        var = sum((x - mean) ** 2 for x in self._losses) / len(self._losses)
+        std = math.sqrt(var)
+        z = (loss - mean) / max(std, 1e-8)
+        if z > self.spike_zscore:
+            self.spikes += 1
+            log_event(logger, "watchdog_loss_spike",
+                      step=trainer.host_step, loss=round(loss, 6),
+                      rolling_mean=round(mean, 6), zscore=round(z, 2))
+            if self.spike_is_anomaly:
+                self._anomaly(trainer,
+                              f"loss spike at step {trainer.host_step}: "
+                              f"loss={loss:.4g} z={z:.1f}")
+
+    # --------------------------------------------------------- anomalies
+
+    def _anomaly(self, trainer, reason: str) -> None:
+        self.anomalies += 1
+        log_event(logger, "watchdog_anomaly", policy=self.policy,
+                  step=trainer.host_step, reason=reason)
+        if self.policy == "halt":
+            raise WatchdogHalt(reason)
+        if self.policy == "skip_step":
+            prev = getattr(trainer, "_prev_state", None)
+            if prev is None:
+                raise WatchdogHalt(
+                    f"{reason} — skip_step needs the pre-step state; use a "
+                    "non-donating step_fn (make_train_step(donate=False)) "
+                    "or the on-device skip_nonfinite=True")
+            self._consecutive_skips += 1
+            if self._consecutive_skips > self.max_consecutive_skips:
+                raise WatchdogHalt(
+                    f"{reason} — {self._consecutive_skips} consecutive "
+                    "skipped steps; the run is not recovering")
+            trainer.state = prev
+            trainer.host_step = max(trainer.host_step - 1, 0)
+            logger.warning("watchdog: skipped bad update, retrying from "
+                           "step %d", trainer.host_step)
+            return
+        # rewind
+        from ..trainer import checkpoint as ckpt
+
+        if self._rewinds >= self.max_rewinds:
+            raise WatchdogHalt(
+                f"{reason} — rewound {self._rewinds} times already; "
+                "the run is not recovering")
+        if not ckpt.has_checkpoint(self.checkpoint_path):
+            raise WatchdogHalt(
+                f"{reason} — no complete checkpoint under "
+                f"{self.checkpoint_path} to rewind to")
+        import jax
+
+        target = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding),
+            trainer.state)
+        trainer.state, _ = ckpt.load_checkpoint(self.checkpoint_path,
+                                                tag=None, target=target)
+        self._rewinds += 1
+        step = _state_step(trainer.state)
+        if step is not None:
+            trainer.host_step = step
+        self._losses.clear()
+        logger.warning("watchdog: rewound to checkpoint step %s "
+                       "(rewind %d/%d)", step, self._rewinds,
+                       self.max_rewinds)

@@ -29,6 +29,7 @@ from typing import Any, List, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
+from ..resilience import manifest as _manifest
 from .checkpoint_storage import BaseCheckpointStorage, create_checkpoint_storage
 
 logger = logging.getLogger(__name__)
@@ -37,12 +38,20 @@ DONE_FILE = "checkpoint"  # reference: done-marker file name
 NEWEST_FILE = "newest"
 STATE_DIR = "state"
 USER_CONTENT_FILE = "user_content.json"
+MANIFEST_FILE = _manifest.MANIFEST_FILE
 
 
 class CheckpointSaveError(RuntimeError):
     """An async checkpoint commit failed (raised at the next
     save/finalize/wait, never swallowed — reference propagates at
     ``wait_save``, ``checkpoint.py:198``)."""
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint with a done-marker failed manifest verification (or
+    restore), and no fallback was possible: explicit-tag loads never fall
+    back silently, and auto-resume raises this only after every complete
+    tag was tried."""
 
 
 class CheckpointIOState:
@@ -168,9 +177,11 @@ def save_checkpoint(
     # before the state dir is touched, else a crash mid-rewrite leaves a
     # half-written checkpoint that _is_complete() accepts. An in-flight
     # async save of the same tag would re-write the marker from its commit
-    # thread — join it first.
+    # thread — join it first. The stale manifest goes too: it describes
+    # the files being replaced.
     _IO_STATE.wait_tag(tag)
     storage.remove_file(os.path.join(tdir, DONE_FILE))
+    storage.remove_file(os.path.join(tdir, MANIFEST_FILE))
 
     ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
     state_path = _orbax_path(tdir)
@@ -189,6 +200,11 @@ def save_checkpoint(
     def commit():
         ckptr.wait_until_finished()
         ckptr.close()
+        # manifest after the payload is durable (sizes are final), before
+        # the done-marker: a complete tag always carries its inventory
+        man = _manifest.build_manifest(storage, tdir, tag)
+        if man is not None:
+            storage.save_object(man, os.path.join(tdir, MANIFEST_FILE))
         storage.save_text("done", os.path.join(tdir, DONE_FILE))
         storage.save_text(tag, os.path.join(path, NEWEST_FILE))
         if num_kept > 0:
@@ -211,14 +227,23 @@ def save_checkpoint(
         commit()
 
 
+# Retention runs on async commit threads: two overlapping saves that both
+# carry num_kept would otherwise list/remove concurrently — each computes
+# a stale survivor set and can delete a tag the other just committed.
+_RETENTION_LOCK = threading.Lock()
+
+
 def _apply_retention(storage: BaseCheckpointStorage, path: str,
                      num_kept: int) -> None:
     """Keep the newest ``num_kept`` complete tags (reference
-    ``_determine_remove_tags:66``)."""
-    tags = _complete_tags(storage, path)
-    for t in tags[:-num_kept] if num_kept > 0 else []:
-        logger.info("retention: removing checkpoint %s", t)
-        storage.remove_dir(_tag_dir(path, t))
+    ``_determine_remove_tags:66``). Serialized process-wide: the
+    list-then-remove sequence is not atomic, so concurrent commit threads
+    take turns."""
+    with _RETENTION_LOCK:
+        tags = _complete_tags(storage, path)
+        for t in tags[:-num_kept] if num_kept > 0 else []:
+            logger.info("retention: removing checkpoint %s", t)
+            storage.remove_dir(_tag_dir(path, t))
 
 
 def finalize_checkpoint() -> None:
@@ -227,10 +252,35 @@ def finalize_checkpoint() -> None:
     _IO_STATE.wait_all()
 
 
+def _verify_tag(storage: BaseCheckpointStorage, path: str,
+                tag: str) -> Tuple[bool, str]:
+    tdir = _tag_dir(path, tag)
+    return _manifest.verify_manifest(storage, tdir,
+                                     os.path.join(tdir, MANIFEST_FILE))
+
+
+def _restore_tag(storage: BaseCheckpointStorage, path: str, tag: str,
+                 target: Optional[Any]) -> Tuple[Any, Optional[dict]]:
+    tdir = _tag_dir(path, tag)
+    ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+    restore_args = (ocp.args.StandardRestore(target)
+                    if target is not None else ocp.args.StandardRestore())
+    try:
+        state = ckptr.restore(_orbax_path(tdir), args=restore_args)
+    finally:
+        ckptr.close()
+    user_content = None
+    uc = os.path.join(tdir, USER_CONTENT_FILE)
+    if storage.file_exists(uc):
+        user_content = storage.load_object(uc)
+    return state, user_content
+
+
 def load_checkpoint(
     path: str,
     tag: Optional[Any] = None,
     target: Optional[Any] = None,
+    verify: bool = True,
 ) -> Tuple[Any, Optional[dict]]:
     """Load ``(state, user_content)``.
 
@@ -239,30 +289,53 @@ def load_checkpoint(
     pytree of arrays or ``jax.ShapeDtypeStruct`` (with shardings) directing
     dtype/sharding of the restore — restoring to a different mesh than the
     save reshards transparently.
+
+    Verified resume (``verify=True``): a tag's manifest (file inventory +
+    metadata checksum, written by ``save_checkpoint`` before the
+    done-marker) is checked first. In auto-resume mode a corrupt or
+    unrestorable tag falls back to the newest *prior* complete tag with a
+    logged warning; an explicit-tag load raises
+    :class:`CheckpointCorruptionError` instead — the caller named that tag,
+    silently loading another would be worse than failing.
     """
     path = _normalize_path(path)
     storage = create_checkpoint_storage(path)
     if tag is None or str(tag) == "-1":
-        tags = _complete_tags(storage, path)
-        if not tags:
-            raise FileNotFoundError(f"no complete checkpoint under {path}")
         # The 'newest' pointer is only a fast-path hint: out-of-order async
         # commits (or a crash between done-marker and pointer write) can
         # leave it pointing at an older complete tag — never resume behind
         # the newest complete checkpoint.
-        tag = tags[-1]
+        tags = _complete_tags(storage, path)
+        if not tags:
+            raise FileNotFoundError(f"no complete checkpoint under {path}")
+        skipped = []
+        for t in reversed(tags):
+            if verify:
+                ok, why = _verify_tag(storage, path, t)
+                if not ok:
+                    logger.warning(
+                        "checkpoint %s/%s failed verification (%s); "
+                        "falling back to the prior complete tag", path, t,
+                        why)
+                    skipped.append((t, why))
+                    continue
+            try:
+                return _restore_tag(storage, path, t, target)
+            except Exception as e:
+                logger.warning(
+                    "checkpoint %s/%s failed to restore (%r); falling back "
+                    "to the prior complete tag", path, t, e)
+                skipped.append((t, repr(e)))
+        raise CheckpointCorruptionError(
+            f"no intact checkpoint under {path}; skipped: "
+            + "; ".join(f"{t}: {why}" for t, why in skipped))
     tag = str(tag)
     if not _is_complete(storage, path, tag):
         raise FileNotFoundError(
             f"checkpoint {path}/{tag} missing or incomplete (no done-marker)")
-    tdir = _tag_dir(path, tag)
-    ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
-    restore_args = (ocp.args.StandardRestore(target)
-                    if target is not None else ocp.args.StandardRestore())
-    state = ckptr.restore(_orbax_path(tdir), args=restore_args)
-    ckptr.close()
-    user_content = None
-    uc = os.path.join(tdir, USER_CONTENT_FILE)
-    if storage.file_exists(uc):
-        user_content = storage.load_object(uc)
-    return state, user_content
+    if verify:
+        ok, why = _verify_tag(storage, path, tag)
+        if not ok:
+            raise CheckpointCorruptionError(
+                f"checkpoint {path}/{tag} is corrupt: {why}")
+    return _restore_tag(storage, path, tag, target)

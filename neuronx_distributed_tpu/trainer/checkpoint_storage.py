@@ -23,7 +23,7 @@ import re
 import shutil
 import time
 from abc import ABC, abstractmethod
-from typing import Any, List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -148,6 +148,17 @@ class BaseCheckpointStorage(ABC):
     def load_object(self, filename: str) -> Any:
         return json.loads(self.load_text(filename))
 
+    def list_files(self, dirname: str) -> Optional[List[Tuple[str, int]]]:
+        """``(relative_path, size_bytes)`` for every file under ``dirname``
+        (recursive, '/'-separated relpaths), or ``None`` when the backend
+        cannot enumerate — callers (manifest verification) must then skip
+        verification rather than fail."""
+        return None
+
+    def file_size(self, filename: str) -> Optional[int]:
+        """Size in bytes, or ``None`` when missing/unsupported."""
+        return None
+
 
 class FilesysCheckpointStorage(BaseCheckpointStorage):
     """Local/NFS filesystem backend (reference
@@ -187,6 +198,28 @@ class FilesysCheckpointStorage(BaseCheckpointStorage):
     def load_text(self, filename: str) -> str:
         with open(filename) as f:
             return f.read()
+
+    def list_files(self, dirname: str) -> Optional[List[Tuple[str, int]]]:
+        if not os.path.isdir(dirname):
+            return []
+        out: List[Tuple[str, int]] = []
+        for root, dirs, files in os.walk(dirname):
+            dirs.sort()
+            for f in sorted(files):
+                full = os.path.join(root, f)
+                rel = os.path.relpath(full, dirname).replace(os.sep, "/")
+                try:
+                    out.append((rel, os.path.getsize(full)))
+                except OSError:
+                    # racing deletion (retention): report what remains
+                    pass
+        return out
+
+    def file_size(self, filename: str) -> Optional[int]:
+        try:
+            return os.path.getsize(filename)
+        except OSError:
+            return None
 
 
 class ObjectStoreCheckpointStorage(BaseCheckpointStorage):
@@ -256,11 +289,60 @@ class ObjectStoreCheckpointStorage(BaseCheckpointStorage):
         with self._fs.open(filename, "r") as f:
             return f.read()
 
+    @retry_with_backoff()
+    def list_files(self, dirname: str) -> Optional[List[Tuple[str, int]]]:
+        if not self._fs.isdir(dirname):
+            return []
+        base = dirname.rstrip("/")
+        out: List[Tuple[str, int]] = []
+        for path, info in sorted(self._fs.find(base, detail=True).items()):
+            if info.get("type") == "directory":
+                continue
+            rel = path[len(base):].lstrip("/") if path.startswith(base) \
+                else os.path.basename(path)
+            out.append((rel, int(info.get("size", 0))))
+        return out
+
+    @retry_with_backoff()
+    def file_size(self, filename: str) -> Optional[int]:
+        try:
+            return int(self._fs.size(filename))
+        except FileNotFoundError:
+            return None
+
+
+# Process-wide storage wrapper hook: the resilience chaos harness (and any
+# future instrumentation layer) interposes on EVERY storage the checkpoint
+# engine creates — including the ones async commit threads construct —
+# without threading a parameter through save/load call sites.
+_STORAGE_WRAPPER: Optional[
+    Callable[[BaseCheckpointStorage], BaseCheckpointStorage]] = None
+
+
+def install_storage_wrapper(
+        wrapper: Callable[[BaseCheckpointStorage],
+                          BaseCheckpointStorage]) -> None:
+    """Wrap every storage subsequently built by
+    :func:`create_checkpoint_storage` (e.g.
+    ``resilience.chaos.wrapper_for_plan(plan)``)."""
+    global _STORAGE_WRAPPER
+    _STORAGE_WRAPPER = wrapper
+
+
+def clear_storage_wrapper() -> None:
+    global _STORAGE_WRAPPER
+    _STORAGE_WRAPPER = None
+
 
 def create_checkpoint_storage(dirname: str) -> BaseCheckpointStorage:
     """Factory (reference ``create_checkpoint_storage:611``)."""
     if dirname.startswith("file://"):
-        return FilesysCheckpointStorage(dirname[len("file://"):])
-    if "://" in dirname:
-        return ObjectStoreCheckpointStorage(dirname)
-    return FilesysCheckpointStorage(dirname)
+        storage: BaseCheckpointStorage = FilesysCheckpointStorage(
+            dirname[len("file://"):])
+    elif "://" in dirname:
+        storage = ObjectStoreCheckpointStorage(dirname)
+    else:
+        storage = FilesysCheckpointStorage(dirname)
+    if _STORAGE_WRAPPER is not None:
+        storage = _STORAGE_WRAPPER(storage)
+    return storage

@@ -10,6 +10,7 @@ the jitted train step; the Lightning surface maps to :class:`Callback` hooks
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional
@@ -17,7 +18,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 import jax
 
 from ..config import NxDConfig
-from ..utils.logger import get_logger
+from ..utils.logger import get_logger, log_event
 from . import checkpoint as ckpt
 
 logger = get_logger(__name__)
@@ -65,20 +66,33 @@ class MetricsLogger(Callback):
 
 class CheckpointCallback(Callback):
     """Periodic async checkpointing with retention + final flush (reference
-    ``lightning/checkpoint_io.py`` over our checkpoint engine)."""
+    ``lightning/checkpoint_io.py`` over our checkpoint engine).
+
+    Step 0 never saves (an untrained checkpoint both wastes a retention
+    slot and can shadow a real resume point), and ``on_train_end`` saves
+    the final step synchronously when it is not aligned to ``every`` — the
+    tail of a run is never lost to alignment.
+    """
 
     def __init__(self, path: str, every: int = 1000, num_kept: int = 3):
         self.path = path
         self.every = every
         self.num_kept = num_kept
+        self._last_saved: Optional[int] = None
 
     def on_step_end(self, trainer, metrics):
         step = trainer.host_step
-        if self.every and step % self.every == 0:
+        if self.every and step > 0 and step % self.every == 0:
             ckpt.save_checkpoint(self.path, step, trainer.state,
                                  async_save=True, num_kept=self.num_kept)
+            self._last_saved = step
 
     def on_train_end(self, trainer):
+        step = trainer.host_step
+        if step > 0 and step != self._last_saved:
+            ckpt.save_checkpoint(self.path, step, trainer.state,
+                                 async_save=False, num_kept=self.num_kept)
+            self._last_saved = step
         ckpt.finalize_checkpoint()
 
 
@@ -92,12 +106,24 @@ class Trainer:
     def __init__(self, step_fn: Callable, state: Any,
                  callbacks: Optional[List[Callback]] = None,
                  resume_path: Optional[str] = None,
-                 eval_fn: Optional[Callable] = None):
+                 eval_fn: Optional[Callable] = None,
+                 preemption_guard: Optional[Any] = None):
         self.step_fn = step_fn
         self.eval_fn = eval_fn
         self.state = state
         self.callbacks = callbacks or []
         self.tokens_per_batch = 0
+        # a resilience.PreemptionGuard: fit() honors a SIGTERM/SIGINT
+        # request at the next step boundary with an emergency checkpoint
+        self.preemption_guard = preemption_guard
+        if preemption_guard is not None and not preemption_guard.installed:
+            preemption_guard.install()
+        # pre-step snapshot for callbacks that roll back a bad update
+        # (Watchdog skip_step); only kept when a callback asks for it —
+        # valid only with a non-donating step_fn
+        self._track_prev = any(
+            getattr(cb, "needs_prev_state", False) for cb in self.callbacks)
+        self._prev_state: Optional[Any] = None
         # host-side mirror of state.step: callbacks read this instead of
         # int(state.step), which would force a device sync every iteration
         # and break async dispatch overlap
@@ -137,10 +163,17 @@ class Trainer:
                 break
             ids = batch.get("input_ids")
             self.tokens_per_batch = int(ids.size) if ids is not None else 0
+            if self._track_prev:
+                self._prev_state = self.state
             self.state, metrics = self.step_fn(self.state, batch)
             self.host_step += 1
             for cb in self.callbacks:
                 cb.on_step_end(self, metrics)
+            if (self.preemption_guard is not None
+                    and self.preemption_guard.requested):
+                # step boundary: the request recorded by the signal handler
+                # becomes a synchronous emergency save + resumable exit
+                self._handle_preemption()
             if (eval_batches is not None and eval_every
                     and self.host_step % eval_every == 0):
                 metrics.update(self.evaluate(eval_batches))
@@ -150,6 +183,82 @@ class Trainer:
         for cb in self.callbacks:
             cb.on_train_end(self)
         return self.state, metrics
+
+    def _checkpoint_path(self) -> Optional[str]:
+        """Where an emergency save goes: the guard's explicit path, else
+        the first CheckpointCallback's — the run resumes from the same
+        directory it periodically checkpoints to."""
+        if self.preemption_guard is not None and \
+                self.preemption_guard.checkpoint_path:
+            return self.preemption_guard.checkpoint_path
+        for cb in self.callbacks:
+            if isinstance(cb, CheckpointCallback):
+                return cb.path
+        return None
+
+    def _handle_preemption(self) -> None:
+        from ..resilience.preemption import TrainingPreempted
+
+        guard = self.preemption_guard
+        guard.announce(self.host_step)
+        path = self._checkpoint_path()
+        saved_tag = None
+        if path is not None:
+            saved_tag = self._emergency_save(path, guard.remaining_grace())
+        else:
+            logger.warning(
+                "preempted with no checkpoint path (no PreemptionGuard "
+                "checkpoint_path and no CheckpointCallback); flushing "
+                "in-flight commits only")
+            ckpt.finalize_checkpoint()
+        log_event(logger, "preemption_exit", step=self.host_step,
+                  saved_tag=saved_tag)
+        raise TrainingPreempted(self.host_step, saved_tag)
+
+    def _emergency_save(self, path: str, grace_s: float) -> Optional[str]:
+        """Synchronous save bounded by the grace deadline. A save that
+        cannot finish in time degrades to flushing the in-flight async
+        commits — the last periodic checkpoint stays the resume point
+        rather than a half-written emergency tag (which the commit
+        protocol would reject on resume anyway)."""
+        tag = str(self.host_step)
+        box: Dict[str, Any] = {}
+
+        def run():
+            try:
+                ckpt.save_checkpoint(path, tag, self.state,
+                                     async_save=False)
+                box["ok"] = True
+            except BaseException as e:  # noqa: BLE001 - reported below
+                box["err"] = e
+
+        # daemon: if the deadline fires we abandon the writer thread so the
+        # process can still exit inside the platform's kill window
+        t = threading.Thread(target=run, daemon=True,
+                             name="ckpt-emergency")
+        t.start()
+        t.join(timeout=max(grace_s, 0.0))
+        if t.is_alive():
+            logger.warning(
+                "emergency checkpoint %s/%s exceeded the %.1fs grace "
+                "deadline; falling back to flushing in-flight commits",
+                path, tag, grace_s)
+            try:
+                ckpt.finalize_checkpoint()
+            except Exception:
+                logger.exception("flushing in-flight commits failed")
+            return None
+        if "err" in box:
+            logger.error("emergency checkpoint %s/%s failed: %r — falling "
+                         "back to flushing in-flight commits", path, tag,
+                         box["err"])
+            try:
+                ckpt.finalize_checkpoint()
+            except Exception:
+                logger.exception("flushing in-flight commits failed")
+            return None
+        logger.info("emergency checkpoint saved: %s/%s", path, tag)
+        return tag
 
     def evaluate(self, batches: Iterable) -> Dict:
         """Mean loss over ``batches`` with NO gradient/optimizer work.

@@ -232,6 +232,7 @@ def make_train_step(
     grad_accum_steps: int = 1,
     scan_steps: int = 1,
     dropout_rng: Optional[jax.Array] = None,
+    skip_nonfinite: bool = False,
 ):
     """Build the jitted SPMD train step.
 
@@ -255,6 +256,14 @@ def make_train_step(
     step so masks differ per step while the compiled program stays one
     program. Only the default loss_fn threads it; custom loss_fn/grad_fn
     callers manage their own rngs.
+
+    ``skip_nonfinite``: guard the update ON DEVICE — when loss or global
+    grad-norm is non-finite, params and optimizer state pass through
+    unchanged (the step counter still advances) and the skip is reported in
+    ``metrics["nonfinite_skipped"]``. This is the donation-compatible
+    counterpart of the resilience ``Watchdog(policy="skip_step")`` host
+    rollback: no extra state copy, no host sync, works with ``donate=True``
+    and inside ``scan_steps``.
     """
     mesh = ps.get_mesh()
 
@@ -337,12 +346,22 @@ def make_train_step(
             loss, grads = accum_grad(state.params, batch, rngs)
         else:
             loss, grads = one_grad(state.params, batch, rngs)
+        grad_norm = optax.global_norm(grads)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
             "loss": loss,
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
         }
+        if skip_nonfinite:
+            # select, don't branch: one compiled program either way, and
+            # the guard composes with donation and scan_steps
+            ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+            keep = lambda new, old: jnp.where(ok, new, old)  # noqa: E731
+            new_params = jax.tree_util.tree_map(keep, new_params,
+                                                state.params)
+            new_opt = jax.tree_util.tree_map(keep, new_opt, state.opt_state)
+            metrics["nonfinite_skipped"] = (~ok).astype(jnp.int32)
         return TrainState(step=state.step + 1, params=new_params,
                           opt_state=new_opt), metrics
 

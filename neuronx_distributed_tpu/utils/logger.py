@@ -9,6 +9,7 @@ one process per host (not per chip); "rank 0" gating maps to
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 from typing import Optional
@@ -45,6 +46,20 @@ def get_logger(name: str = "neuronx_distributed_tpu",
         pass
     _LOGGERS[key] = logger
     return logger
+
+
+def log_event(logger: logging.Logger, event: str, **fields) -> None:
+    """One-line machine-parseable event record: ``NXD_EVENT {json}``.
+
+    The resilience subsystem (preemption, watchdog, chaos drills) emits its
+    operational events through this so ``bench.py`` and launch tooling can
+    grep/parse them without scraping free-form log text. WARNING level:
+    rank0_only loggers on non-zero processes drop below WARNING, and a
+    resilience event from *any* rank must stay visible.
+    """
+    payload = {"event": event, **fields}
+    logger.warning("NXD_EVENT %s",
+                   json.dumps(payload, sort_keys=True, default=str))
 
 
 def rmsg(msg: str) -> str:

@@ -1,0 +1,35 @@
+"""Fixture: resilience-rule violations (never imported, only parsed)."""
+
+import signal
+import time
+from time import sleep
+
+import jax
+
+
+def _noop(signum, frame):
+    pass
+
+
+# bare registration outside resilience/ — must go through PreemptionGuard
+signal.signal(signal.SIGTERM, _noop)
+
+
+@jax.jit
+def traced_with_sleep(x):
+    time.sleep(0.5)  # trace-time no-op: the compiled program has no delay
+    return x * 2
+
+
+def outer(xs):
+    def body(carry, x):
+        sleep(0.1)  # `from time import sleep` form, inside a scan body
+        return carry + x, x
+
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def host_side_is_fine():
+    # NOT traced: host retry pacing is exactly where sleep belongs
+    time.sleep(0.01)
+    return signal.SIGTERM

@@ -158,7 +158,7 @@ def test_cli_nonzero_on_fixture_corpus():
     out_rules = {line.split("[")[1].split("]")[0]
                  for line in r.stdout.splitlines() if "[" in line}
     assert out_rules == {"mesh-axis", "trace-safety", "custom-vjp",
-                         "recompile-hazard"}
+                         "recompile-hazard", "resilience"}
 
 
 def test_cli_zero_on_clean_file():

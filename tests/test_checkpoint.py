@@ -284,6 +284,103 @@ def test_retry_classifies_deterministic_errors(monkeypatch):
     assert calls["n"] == 1
 
 
+def test_is_transient_errno_classification():
+    """Deterministic local OSErrors (disk full, quota, read-only fs) must
+    NOT retry — no amount of backoff frees the disk; environment hiccups
+    (EIO, network) must."""
+    import errno
+
+    from neuronx_distributed_tpu.trainer import checkpoint_storage as cs
+
+    for code in (errno.ENOSPC, errno.EDQUOT, errno.EROFS):
+        assert not cs._is_transient(OSError(code, os.strerror(code)))
+    assert cs._is_transient(OSError(errno.EIO, os.strerror(errno.EIO)))
+    assert cs._is_transient(ConnectionError("reset"))
+    assert cs._is_transient(TimeoutError())
+
+
+def test_retry_backoff_schedule(monkeypatch):
+    """The documented schedule under a fake clock: exponential from
+    base_delay, capped at max_delay, with the decrementing jitter zeroed
+    (random.uniform -> 0) the sleeps are exactly base * 2^attempt."""
+    from neuronx_distributed_tpu.trainer import checkpoint_storage as cs
+
+    sleeps = []
+    monkeypatch.setattr(cs.time, "sleep", sleeps.append)
+    monkeypatch.setattr(cs.random, "uniform", lambda a, b: 0.0)
+
+    @cs.retry_with_backoff(max_attempts=5, base_delay=0.5, max_delay=8.0)
+    def always_throttled():
+        raise ConnectionError("503 slow down")
+
+    with pytest.raises(ConnectionError):
+        always_throttled()
+    assert sleeps == [0.5, 1.0, 2.0, 4.0]
+
+    # max_delay caps the exponential tail
+    sleeps.clear()
+
+    @cs.retry_with_backoff(max_attempts=6, base_delay=1.0, max_delay=4.0)
+    def capped():
+        raise ConnectionError("timed out")
+
+    with pytest.raises(ConnectionError):
+        capped()
+    assert sleeps == [1.0, 2.0, 4.0, 4.0, 4.0]
+
+    # deterministic errno: zero sleeps, surfaces on the first attempt
+    sleeps.clear()
+    import errno
+
+    @cs.retry_with_backoff(max_attempts=5)
+    def disk_full():
+        raise OSError(errno.ENOSPC, "no space left on device")
+
+    with pytest.raises(OSError):
+        disk_full()
+    assert sleeps == []
+
+
+def test_retention_race_serialized(tmp_path, monkeypatch):
+    """Two overlapping async saves that both apply retention must not
+    interleave list-then-remove: each would compute a stale survivor set
+    and can delete a tag the other just committed. _apply_retention is
+    serialized under a module lock — observed concurrency must be 1."""
+    import threading
+    import time as _time
+
+    path = str(tmp_path / "ckpt")
+    for i in (1, 2):
+        ckpt.save_checkpoint(path, i, _state(i), async_save=False)
+
+    active = {"now": 0, "max": 0}
+    lock = threading.Lock()
+    orig = ckpt._complete_tags
+
+    def slow_complete_tags(storage, base):
+        with lock:
+            active["now"] += 1
+            active["max"] = max(active["max"], active["now"])
+        _time.sleep(0.05)  # widen the race window
+        try:
+            return orig(storage, base)
+        finally:
+            with lock:
+                active["now"] -= 1
+
+    monkeypatch.setattr(ckpt, "_complete_tags", slow_complete_tags)
+    ckpt.save_checkpoint(path, 3, _state(3), async_save=True, num_kept=2)
+    ckpt.save_checkpoint(path, 4, _state(4), async_save=True, num_kept=2)
+    ckpt.finalize_checkpoint()
+    monkeypatch.setattr(ckpt, "_complete_tags", orig)
+
+    assert active["max"] == 1, (
+        f"retention ran concurrently (max parallel={active['max']})")
+    # both new tags survived; retention kept exactly the newest two
+    tags = ckpt._complete_tags(ckpt.create_checkpoint_storage(path), path)
+    assert tags == ["3", "4"]
+
+
 def test_async_commit_failure_propagates(tmp_path, monkeypatch):
     """A failing async commit must raise at the next save/finalize instead
     of silently losing the checkpoint (VERDICT r1 weak #6)."""

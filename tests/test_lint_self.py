@@ -23,6 +23,17 @@ def test_package_lints_clean():
         + r.stdout + r.stderr)
 
 
+def test_resilience_package_lints_clean_standalone():
+    """The resilience rule must not flag the resilience package itself:
+    signal.signal registration is allowed by path inside resilience/ (it is
+    where PreemptionGuard lives), and its host-side sleeps are untraced."""
+    r = subprocess.run(
+        [sys.executable, "-m", "neuronx_distributed_tpu.analysis",
+         os.path.join(PACKAGE, "resilience")],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 def test_fixture_corpus_stays_bad():
     """Guards the gate itself: if the analyzer regresses to finding nothing,
     the self-lint above would pass vacuously."""

@@ -1,0 +1,676 @@
+"""Resilience subsystem: fault injection, preemption-safe checkpointing,
+training watchdog, verified resume (docs/resilience.md)."""
+
+import errno
+import json
+import logging
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.resilience import (EXIT_PREEMPTED,
+                                                ChaosCheckpointStorage,
+                                                FaultPlan, FaultRule,
+                                                InjectedFault,
+                                                PreemptionGuard,
+                                                TrainingPreempted, Watchdog,
+                                                WatchdogHalt)
+from neuronx_distributed_tpu.resilience import manifest as rman
+from neuronx_distributed_tpu.resilience.chaos import wrapper_for_plan
+from neuronx_distributed_tpu.trainer import checkpoint as ckpt
+from neuronx_distributed_tpu.trainer import checkpoint_storage as cs
+from neuronx_distributed_tpu.trainer.loop import (Callback,
+                                                  CheckpointCallback, Trainer)
+from neuronx_distributed_tpu.trainer.trainer import TrainState
+
+
+def _state(seed=0):
+    k = jax.random.key(seed)
+    return {
+        "params": {"w": jax.random.normal(k, (8, 4)),
+                   "b": jnp.zeros((4,))},
+        "step": jnp.asarray(7, jnp.int32),
+    }
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan / ChaosCheckpointStorage
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_parse():
+    plan = FaultPlan.parse(
+        "seed=7; save_text|*/checkpoint : transient, p=0.5, times=2; "
+        "load_text : permanent, after=1; * : latency=0.01")
+    assert plan.seed == 7
+    r0, r1, r2 = plan.rules
+    assert (r0.op, r0.path, r0.kind, r0.prob, r0.times) == (
+        "save_text", "*/checkpoint", "transient", 0.5, 2)
+    assert (r1.op, r1.kind, r1.after) == ("load_text", "permanent", 1)
+    assert (r2.op, r2.kind, r2.latency_s) == ("*", "latency", 0.01)
+
+    with pytest.raises(ValueError, match="bad fault clause"):
+        FaultPlan.parse("nonsense")
+    with pytest.raises(ValueError, match="unknown fault option"):
+        FaultPlan.parse("save_text : transient, bogus=1")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultRule(kind="bogus")
+    with pytest.raises(ValueError, match="prob"):
+        FaultRule(prob=1.5)
+
+
+def test_fault_plan_deterministic():
+    """Same (seed, op sequence) -> identical injected faults, replayable
+    bit-for-bit."""
+    spec = "seed=9; save_text : transient, p=0.3"
+
+    def run(plan):
+        out = []
+        for i in range(50):
+            try:
+                plan.apply("save_text", f"/x/{i}")
+                out.append("ok")
+            except InjectedFault:
+                out.append("fault")
+        return out
+
+    a, b = FaultPlan.parse(spec), FaultPlan.parse(spec)
+    assert run(a) == run(b)
+    assert a.fire_count() == b.fire_count() > 0
+    assert a.injected == b.injected
+    # a different seed gives a different fault pattern
+    c = FaultPlan.parse("seed=10; save_text : transient, p=0.3")
+    assert run(c) != run(a)
+
+
+def test_fault_plan_after_and_times():
+    plan = FaultPlan([FaultRule(op="save_text", after=2, times=1)])
+    outcomes = []
+    for i in range(5):
+        try:
+            plan.apply("save_text", f"/f{i}")
+            outcomes.append("ok")
+        except InjectedFault:
+            outcomes.append("fault")
+    # first 2 matching calls skipped, then exactly one fire
+    assert outcomes == ["ok", "ok", "fault", "ok", "ok"]
+    assert plan.fire_count() == 1
+    # non-matching op never fires
+    plan.apply("load_text", "/f")
+
+
+def test_fault_plan_latency():
+    plan = FaultPlan([FaultRule(kind="latency", latency_s=0.05)])
+    t0 = time.perf_counter()
+    plan.apply("save_text", "/f")
+    assert time.perf_counter() - t0 >= 0.04
+
+
+def test_chaos_storage_direct(tmp_path):
+    inner = cs.create_checkpoint_storage(str(tmp_path))
+    f = str(tmp_path / "f.txt")
+
+    # transient fault heals through the retry layer
+    healing = ChaosCheckpointStorage(
+        inner, FaultPlan([FaultRule(op="save_text", times=1)]),
+        base_delay=0.001)
+    healing.save_text("hi", f)
+    assert open(f).read() == "hi"
+    assert healing.plan.fire_count() == 1
+
+    # retries=False surfaces the raw injected fault
+    raw = ChaosCheckpointStorage(
+        inner, FaultPlan([FaultRule(op="load_text")]), retries=False)
+    with pytest.raises(InjectedFault, match="503"):
+        raw.load_text(f)
+
+    # permanent ENOSPC surfaces immediately: exactly one attempt burned
+    perm_plan = FaultPlan([FaultRule(op="file_exists", kind="permanent")])
+    perm = ChaosCheckpointStorage(inner, perm_plan, base_delay=0.001)
+    with pytest.raises(OSError) as ei:
+        perm.file_exists(f)
+    assert ei.value.errno == errno.ENOSPC
+    assert perm_plan.fire_count() == 1
+
+    # wrapper factory never stacks chaos on chaos
+    wrap = wrapper_for_plan(FaultPlan([]))
+    assert wrap(wrap(inner)) is wrap(inner) or isinstance(
+        wrap(wrap(inner)).inner, type(inner))
+
+
+def test_chaos_transient_heals_full_save(tmp_path):
+    """Injected transient faults on the done-marker write heal through the
+    real retry path — the async commit still completes."""
+    path = str(tmp_path / "ckpt")
+    plan = FaultPlan([FaultRule(op="save_text", path="*/checkpoint",
+                                times=2)], seed=1)
+    cs.install_storage_wrapper(wrapper_for_plan(plan, base_delay=0.001,
+                                                max_delay=0.01))
+    try:
+        ckpt.save_checkpoint(path, 1, _state(), async_save=True)
+        ckpt.finalize_checkpoint()
+    finally:
+        cs.clear_storage_wrapper()
+    assert ckpt.has_checkpoint(path, 1)
+    assert plan.fire_count() == 2
+    loaded, _ = ckpt.load_checkpoint(path, 1)
+    np.testing.assert_allclose(loaded["params"]["w"],
+                               _state()["params"]["w"])
+
+
+def test_chaos_permanent_fails_commit(tmp_path):
+    """A permanent (ENOSPC) fault on the done-marker write fails the async
+    commit without burning retries; the tag stays incomplete and the error
+    surfaces at finalize."""
+    path = str(tmp_path / "ckpt")
+    plan = FaultPlan([FaultRule(op="save_text", path="*/checkpoint",
+                                kind="permanent")])
+    cs.install_storage_wrapper(wrapper_for_plan(plan, base_delay=0.001))
+    try:
+        ckpt.save_checkpoint(path, 1, _state(), async_save=True)
+        with pytest.raises(ckpt.CheckpointSaveError):
+            ckpt.finalize_checkpoint()
+    finally:
+        cs.clear_storage_wrapper()
+    assert not ckpt.has_checkpoint(path, 1)
+    assert plan.fire_count() == 1  # deterministic: no retries burned
+
+
+# ---------------------------------------------------------------------------
+# Manifests / verified resume
+# ---------------------------------------------------------------------------
+
+def test_manifest_written_on_save(tmp_path):
+    path = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(path, 1, _state(), user_content={"lr": 0.1},
+                         async_save=False)
+    mpath = os.path.join(path, "1", rman.MANIFEST_FILE)
+    assert os.path.isfile(mpath)
+    man = json.load(open(mpath))
+    assert man["version"] == 1 and man["tag"] == "1"
+    names = [p for p, _ in man["files"]]
+    assert "user_content.json" in names
+    assert any(p.startswith("state/") for p in names)
+    # the done-marker and the manifest itself are excluded
+    assert ckpt.DONE_FILE not in names and rman.MANIFEST_FILE not in names
+    # sizes are exact
+    for rel, size in man["files"]:
+        assert os.path.getsize(os.path.join(path, "1", rel)) == size
+
+    storage = ckpt.create_checkpoint_storage(path)
+    ok, why = rman.verify_manifest(storage, os.path.join(path, "1"), mpath)
+    assert ok, why
+
+
+def _corrupt_tag(path, tag):
+    """Truncate the largest payload file under the tag's state dir."""
+    sdir = os.path.join(path, str(tag), "state")
+    files = [os.path.join(r, f) for r, _, fs in os.walk(sdir) for f in fs]
+    victim = max(files, key=os.path.getsize)
+    size = os.path.getsize(victim)
+    assert size > 0
+    with open(victim, "r+b") as fh:
+        fh.truncate(size // 2)
+    return victim
+
+
+def test_corruption_fallback_to_prior_tag(tmp_path, caplog):
+    """Acceptance: truncate the newest tag's state dir; auto-resume falls
+    back to the prior complete tag with a logged warning; an explicit-tag
+    load of the corrupt tag raises instead of silently falling back."""
+    path = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(path, 1, _state(1), async_save=False)
+    ckpt.save_checkpoint(path, 2, _state(2), async_save=False)
+    _corrupt_tag(path, 2)
+
+    with caplog.at_level(logging.WARNING):
+        loaded, _ = ckpt.load_checkpoint(path, tag=None)
+    np.testing.assert_allclose(loaded["params"]["w"],
+                               _state(1)["params"]["w"])
+    assert "falling back to the prior complete tag" in caplog.text
+
+    with pytest.raises(ckpt.CheckpointCorruptionError, match="corrupt"):
+        ckpt.load_checkpoint(path, tag=2)
+
+    # verify=False trusts the done-marker (legacy behaviour)
+    ok, why = ckpt._verify_tag(ckpt.create_checkpoint_storage(path), path,
+                               "2")
+    assert not ok and "size mismatch" in why
+
+
+def test_corruption_missing_file_detected(tmp_path):
+    path = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(path, 1, _state(), user_content={"a": 1},
+                         async_save=False)
+    os.remove(os.path.join(path, "1", "user_content.json"))
+    ok, why = ckpt._verify_tag(ckpt.create_checkpoint_storage(path), path,
+                               "1")
+    assert not ok and "missing file" in why
+
+
+def test_all_tags_corrupt_raises(tmp_path):
+    path = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(path, 1, _state(1), async_save=False)
+    ckpt.save_checkpoint(path, 2, _state(2), async_save=False)
+    _corrupt_tag(path, 1)
+    _corrupt_tag(path, 2)
+    with pytest.raises(ckpt.CheckpointCorruptionError, match="skipped"):
+        ckpt.load_checkpoint(path, tag=None)
+
+
+def test_legacy_tag_without_manifest_loads(tmp_path):
+    """Tags saved before the manifest format carry none and are accepted
+    as-is — the done-marker stays the baseline guarantee."""
+    path = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(path, 1, _state(3), async_save=False)
+    os.remove(os.path.join(path, "1", rman.MANIFEST_FILE))
+    loaded, _ = ckpt.load_checkpoint(path, tag=None)
+    np.testing.assert_allclose(loaded["params"]["w"],
+                               _state(3)["params"]["w"])
+
+
+# ---------------------------------------------------------------------------
+# Preemption
+# ---------------------------------------------------------------------------
+
+def _fake_state(step=0):
+    return TrainState(step=jnp.asarray(step, jnp.int32),
+                      params={"w": jnp.zeros((4,), jnp.float32)},
+                      opt_state={"m": jnp.zeros((4,), jnp.float32)})
+
+
+def _fake_step_fn(s, batch):
+    return TrainState(
+        step=s.step + 1,
+        params=jax.tree_util.tree_map(lambda x: x + 1.0, s.params),
+        opt_state=s.opt_state), {"loss": jnp.asarray(0.1),
+                                 "grad_norm": jnp.asarray(1.0)}
+
+
+def _fake_batches(n):
+    return iter([{"input_ids": jnp.zeros((1, 2), jnp.int32)}] * n)
+
+
+def test_preemption_guard_handler_contract():
+    guard = PreemptionGuard(grace_s=5.0, signals=(signal.SIGUSR1,))
+    assert not guard.requested
+    assert guard.remaining_grace() == 5.0
+    with guard:
+        assert guard.installed
+        os.kill(os.getpid(), signal.SIGUSR1)
+        # deliver: the handler runs at the next bytecode boundary
+        for _ in range(100):
+            if guard.requested:
+                break
+            time.sleep(0.01)
+        assert guard.requested
+        assert guard.signum == signal.SIGUSR1
+        assert 0.0 <= guard.remaining_grace() <= 5.0
+        guard.reset()
+        assert not guard.requested and guard.signum is None
+    assert not guard.installed
+
+
+def test_preemption_emergency_save_and_resume(tmp_path):
+    """Acceptance: SIGTERM mid-run -> emergency checkpoint at the step
+    boundary -> TrainingPreempted(code 75); rerun resumes from the
+    emergency tag losing ZERO optimizer steps."""
+    path = str(tmp_path / "ckpt")
+    guard = PreemptionGuard(checkpoint_path=path, grace_s=60.0)
+
+    class Kill(Callback):
+        def on_step_end(self, trainer, metrics):
+            if trainer.host_step == 3:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    trainer = Trainer(_fake_step_fn, _fake_state(), callbacks=[
+        CheckpointCallback(path, every=100), Kill(),
+    ], preemption_guard=guard)
+    try:
+        with pytest.raises(TrainingPreempted) as ei:
+            trainer.fit(_fake_batches(10), max_steps=10)
+    finally:
+        guard.uninstall()
+    e = ei.value
+    assert e.code == EXIT_PREEMPTED == 75
+    assert e.step == 3 and e.saved_tag == "3"
+    assert ckpt.has_checkpoint(path, 3)
+
+    # rerun: resume from the emergency checkpoint — zero steps lost
+    trainer2 = Trainer(_fake_step_fn, _fake_state(), resume_path=path)
+    assert int(trainer2.state.step) == 3
+    np.testing.assert_allclose(trainer2.state.params["w"],
+                               np.full((4,), 3.0))
+    st, _ = trainer2.fit(_fake_batches(5), max_steps=5)
+    assert int(st.step) == 5
+
+
+class _Records(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.messages = []
+
+    def emit(self, record):
+        self.messages.append(record.getMessage())
+
+
+def test_preemption_degrades_to_flush_on_expired_grace(tmp_path):
+    """Grace already exhausted at the boundary: the emergency save is
+    abandoned and in-flight commits are flushed — the prior periodic
+    checkpoint stays the resume point."""
+    path = str(tmp_path / "ckpt")
+    guard = PreemptionGuard(checkpoint_path=path, grace_s=0.0)
+
+    class Kill(Callback):
+        def on_step_end(self, trainer, metrics):
+            if trainer.host_step == 2:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    trainer = Trainer(_fake_step_fn, _fake_state(), callbacks=[
+        CheckpointCallback(path, every=1), Kill(),
+    ], preemption_guard=guard)
+    rec = _Records()
+    loop_logger = logging.getLogger("neuronx_distributed_tpu.trainer.loop")
+    loop_logger.addHandler(rec)
+    try:
+        with pytest.raises(TrainingPreempted) as ei:
+            trainer.fit(_fake_batches(10), max_steps=10)
+    finally:
+        guard.uninstall()
+        loop_logger.removeHandler(rec)
+    assert ei.value.saved_tag is None
+    assert any("grace deadline" in m for m in rec.messages), rec.messages
+    # in-flight periodic saves were flushed: step 1 is a complete resume
+    # point (the abandoned tag-2 emergency save dropped that tag's
+    # done-marker, exactly as the commit protocol requires)
+    assert ckpt.has_checkpoint(path, 1)
+
+
+def test_preemption_exit_code_in_subprocess(tmp_path):
+    """Uncaught TrainingPreempted is a SystemExit: the process exits with
+    the documented resumable status 75, and the parent can resume from the
+    emergency checkpoint."""
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "ckpt")
+    script = f"""
+import os, signal
+from neuronx_distributed_tpu.utils.cpu_mesh import force_cpu_platform
+force_cpu_platform(1)
+import jax, jax.numpy as jnp
+from neuronx_distributed_tpu.resilience import PreemptionGuard
+from neuronx_distributed_tpu.trainer.loop import Callback, Trainer
+from neuronx_distributed_tpu.trainer.trainer import TrainState
+
+state = TrainState(step=jnp.asarray(0, jnp.int32),
+                   params={{"w": jnp.zeros((4,), jnp.float32)}},
+                   opt_state={{"m": jnp.zeros((4,), jnp.float32)}})
+
+def step_fn(s, b):
+    return TrainState(step=s.step + 1,
+                      params=jax.tree_util.tree_map(lambda x: x + 1.0,
+                                                    s.params),
+                      opt_state=s.opt_state), {{"loss": jnp.asarray(0.1)}}
+
+class Kill(Callback):
+    def on_step_end(self, trainer, metrics):
+        if trainer.host_step == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+guard = PreemptionGuard(checkpoint_path={path!r}, grace_s=60.0)
+Trainer(step_fn, state, callbacks=[Kill()], preemption_guard=guard).fit(
+    iter([{{"input_ids": jnp.zeros((1, 2), jnp.int32)}}] * 10),
+    max_steps=10)
+raise SystemExit("unreachable: fit must raise TrainingPreempted")
+"""
+    r = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=300, env={**os.environ, "PYTHONPATH": os.getcwd()})
+    assert r.returncode == EXIT_PREEMPTED, (r.returncode, r.stderr[-2000:])
+    # parent-side rerun resumes from the emergency tag with 0 steps lost
+    state, _ = ckpt.load_checkpoint(path, tag=None)
+    assert int(state["step"]) == 3
+    np.testing.assert_allclose(state["params"]["w"], np.full((4,), 3.0))
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_validation():
+    with pytest.raises(ValueError, match="unknown watchdog policy"):
+        Watchdog(policy="bogus")
+    with pytest.raises(ValueError, match="requires checkpoint_path"):
+        Watchdog(policy="rewind")
+
+
+def _nan_once_step_fn(nan_at):
+    """Fake step_fn producing one non-finite loss at host call count
+    ``nan_at`` (1-based), finite otherwise."""
+    calls = {"n": 0}
+
+    def step_fn(s, batch):
+        calls["n"] += 1
+        bad = calls["n"] == nan_at
+        loss = jnp.asarray(float("nan") if bad else 0.1)
+        return TrainState(
+            step=s.step + 1,
+            params=jax.tree_util.tree_map(lambda x: x + 1.0, s.params),
+            opt_state=s.opt_state), {"loss": loss,
+                                     "grad_norm": jnp.asarray(1.0)}
+    return step_fn
+
+
+def test_watchdog_halt():
+    wd = Watchdog(policy="halt")
+    trainer = Trainer(_nan_once_step_fn(nan_at=2), _fake_state(),
+                      callbacks=[wd])
+    with pytest.raises(WatchdogHalt, match="non-finite"):
+        trainer.fit(_fake_batches(5), max_steps=5)
+    assert wd.anomalies == 1
+
+
+def test_watchdog_skip_step():
+    """skip_step rolls back to the pre-step snapshot: the bad update never
+    lands, training continues, and the final params reflect only the good
+    steps."""
+    wd = Watchdog(policy="skip_step")
+    trainer = Trainer(_nan_once_step_fn(nan_at=3), _fake_state(),
+                      callbacks=[wd])
+    st, _ = trainer.fit(_fake_batches(10), max_steps=4)
+    assert wd.anomalies == 1
+    assert int(st.step) == 4
+    # 5 step_fn calls happened but one update was rolled back
+    np.testing.assert_allclose(st.params["w"], np.full((4,), 4.0))
+
+
+def test_watchdog_skip_step_cap():
+    def always_nan(s, batch):
+        return TrainState(step=s.step + 1, params=s.params,
+                          opt_state=s.opt_state), {
+            "loss": jnp.asarray(float("nan")),
+            "grad_norm": jnp.asarray(1.0)}
+
+    wd = Watchdog(policy="skip_step", max_consecutive_skips=2)
+    trainer = Trainer(always_nan, _fake_state(), callbacks=[wd])
+    with pytest.raises(WatchdogHalt, match="not recovering"):
+        trainer.fit(_fake_batches(20), max_steps=10)
+    assert wd.anomalies == 3  # 2 skips + the one that tripped the cap
+
+
+def test_watchdog_rewind(tmp_path):
+    """rewind restores the newest complete checkpoint and continues."""
+    path = str(tmp_path / "ckpt")
+    good = TrainState(step=jnp.asarray(2, jnp.int32),
+                      params={"w": jnp.full((4,), 2.0)},
+                      opt_state={"m": jnp.zeros((4,), jnp.float32)})
+    ckpt.save_checkpoint(path, 2, good, async_save=False)
+
+    wd = Watchdog(policy="rewind", checkpoint_path=path)
+    # the run starts from the checkpointed state (step 2, w=2); the second
+    # step_fn call (host step 4) produces the nan
+    trainer = Trainer(_nan_once_step_fn(nan_at=2), _fake_state(2),
+                      callbacks=[wd])
+    trainer.state = good
+    st, _ = trainer.fit(_fake_batches(10), max_steps=5)
+    assert wd.anomalies == 1
+    assert int(st.step) == 5
+    # call 1 ran (w 2->3), call 2 nan'd and rewound to the tag-2 state
+    # (w=2), then three clean calls finish at step 5 with w=5
+    np.testing.assert_allclose(st.params["w"], np.full((4,), 5.0))
+
+
+def test_watchdog_loss_spike_detection():
+    calls = {"n": 0}
+
+    def spiky(s, batch):
+        calls["n"] += 1
+        loss = 100.0 if calls["n"] == 10 else 1.0
+        return TrainState(step=s.step + 1, params=s.params,
+                          opt_state=s.opt_state), {
+            "loss": jnp.asarray(loss), "grad_norm": jnp.asarray(1.0)}
+
+    wd = Watchdog(spike_min_steps=8, spike_zscore=8.0)
+    trainer = Trainer(spiky, _fake_state(), callbacks=[wd])
+    trainer.fit(_fake_batches(12), max_steps=12)
+    assert wd.spikes == 1
+    assert wd.anomalies == 0  # spike_is_anomaly defaults to False
+
+
+def test_watchdog_stall_timer():
+    """A step exceeding the wall-clock budget fires on_stall from the
+    monitor thread (custom handler here; the default interrupts main)."""
+    stalled = threading.Event()
+
+    def slow_once(s, batch):
+        if int(s.step) == 1:
+            time.sleep(0.6)
+        return _fake_step_fn(s, batch)
+
+    wd = Watchdog(stall_timeout_s=0.15,
+                  on_stall=lambda trainer: stalled.set())
+    trainer = Trainer(slow_once, _fake_state(), callbacks=[wd])
+    trainer.fit(_fake_batches(3), max_steps=3)
+    assert stalled.wait(timeout=2.0)
+    assert wd.stalls >= 1
+    # the monitor thread stops at on_train_end
+    assert wd._stall_thread is None
+
+
+def test_loader_stall_raises(tmp_path, monkeypatch):
+    """A wedged producer surfaces as DataLoaderStallError instead of a
+    silent hang (resilience stall contract for data/native_loader)."""
+    from neuronx_distributed_tpu.data.native_loader import (
+        DataLoaderStallError, TokenBatchLoader)
+
+    tokens = np.arange(4 * 9, dtype=np.uint16)
+    path = str(tmp_path / "toks.bin")
+    tokens.tofile(path)
+    loader = TokenBatchLoader(path, batch=2, seqlen=8, force_python=True,
+                              stall_timeout_s=0.2)
+    b = loader.next_batch()
+    assert b["input_ids"].shape == (2, 8)
+
+    monkeypatch.setattr(loader, "_produce", lambda: time.sleep(5.0))
+    with pytest.raises(DataLoaderStallError, match="no batch within"):
+        loader.next_batch()
+
+
+# ---------------------------------------------------------------------------
+# Device-side non-finite skip (make_train_step(skip_nonfinite=True))
+# ---------------------------------------------------------------------------
+
+def test_train_step_skip_nonfinite_on_device():
+    """The donation/scan-compatible counterpart of Watchdog skip_step: a
+    non-finite loss passes params and opt state through unchanged on
+    device, reported via metrics['nonfinite_skipped']."""
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model, initialize_parallel_optimizer,
+        make_train_step)
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=2)
+    mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                       num_layers=1)
+    model = LlamaForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (4, 17), 0, mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           batch["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 1e-3)
+
+    # per-batch multiplier: inf poisons the loss (and thus the grads)
+    def scaled_loss(module, p, b):
+        return module.apply(p, b["input_ids"], b["labels"],
+                            method="loss") * b["mult"].mean()
+
+    step = make_train_step(pm, tx, sh, loss_fn=scaled_loss, donate=False,
+                           skip_nonfinite=True)
+    good = {**batch, "mult": jnp.ones((4,), jnp.float32)}
+    bad = {**batch, "mult": jnp.full((4,), jnp.inf, jnp.float32)}
+
+    s1, m1 = step(state, bad)
+    assert int(m1["nonfinite_skipped"]) == 1
+    assert int(s1.step) == 1  # the counter still advances
+    w0 = jax.tree_util.tree_leaves(state.params)[0]
+    w1 = jax.tree_util.tree_leaves(s1.params)[0]
+    np.testing.assert_array_equal(np.asarray(w0), np.asarray(w1))
+
+    s2, m2 = step(s1, good)
+    assert int(m2["nonfinite_skipped"]) == 0
+    assert np.isfinite(float(m2["loss"]))
+    w2 = jax.tree_util.tree_leaves(s2.params)[0]
+    assert not np.array_equal(np.asarray(w1), np.asarray(w2))
+
+
+# ---------------------------------------------------------------------------
+# Lint rule
+# ---------------------------------------------------------------------------
+
+def test_resilience_lint_rule_units():
+    from neuronx_distributed_tpu.analysis.core import (DEFAULT_AXES,
+                                                       analyze_source)
+
+    sig = ("import signal\n"
+           "signal.signal(signal.SIGTERM, lambda *a: None)\n")
+    fs = analyze_source(sig, "pkg/trainer/loop.py", DEFAULT_AXES)
+    assert {f.rule for f in fs} == {"resilience"}
+    # allowed by path inside the resilience package
+    assert analyze_source(
+        sig, "neuronx_distributed_tpu/resilience/preemption.py",
+        DEFAULT_AXES) == []
+
+    traced = ("import time\n"
+              "import jax\n"
+              "@jax.jit\n"
+              "def f(x):\n"
+              "    time.sleep(1)\n"
+              "    return x\n")
+    fs = analyze_source(traced, "m.py", DEFAULT_AXES)
+    assert any(f.rule == "resilience" and "trace time" in f.message
+               for f in fs)
+
+    host = ("import time\n"
+            "def g():\n"
+            "    time.sleep(1)\n")
+    assert analyze_source(host, "m.py", DEFAULT_AXES) == []
+
+
+def test_resilience_lint_rule_fixture():
+    from neuronx_distributed_tpu.analysis.core import (DEFAULT_AXES,
+                                                       analyze_source)
+
+    fix = os.path.join(os.path.dirname(__file__), "analysis_fixtures",
+                       "bad_resilience.py")
+    fs = analyze_source(open(fix).read(), fix, DEFAULT_AXES)
+    assert {f.rule for f in fs} == {"resilience"}
+    assert len([f for f in fs if not f.suppressed]) == 3

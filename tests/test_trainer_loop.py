@@ -42,9 +42,11 @@ def test_trainer_loop_with_callbacks(tmp_path):
 
     assert ck.has_checkpoint(ckpt_dir)
 
-    # resume: picks up from the newest checkpoint (step 6)
+    # resume: picks up from the newest checkpoint. Periodic saves landed at
+    # steps 3 and 6; on_train_end additionally saved the final step 7 (not
+    # aligned to every=3), so the run's tail is not lost to alignment.
     trainer2 = Trainer(step, state, resume_path=ckpt_dir)
-    assert int(trainer2.state.step) == 6
+    assert int(trainer2.state.step) == 7
     st, m = trainer2.fit(iter([batch] * 2), max_steps=8)
     assert int(st.step) == 8
 
