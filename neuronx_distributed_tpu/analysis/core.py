@@ -87,7 +87,8 @@ def all_rules() -> Dict[str, Rule]:
 def _ensure_rules_loaded() -> None:
     # Import for side effect (registration). Local import breaks the cycle
     # core -> rules -> core.
-    from . import (rules_custom_vjp,  # noqa: F401
+    from . import (rules_comm_compression,  # noqa: F401
+                   rules_custom_vjp,  # noqa: F401
                    rules_mesh_axes,  # noqa: F401
                    rules_recompile,  # noqa: F401
                    rules_resilience,  # noqa: F401

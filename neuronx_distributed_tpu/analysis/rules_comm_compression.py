@@ -1,0 +1,69 @@
+"""Rule ``comm-compression``: raw gradient collectives bypass the
+compression layer.
+
+A raw ``lax.pmean``/``lax.psum`` on a gradient bypasses everything
+``parallel.grads.allreduce_gradients`` layers on top of the collective:
+FSDP-aware axis skipping from the param specs, the quantized int8/fp8 wire
+format, hierarchical fast/slow staging, and the error-feedback residue
+(docs/comm_compression.md). It also fragments the hot path the
+``grad_comm_*`` config fields are supposed to control — a model whose
+gradients are pmean'd inline stays fp32 no matter what the config says.
+
+The rule fires on ``lax.pmean``/``lax.psum`` calls whose first argument is
+a gradient-named variable (``grad``/``grads``/``g_``.../``*_grad*``)
+outside ``parallel/`` — inside the package the wrappers themselves (and
+the compressed collectives) legitimately issue raw collectives.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List
+
+from . import astutil
+from .core import Finding, LintContext, register
+
+# identifier looks like a gradient: 'grad', 'grads', 'gradients', 'dw',
+# 'g_acc', 'clipped_grads', ... — substring 'grad' or the g/dgrad naming
+# convention with a separator
+_GRAD_NAME = re.compile(r"(^|_)grads?(_|$)|gradient|(^|_)g(acc|sum)?(_|$)",
+                        re.IGNORECASE)
+
+
+def _in_parallel_package(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return "/parallel/" in norm or norm.startswith("parallel/")
+
+
+def _gradient_named(node: ast.AST) -> bool:
+    name = astutil.tail_name(node)
+    if name is None and isinstance(node, ast.Name):
+        name = node.id
+    return bool(name and _GRAD_NAME.search(name))
+
+
+@register(
+    "comm-compression",
+    "raw lax.pmean/lax.psum on gradient-named variables outside parallel/ "
+    "— use parallel.grads.allreduce_gradients so spec-aware skipping, "
+    "quantization and error feedback apply")
+def check(ctx: LintContext) -> Iterator[Finding]:
+    if _in_parallel_package(ctx.path):
+        return
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = astutil.tail_name(node.func)
+        if tail not in ("pmean", "psum"):
+            continue
+        if not node.args or not _gradient_named(node.args[0]):
+            continue
+        findings.append(Finding(
+            ctx.path, node.lineno, node.col_offset, "comm-compression",
+            f"raw lax.{tail} on a gradient — use "
+            "parallel.grads.allreduce_gradients(..., specs=, compression=) "
+            "so FSDP-spec skipping, quantized wire formats and error "
+            "feedback apply (docs/comm_compression.md)"))
+    yield from findings

@@ -89,11 +89,38 @@ class ParallelConfig:
 
 @dataclass(frozen=True)
 class OptimizerConfig:
-    """Reference: ``optimizer_config`` in ``trainer/trainer.py:52-60``."""
+    """Reference: ``optimizer_config`` in ``trainer/trainer.py:52-60``.
+
+    The ``grad_comm_*`` fields drive the communication-compression layer
+    (``parallel/comm_compressed.py``, docs/comm_compression.md): wire
+    dtype for gradient collectives, ZeRO++-style hierarchical staging
+    over the declared fast/slow mesh-axis split, quantization block size,
+    and whether the quantization residue is carried across steps
+    (error feedback, checkpointed in ``TrainState.comm_error``).
+    """
 
     zero_one_enabled: bool = False
     grad_clipping: bool = True
     max_grad_norm: float = 1.0
+    grad_comm_dtype: str = "fp32"          # fp32 | int8 | fp8
+    grad_comm_hierarchical: bool = False
+    grad_comm_block_size: int = 256
+    grad_comm_error_feedback: bool = True
+
+    def __post_init__(self) -> None:
+        if self.grad_comm_dtype not in ("fp32", "int8", "fp8"):
+            raise ValueError(
+                "grad_comm_dtype must be one of ('fp32', 'int8', 'fp8'), "
+                f"got {self.grad_comm_dtype!r}")
+        if (not isinstance(self.grad_comm_block_size, int)
+                or self.grad_comm_block_size < 1):
+            raise ValueError(
+                "grad_comm_block_size must be a positive int, got "
+                f"{self.grad_comm_block_size!r}")
+        if self.grad_clipping and self.max_grad_norm <= 0:
+            raise ValueError(
+                "max_grad_norm must be positive when grad_clipping is "
+                f"enabled, got {self.max_grad_norm!r}")
 
 
 @dataclass(frozen=True)

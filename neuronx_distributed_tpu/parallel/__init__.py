@@ -2,6 +2,7 @@
 
 from . import mesh
 from . import comm
+from . import comm_compressed
 from . import mappings
 from . import grads
 from . import layers
@@ -14,11 +15,14 @@ from .layers import (
     GQAQKVColumnParallelLinear,
 )
 from .loss_functions import parallel_cross_entropy
+from .comm_compressed import CompressionConfig
 from .mesh import (
     initialize_distributed,
     initialize_model_parallel,
     model_parallel_is_initialized,
     destroy_model_parallel,
+    declare_axis_hierarchy,
+    get_axis_hierarchy,
     get_mesh,
     get_expert_mesh,
     get_moe_phase_mesh,
@@ -33,11 +37,15 @@ from .mesh import (
 __all__ = [
     "mesh",
     "comm",
+    "comm_compressed",
+    "CompressionConfig",
     "mappings",
     "initialize_distributed",
     "initialize_model_parallel",
     "model_parallel_is_initialized",
     "destroy_model_parallel",
+    "declare_axis_hierarchy",
+    "get_axis_hierarchy",
     "get_mesh",
     "get_expert_mesh",
     "get_moe_phase_mesh",

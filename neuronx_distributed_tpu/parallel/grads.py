@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import PartitionSpec
 
 from . import comm
+from . import comm_compressed as cc
 from . import mesh as ps
 
 
@@ -46,6 +47,8 @@ def allreduce_gradients(
     grads: Any,
     specs: Optional[Any] = None,
     axes: Sequence[str] = (ps.DP_AXIS, ps.CP_AXIS),
+    compression: Optional["cc.CompressionConfig"] = None,
+    error: Optional[Any] = None,
 ) -> Any:
     """Average gradients over the bound data axes (reference
     ``bucket_allreduce_gradients:259`` + CP reduce ``:348``).
@@ -60,23 +63,53 @@ def allreduce_gradients(
 
     ``specs``: optional PartitionSpec tree; a leaf already sharded over one
     of ``axes`` (e.g. FSDP-style params) is not reduced over that axis.
+
+    ``compression``: optional ``comm_compressed.CompressionConfig`` — the
+    reduce runs as a blockwise-quantized (and/or hierarchical) collective
+    instead of ``lax.pmean``. ``error``: per-rank error-feedback tree
+    (same structure/shapes as ``grads``, this rank's residue slice); when
+    given, returns ``(grads, new_error)`` instead of ``grads``.
     """
     bound = [ax for ax in axes if comm._axis_size(ax) not in (None, 1)]
     if not bound:
-        return grads
+        return (grads, error) if error is not None else grads
 
-    def reduce_leaf(g, spec=None):
+    use_cc = compression is not None and (
+        compression.quantized or compression.hierarchical)
+
+    def reduce_leaf(g, spec=None, e=None):
         mentioned = _spec_axes(spec) if spec is not None else set()
-        for ax in bound:
-            if ax not in mentioned:
-                g = lax.pmean(g, ax)
-        return g
+        red = tuple(ax for ax in bound if ax not in mentioned)
+        if not red:
+            # leaf fully sharded over the data axes (FSDP-style): nothing
+            # to reduce; residue stays (and stays zero if it started zero)
+            return g, e
+        if use_cc:
+            if e is not None:
+                return cc.all_reduce(g, red, config=compression, op="mean",
+                                     error=e)
+            return cc.all_reduce(g, red, config=compression, op="mean"), None
+        for ax in red:
+            g = lax.pmean(g, ax)
+        return g, (None if e is None else jnp.zeros_like(e))
 
+    flat_g, treedef = jax.tree_util.tree_flatten(grads)
     if specs is None:
-        return jax.tree_util.tree_map(reduce_leaf, grads)
-    return jax.tree_util.tree_map(
-        reduce_leaf, grads, specs,
-        is_leaf=lambda x: isinstance(x, PartitionSpec))
+        flat_s = [None] * len(flat_g)
+    else:
+        flat_s = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+    if error is None:
+        flat_e = [None] * len(flat_g)
+    else:
+        flat_e = jax.tree_util.tree_leaves(error)
+    outs = [reduce_leaf(g, s, e)
+            for g, s, e in zip(flat_g, flat_s, flat_e)]
+    reduced = jax.tree_util.tree_unflatten(treedef, [o[0] for o in outs])
+    if error is None:
+        return reduced
+    new_error = jax.tree_util.tree_unflatten(treedef, [o[1] for o in outs])
+    return reduced, new_error
 
 
 def global_grad_norm(grads: Any, specs: Optional[Any] = None) -> jax.Array:
@@ -105,7 +138,16 @@ def global_grad_norm(grads: Any, specs: Optional[Any] = None) -> jax.Array:
 def clip_grad_norm(grads: Any, max_norm: float,
                    specs: Optional[Any] = None) -> Tuple[Any, jax.Array]:
     """Clip by global norm (reference ``clip_grad_norm:192``); returns
-    ``(clipped_grads, norm)``."""
+    ``(clipped_grads, norm)``.
+
+    A non-finite norm (overflow/NaN in the backward) yields scale 1.0 —
+    the grads pass through unscaled so ``make_train_step(skip_nonfinite=
+    True)`` can drop the whole step, instead of a NaN scale poisoning
+    every leaf including the ones that were still finite.
+    """
+    if max_norm <= 0:
+        raise ValueError(f"max_norm must be positive, got {max_norm}")
     norm = global_grad_norm(grads, specs)
-    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    scale = jnp.where(jnp.isfinite(norm),
+                      jnp.minimum(1.0, max_norm / (norm + 1e-6)), 1.0)
     return jax.tree_util.tree_map(lambda g: g * scale, grads), norm

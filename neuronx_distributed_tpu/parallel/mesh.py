@@ -62,6 +62,10 @@ class _ParallelState:
         self.sizes: dict = {}
         self.aot_mode: bool = False
         self.phase_meshes: dict = {}  # (tp, ep) -> Mesh view
+        # (fast_axes, slow_axes) link-speed split for hierarchical
+        # collectives; None = undeclared (MESH_AXES-order convention).
+        self.axis_hierarchy: Optional[Tuple[Tuple[str, ...],
+                                            Tuple[str, ...]]] = None
 
 
 _STATE = _ParallelState()
@@ -192,6 +196,12 @@ def initialize_model_parallel(
     _STATE.expert_mesh = Mesh(arr.reshape(pp, dp_exp, ep, tp), EXPERT_MESH_AXES)
     _STATE.sizes = dict(pp=pp, dp=dp, cp=cp, tp=tp, ep=ep, dp_exp=dp_exp,
                         world=world)
+    if dcn_data_parallel_size and dcn_data_parallel_size > 1:
+        # dp crosses DCN in the hybrid layout: every other data axis rides
+        # ICI, so hierarchical gradient collectives should stage through
+        # them first (comm_compressed.split_axis_hierarchy consumes this).
+        fast = tuple(a for a, s in ((CP_AXIS, cp),) if s > 1)
+        _STATE.axis_hierarchy = (fast, (DP_AXIS,))
     logger.info("initialized mesh: pp=%d dp=%d cp=%d tp=%d (ep=%d dp_exp=%d)",
                 pp, dp, cp, tp, ep, dp_exp)
     return _STATE.mesh
@@ -210,6 +220,7 @@ def destroy_model_parallel() -> None:
     _STATE.sizes = {}
     _STATE.aot_mode = False
     _STATE.phase_meshes = {}
+    _STATE.axis_hierarchy = None
 
 
 def _require_init() -> None:
@@ -227,6 +238,38 @@ def get_mesh() -> Mesh:
 def get_expert_mesh() -> Mesh:
     _require_init()
     return _STATE.expert_mesh  # type: ignore[return-value]
+
+
+def declare_axis_hierarchy(fast: Sequence[str],
+                           slow: Sequence[str]) -> None:
+    """Declare which mesh axes ride fast links (ICI) vs slow links (DCN).
+
+    Hierarchical collectives (``parallel.comm_compressed``) stage through
+    the fast axes first, so only 1/N_fast of the payload crosses the slow
+    axes. ``initialize_model_parallel(dcn_data_parallel_size=...)``
+    auto-declares ``dp`` slow; call this to override or for custom
+    topologies. Axes must be mesh axis names and the two sets disjoint.
+    """
+    _require_init()
+    fast = tuple(fast)
+    slow = tuple(slow)
+    valid = set(MESH_AXES) | set(EXPERT_MESH_AXES)
+    unknown = [a for a in fast + slow if a not in valid]
+    if unknown:
+        raise ValueError(f"unknown mesh axes in hierarchy: {unknown}; "
+                         f"valid axes: {sorted(valid)}")
+    overlap = set(fast) & set(slow)
+    if overlap:
+        raise ValueError(f"axes cannot be both fast and slow: "
+                         f"{sorted(overlap)}")
+    _STATE.axis_hierarchy = (fast, slow)
+
+
+def get_axis_hierarchy() -> Optional[Tuple[Tuple[str, ...],
+                                           Tuple[str, ...]]]:
+    """The declared ``(fast_axes, slow_axes)`` split, or None when
+    undeclared (consumers fall back to mesh-axis-order conventions)."""
+    return _STATE.axis_hierarchy
 
 
 def get_moe_phase_mesh(tensor_parallel_size: int,

@@ -58,6 +58,12 @@ class MetricsLogger(Callback):
             line = (f"step {step} loss {float(metrics['loss']):.4f} "
                     f"grad_norm {float(metrics.get('grad_norm', 0)):.3f} "
                     f"tokens/s {tps:,.0f}")
+            if "grad_comm_ratio" in metrics:
+                # wire-compression ratio of the gradient collectives
+                # (parallel/comm_compressed.py); constant per run but kept
+                # on the step line so logs are self-describing
+                line += (" comm_ratio "
+                         f"{float(metrics['grad_comm_ratio']):.2f}x")
             logger.info(line)
             if self.file:
                 with open(self.file, "a") as f:

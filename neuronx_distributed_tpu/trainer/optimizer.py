@@ -23,6 +23,7 @@ import optax
 from jax.sharding import PartitionSpec
 
 from ..config import NxDConfig
+from ..parallel import comm_compressed as cc
 from ..parallel import mesh as ps
 
 
@@ -117,3 +118,60 @@ def zero1_state_specs(opt_state: Any, param_specs: Any,
             treedef, [rec(c) for c in children])
 
     return rec(opt_state)
+
+
+# ---------------------------------------------------------------------------
+# Explicit ZeRO-1 gradient dataflow (reference NeuronZero1Optimizer:30 —
+# reduce-scatter grads over DP, update the local 1/N shard, all-gather the
+# updated params). The declarative zero1_state_specs path above lets GSPMD
+# insert this pair itself but always at fp32; these helpers ARE the
+# reduce-scatter / all-gather, so they can ride the compressed collectives.
+# Both run *inside* shard_map over the zero axes (leaves replicated across
+# them, the usual explicit-path layout).
+# ---------------------------------------------------------------------------
+
+def zero1_reduce_scatter_gradients(
+    grads: Any,
+    zero_axes: Tuple[str, ...] = (ps.DP_AXIS, ps.CP_AXIS),
+    compression: Optional[cc.CompressionConfig] = None,
+    error: Optional[Any] = None,
+) -> Any:
+    """Mean-reduce each gradient leaf over the zero axes and keep this
+    rank's flat 1/N chunk (zero-padded to whole quantization blocks).
+
+    ``compression`` selects the wire dtype (None = fp32); ``error`` is the
+    per-rank error-feedback tree (leaf shapes match ``grads``) — when given,
+    returns ``(chunks, new_error)``. Feed the chunks to the local optimizer
+    shard and rebuild params with :func:`zero1_all_gather_params`.
+    """
+    if error is None:
+        return jax.tree_util.tree_map(
+            lambda g: cc.reduce_scatter_flat(
+                g, zero_axes, config=compression, op="mean"), grads)
+    flat_g, treedef = jax.tree_util.tree_flatten(grads)
+    flat_e = jax.tree_util.tree_leaves(error)
+    outs = [cc.reduce_scatter_flat(g, zero_axes, config=compression,
+                                   op="mean", error=e)
+            for g, e in zip(flat_g, flat_e)]
+    chunks = jax.tree_util.tree_unflatten(treedef, [o[0] for o in outs])
+    new_error = jax.tree_util.tree_unflatten(treedef, [o[1] for o in outs])
+    return chunks, new_error
+
+
+def zero1_all_gather_params(
+    chunks: Any,
+    shapes: Any,
+    zero_axes: Tuple[str, ...] = (ps.DP_AXIS, ps.CP_AXIS),
+    compression: Optional[cc.CompressionConfig] = None,
+) -> Any:
+    """Inverse of :func:`zero1_reduce_scatter_gradients`: gather every
+    rank's flat chunk, drop block padding, reshape to ``shapes`` (a tree of
+    shape tuples or template arrays). Quantizing this leg compresses the
+    param all-gather exactly like ZeRO++'s qwZ."""
+    def gather(c, s):
+        shape = tuple(s.shape) if hasattr(s, "shape") else tuple(s)
+        return cc.all_gather_flat(c, shape, zero_axes, config=compression)
+
+    return jax.tree_util.tree_map(
+        gather, chunks, shapes,
+        is_leaf=lambda x: isinstance(x, (tuple, list)))

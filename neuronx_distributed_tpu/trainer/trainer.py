@@ -26,17 +26,31 @@ from flax.core import meta
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..config import NxDConfig
+from ..parallel import comm
+from ..parallel import comm_compressed as cc
+from ..parallel import grads as grads_mod
 from ..parallel import mesh as ps
 from . import optimizer as opt_mod
 
 
 class TrainState(struct.PyTreeNode):
     """Step + params + optimizer state (flax TrainState without the apply_fn
-    closure, so it stays a clean pytree for checkpointing)."""
+    closure, so it stays a clean pytree for checkpointing).
+
+    ``comm_error``: gradient-compression error-feedback buffers (the
+    per-reduce-rank quantization residue re-injected next step; see
+    ``parallel/comm_compressed.py``). None unless the config enables a
+    quantized ``grad_comm_dtype`` with error feedback — None flattens to
+    an empty subtree, so checkpoints and pytree structure are unchanged
+    for uncompressed runs. When present it is *checkpointed state*
+    (docs/resilience.md): dropping it on restore silently replays one
+    step of quantization residue.
+    """
 
     step: jax.Array
     params: Any
     opt_state: Any
+    comm_error: Any = None
 
 
 @struct.dataclass
@@ -212,12 +226,31 @@ def initialize_parallel_optimizer(
         is_leaf=lambda s: isinstance(s, PartitionSpec))
     opt_shardings = to_shard(opt_specs)
     opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
+
+    # Gradient-compression error feedback: allocate the per-reduce-rank
+    # residue buffers alongside the optimizer state so they are carried
+    # (and checkpointed) in the TrainState.
+    comm_error = None
+    err_shardings = None
+    comp = cc.from_config(cfg)
+    if comp is not None and comp.quantized and comp.error_feedback:
+        red_axes = tuple(ax for ax in (ps.DP_AXIS, ps.CP_AXIS)
+                         if dict(mesh.shape).get(ax, 1) > 1)
+        if red_axes:
+            ef_specs = cc.error_feedback_specs(pm.param_specs, red_axes)
+            err_shardings = to_shard(ef_specs)
+            comm_error = jax.jit(
+                lambda p: cc.init_error_feedback(p, pm.param_specs,
+                                                 red_axes),
+                out_shardings=err_shardings)(params)
+
     state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                       opt_state=opt_state)
+                       opt_state=opt_state, comm_error=comm_error)
     state_shardings = TrainState(
         step=NamedSharding(mesh, PartitionSpec()),
         params=to_shard(pm.param_specs),
-        opt_state=opt_shardings)
+        opt_state=opt_shardings,
+        comm_error=err_shardings)
     return tx, state, state_shardings
 
 
@@ -233,6 +266,7 @@ def make_train_step(
     scan_steps: int = 1,
     dropout_rng: Optional[jax.Array] = None,
     skip_nonfinite: bool = False,
+    compression: Optional[cc.CompressionConfig] = None,
 ):
     """Build the jitted SPMD train step.
 
@@ -264,6 +298,18 @@ def make_train_step(
     counterpart of the resilience ``Watchdog(policy="skip_step")`` host
     rollback: no extra state copy, no host sync, works with ``donate=True``
     and inside ``scan_steps``.
+
+    ``compression``: a ``parallel.CompressionConfig`` (typically
+    ``comm_compressed.from_config(pm.config)``) switching gradient
+    synchronisation to the quantized / hierarchical collectives. This
+    builds the *explicit* path internally — loss and grads computed inside
+    ``shard_map`` with the compressed all-reduce on the data axes (GSPMD
+    cannot be told to quantize its implicit reductions) — so it composes
+    only with the default loss (``loss_fn=None, grad_fn=None``); pipeline
+    ``grad_fn``s own their collectives and stay uncompressed. With a
+    quantized dtype + error feedback, the state must carry ``comm_error``
+    buffers (``initialize_parallel_optimizer`` allocates them when the
+    config asks for compression).
     """
     mesh = ps.get_mesh()
 
@@ -271,6 +317,13 @@ def make_train_step(
         raise ValueError(
             "pass either loss_fn (differentiated here) or grad_fn "
             "(self-differentiating, e.g. the pipeline engine), not both")
+    if compression is not None and (loss_fn is not None
+                                    or grad_fn is not None):
+        raise ValueError(
+            "compression= builds its own shard_map gradient path and only "
+            "composes with the default loss; custom loss_fn/grad_fn "
+            "callers should call parallel.grads.allreduce_gradients("
+            "compression=...) themselves")
     if dropout_rng is not None and (loss_fn is not None
                                     or grad_fn is not None):
         raise ValueError(
@@ -293,16 +346,94 @@ def make_train_step(
     else:
         default_loss = False
 
-    def one_grad(params, batch, rngs=None):
-        if grad_fn is not None:
-            return grad_fn(params, batch)
-        if default_loss:
-            return jax.value_and_grad(
-                lambda p: loss_fn(pm.module, p, batch, rngs))(params)
-        return jax.value_and_grad(
-            lambda p: loss_fn(pm.module, p, batch))(params)
+    compressed_grad = None
+    if compression is not None:
+        use_ef = compression.quantized and compression.error_feedback
+        with_rng = dropout_rng is not None
+        red_axes = tuple(ax for ax in (ps.DP_AXIS, ps.CP_AXIS)
+                         if dict(mesh.shape).get(ax, 1) > 1)
+        ef_specs = (cc.error_feedback_specs(pm.param_specs, red_axes)
+                    if use_ef and red_axes else None)
+        use_ef = use_ef and ef_specs is not None
 
-    def accum_grad(params, batch, rngs=None):
+        def inner(*args):
+            p, input_ids, labels = args[:3]
+            idx = 3
+            rngs_in = None
+            if with_rng:
+                # distinct dropout streams per data-parallel rank, shared
+                # across tp (the parallel.random contract)
+                rngs_in = {"dropout": jax.random.fold_in(
+                    args[idx], comm.combined_axis_index(red_axes)
+                    if red_axes else 0)}
+                idx += 1
+            err = None
+            if use_ef:
+                # EF buffers carry a leading reduce-rank dim outside the
+                # shard_map (so each rank's residue is real, addressable,
+                # checkpointable state); locally that dim is 1 — peel it
+                err = jax.tree_util.tree_map(
+                    lambda t: jnp.squeeze(t, 0), args[idx])
+
+            def local_loss(pp):
+                if rngs_in is not None:
+                    return pm.module.apply(pp, input_ids, labels,
+                                           method="loss", rngs=rngs_in)
+                return pm.module.apply(pp, input_ids, labels, method="loss")
+
+            loss, g = jax.value_and_grad(local_loss)(p)
+            if use_ef:
+                g, ne = grads_mod.allreduce_gradients(
+                    g, specs=pm.param_specs, axes=red_axes,
+                    compression=compression, error=err)
+                ne = jax.tree_util.tree_map(lambda t: t[None], ne)
+            else:
+                g = grads_mod.allreduce_gradients(
+                    g, specs=pm.param_specs, axes=red_axes,
+                    compression=compression)
+            for ax in red_axes:
+                loss = jax.lax.pmean(loss, ax)
+            return (loss, g, ne) if use_ef else (loss, g)
+
+        in_specs = [pm.param_specs, batch_spec, batch_spec]
+        if with_rng:
+            in_specs.append(PartitionSpec())
+        if use_ef:
+            in_specs.append(ef_specs)
+        out_specs = (PartitionSpec(), pm.param_specs)
+        if use_ef:
+            out_specs = out_specs + (ef_specs,)
+        sm_grad = ps.shard_map(inner, mesh, in_specs=tuple(in_specs),
+                               out_specs=out_specs)
+
+        def compressed_grad(params, batch, rngs, err):
+            args = [params, batch["input_ids"], batch["labels"]]
+            if with_rng:
+                args.append(rngs["dropout"])
+            if use_ef:
+                args.append(err)
+            outs = sm_grad(*args)
+            if use_ef:
+                return outs
+            return outs[0], outs[1], err
+
+    def one_grad(params, batch, rngs=None, err=None):
+        """→ ``(loss, grads, new_err)``; ``err`` passes through untouched
+        on the uncompressed paths (None stays None)."""
+        if compressed_grad is not None:
+            return compressed_grad(params, batch, rngs, err)
+        if grad_fn is not None:
+            loss, g = grad_fn(params, batch)
+            return loss, g, err
+        if default_loss:
+            loss, g = jax.value_and_grad(
+                lambda p: loss_fn(pm.module, p, batch, rngs))(params)
+            return loss, g, err
+        loss, g = jax.value_and_grad(
+            lambda p: loss_fn(pm.module, p, batch))(params)
+        return loss, g, err
+
+    def accum_grad(params, batch, rngs=None, err=None):
         a = grad_accum_steps
 
         def slice_mb(x):
@@ -321,31 +452,35 @@ def make_train_step(
             lambda x: jax.lax.with_sharding_constraint(x, mb_sharding), mbs)
 
         def body(carry, xs):
-            loss_sum, gacc = carry
+            loss_sum, gacc, e = carry
             mb, i = xs
             mb_rngs = (None if rngs is None else
                        {k: jax.random.fold_in(r, i)
                         for k, r in rngs.items()})
-            loss, g = one_grad(params, mb, mb_rngs)
+            # with compression each microbatch reduce consumes/produces
+            # the error-feedback residue through the scan carry
+            loss, g, e = one_grad(params, mb, mb_rngs, e)
             gacc = jax.tree_util.tree_map(jnp.add, gacc, g)
-            return (loss_sum + loss, gacc), None
+            return (loss_sum + loss, gacc, e), None
 
         zero = jax.tree_util.tree_map(
             lambda p: jnp.zeros(jnp.shape(p), jnp.result_type(p)), params)
-        (loss_sum, gsum), _ = jax.lax.scan(
-            body, (jnp.zeros((), jnp.float32), zero),
+        (loss_sum, gsum, err), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zero, err),
             (mbs, jnp.arange(a)))
         scale = 1.0 / a
         return loss_sum * scale, jax.tree_util.tree_map(
-            lambda g: g * scale, gsum)
+            lambda g: g * scale, gsum), err
 
     def step_fn(state: TrainState, batch) -> Tuple[TrainState, dict]:
         rngs = (None if dropout_rng is None else
                 {"dropout": jax.random.fold_in(dropout_rng, state.step)})
         if grad_accum_steps > 1:
-            loss, grads = accum_grad(state.params, batch, rngs)
+            loss, grads, new_err = accum_grad(state.params, batch, rngs,
+                                              state.comm_error)
         else:
-            loss, grads = one_grad(state.params, batch, rngs)
+            loss, grads, new_err = one_grad(state.params, batch, rngs,
+                                            state.comm_error)
         grad_norm = optax.global_norm(grads)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -353,6 +488,9 @@ def make_train_step(
             "loss": loss,
             "grad_norm": grad_norm,
         }
+        if compression is not None:
+            metrics["grad_comm_ratio"] = jnp.asarray(compression.ratio,
+                                                     jnp.float32)
         if skip_nonfinite:
             # select, don't branch: one compiled program either way, and
             # the guard composes with donation and scan_steps
@@ -361,9 +499,13 @@ def make_train_step(
             new_params = jax.tree_util.tree_map(keep, new_params,
                                                 state.params)
             new_opt = jax.tree_util.tree_map(keep, new_opt, state.opt_state)
+            # a skipped step must also discard the residue the bad reduce
+            # wrote, or one NaN grad poisons every later step through EF
+            new_err = jax.tree_util.tree_map(keep, new_err,
+                                             state.comm_error)
             metrics["nonfinite_skipped"] = (~ok).astype(jnp.int32)
         return TrainState(step=state.step + 1, params=new_params,
-                          opt_state=new_opt), metrics
+                          opt_state=new_opt, comm_error=new_err), metrics
 
     batch_shardings = NamedSharding(mesh, batch_spec)
     if scan_steps > 1:

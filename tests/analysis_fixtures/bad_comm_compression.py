@@ -1,0 +1,31 @@
+"""Fixture: comm-compression-rule violations (never imported, only parsed)."""
+
+import jax
+from jax import lax
+
+
+def sync_gradients(grads):
+    # raw pmean on a gradient tree — bypasses spec-aware skipping,
+    # quantization and error feedback
+    return jax.tree_util.tree_map(lambda g: lax.pmean(grads, "dp"), grads)
+
+
+def reduce_one(grad, axis):
+    # raw psum on a single gradient leaf
+    total = lax.psum(grad, axis)
+    return total / lax.psum(1.0, axis)
+
+
+def accumulate(g_sum):
+    # accumulator naming convention still counts as a gradient
+    return lax.pmean(g_sum, ("dp", "cp"))
+
+
+def activations_are_fine(hidden):
+    # pmean on a non-gradient value: the rule must NOT fire here —
+    # activation/loss collectives are the model's own business
+    return lax.pmean(hidden, "tp")
+
+
+def losses_are_fine(loss):
+    return lax.psum(loss, "dp")

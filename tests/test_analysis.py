@@ -65,6 +65,28 @@ def test_custom_vjp_fires_on_fixture():
     assert "2 differentiable arg(s)" in msgs
 
 
+def test_comm_compression_fires_on_fixture():
+    fs = _lint("bad_comm_compression.py")
+    assert _rules(fs) == {"comm-compression"}
+    # the three gradient-named call sites fire; activations/losses don't
+    assert len([f for f in fs if not f.suppressed]) == 3
+    msgs = " | ".join(f.message for f in fs)
+    assert "allreduce_gradients" in msgs
+    assert "lax.pmean" in msgs and "lax.psum" in msgs
+
+
+def test_comm_compression_exempts_parallel_package():
+    src = ("from jax import lax\n"
+           "def allreduce_gradients(grads):\n"
+           "    return lax.pmean(grads, 'dp')\n")
+    # the wrapper itself lives in parallel/ and is allowed raw collectives
+    assert analyze_source(
+        src, "neuronx_distributed_tpu/parallel/grads.py",
+        axes=DEFAULT_AXES) == []
+    flagged = analyze_source(src, "mymodel/train.py", axes=DEFAULT_AXES)
+    assert [f.rule for f in flagged] == ["comm-compression"]
+
+
 def test_recompile_hazard_fires_on_fixture():
     fs = _lint("bad_recompile.py")
     assert _rules(fs) == {"recompile-hazard"}
@@ -158,7 +180,8 @@ def test_cli_nonzero_on_fixture_corpus():
     out_rules = {line.split("[")[1].split("]")[0]
                  for line in r.stdout.splitlines() if "[" in line}
     assert out_rules == {"mesh-axis", "trace-safety", "custom-vjp",
-                         "recompile-hazard", "resilience"}
+                         "recompile-hazard", "resilience",
+                         "comm-compression"}
 
 
 def test_cli_zero_on_clean_file():
