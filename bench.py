@@ -70,7 +70,7 @@ jax = _init_backend_with_watchdog()
 import jax.numpy as jnp  # noqa: E402
 
 
-def main(chaos_spec=None):
+def main(chaos_spec=None, serving=False):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -203,6 +203,18 @@ def main(chaos_spec=None):
 
         traceback.print_exc()
         print(f"bench: resilience metric failed: {e!r}", file=sys.stderr)
+
+    # continuous-batching serving drill (docs/serving.md): opt-in via
+    # --serving; ragged Poisson arrivals through the paged-cache engine
+    # vs the static batched generate() baseline
+    if serving:
+        try:
+            aux.update(serving_metric(platform))
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: serving metric failed: {e!r}", file=sys.stderr)
 
     # gradient-collective microbenchmark (docs/comm_compression.md): time a
     # gradient-sized all-reduce at fp32 vs blockwise int8 and report the
@@ -392,6 +404,119 @@ def _bundle_cold_start_ms() -> float:
     return (time.perf_counter() - t0) * 1e3
 
 
+def serving_metric(platform: str) -> dict:
+    """Continuous-batching serving vs static batched decode (docs/serving.md).
+
+    A ragged Poisson-arrival workload (mixed prompt lengths and
+    ``max_new_tokens``) is served two ways on the same model:
+
+    * **static**: collect every request, pad the batch square (longest
+      prompt, longest max_new), run :func:`generate` per ``max_slots``-
+      sized batch — the head-of-line-blocking baseline. Its makespan is
+      charged from t=0, so it includes the wait for the last arrival.
+    * **engine**: :class:`ServingEngine` admits mid-flight, chunks
+      prefill, retires finished slots immediately; one compiled step.
+
+    Throughput counts only the tokens each request asked for, so the
+    static baseline pays for its padding in time, not in credit."""
+    import numpy as np
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference.engine import (EngineConfig,
+                                                          ServingEngine)
+    from neuronx_distributed_tpu.inference.engine import EngineStats
+    from neuronx_distributed_tpu.inference.generation import generate
+    from neuronx_distributed_tpu.models import llama
+    from neuronx_distributed_tpu.parallel import mesh as ps
+
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel()
+    if platform == "cpu":
+        cfg = llama.LlamaConfig(
+            vocab_size=1024, hidden_size=256, intermediate_size=704,
+            num_layers=4, num_heads=8, num_kv_heads=8, max_seq_len=512)
+        n_req, max_slots, budget = 8, 4, 16
+        plen_range, new_range = (8, 33), (4, 17)
+        block_size, num_blocks = 8, 64
+    else:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=16, num_heads=8, num_kv_heads=8, max_seq_len=4096)
+        n_req, max_slots, budget = 16, 8, 64
+        plen_range, new_range = (32, 129), (16, 65)
+        block_size, num_blocks = 16, 256
+    params = meta.unbox(llama.LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    rng = np.random.RandomState(0)
+    reqs = [(rng.randint(0, cfg.vocab_size,
+                         (rng.randint(*plen_range),)).tolist(),
+             int(rng.randint(*new_range))) for _ in range(n_req)]
+    total_tokens = sum(n for _, n in reqs)
+
+    # -- static baseline: square batches of max_slots ---------------------
+    def run_static():
+        elapsed = 0.0
+        for i in range(0, n_req, max_slots):
+            batch = reqs[i:i + max_slots]
+            pmax = max(len(p) for p, _ in batch)
+            nmax = max(n for _, n in batch)
+            ids = np.zeros((len(batch), pmax), np.int32)
+            for j, (p, _) in enumerate(batch):
+                ids[j, :len(p)] = p
+            plen = jnp.asarray([len(p) for p, _ in batch], jnp.int32)
+            t0 = time.perf_counter()
+            np.asarray(generate(cfg, params, jnp.asarray(ids), plen, nmax,
+                                buckets=(pmax,)))
+            elapsed += time.perf_counter() - t0
+        return elapsed
+
+    run_static()                       # compile + warm
+    static_gen_s = min(run_static() for _ in range(2))
+
+    ecfg = EngineConfig(block_size=block_size, num_blocks=num_blocks,
+                        max_slots=max_slots,
+                        max_blocks_per_seq=-(-cfg.max_seq_len // block_size),
+                        token_budget=budget, kv_dtype=cfg.dtype)
+    eng = ServingEngine(cfg, params, ecfg)
+    eng.submit(reqs[0][0], reqs[0][1], uid="warm")   # compile + warm
+    eng.run()
+    eng.stats = EngineStats()
+    eng.results = {}
+
+    # Poisson arrivals spanning ~75% of the static busy time: the static
+    # server must wait for the full batch, the engine starts immediately
+    gaps = rng.exponential(0.75 * static_gen_s / n_req, n_req)
+    arrivals = np.concatenate([[0.0], gaps.cumsum()[:-1]])
+    eng._t0 = eng._clock()
+    for (p, n), at in zip(reqs, arrivals):
+        eng.submit(p, n, arrival_time=float(at))
+    results = eng.run()
+    done = [r for r in results.values() if r.status == "completed"]
+    makespan = max(r.finish_s for r in done)
+    rep = eng.stats.report()
+    serving_tps = sum(len(r.tokens) for r in done) / makespan
+    static_tps = total_tokens / (float(arrivals[-1]) + static_gen_s)
+    speedup = serving_tps / static_tps
+    tag = f"{platform}1"
+    return {
+        f"serving_tokens_per_s_{tag}": {
+            "value": round(serving_tps, 2), "unit": "tokens/sec",
+            "vs_baseline": round(speedup, 3)},
+        f"serving_ttft_p50_{tag}": {
+            "value": round(rep["ttft_p50_ms"], 2), "unit": "ms",
+            "vs_baseline": 1.0},
+        f"serving_ttft_p99_{tag}": {
+            "value": round(rep["ttft_p99_ms"], 2), "unit": "ms",
+            "vs_baseline": 1.0},
+        f"serving_speedup_vs_static_{tag}": {
+            "value": round(speedup, 3), "unit": "x",
+            "vs_baseline": round(speedup / 1.5, 3)},
+        f"serving_pool_occupancy_{tag}": {
+            "value": round(rep["pool_occupancy_mean"], 4), "unit": "frac",
+            "vs_baseline": 1.0},
+    }
+
+
 def comm_metric(platform: str, n_dev: int) -> dict:
     """Gradient-collective microbenchmark: step time of a gradient-sized
     ``all_reduce`` over the data axes at fp32 vs blockwise int8
@@ -559,5 +684,10 @@ if __name__ == "__main__":
              "SPEC is a FaultPlan DSL string (docs/resilience.md), default "
              "a deterministic transient-fault mix (first saves/loads fail "
              "once, then heal through the retry path)")
+    _p.add_argument(
+        "--serving", action="store_true",
+        help="also run the continuous-batching serving drill (paged-cache "
+             "engine vs static batched generate under a ragged Poisson "
+             "arrival workload; docs/serving.md)")
     _args = _p.parse_args()
-    main(chaos_spec=_args.chaos)
+    main(chaos_spec=_args.chaos, serving=_args.serving)

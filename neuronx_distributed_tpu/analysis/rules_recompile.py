@@ -15,12 +15,20 @@ Two shapes:
 Only syntactically jit-decorated functions are checked; the factory idiom
 (returning a closure that the caller jits) is out of scope here, and
 captured *immutable* globals (ints, tuples, constants) are fine.
+
+A third shape applies to serving code (any module with ``inference`` as a
+path component): calls to a jit-wrapped callable whose **array operands
+were shaped from per-request values** (``len(requests)`` and friends).
+Each distinct live-request count is a distinct shape, so the step
+retraces as load varies — exactly what the fixed-budget packing of
+:mod:`..inference.engine` exists to avoid.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, List, Set
+import pathlib
+from typing import Dict, Iterator, List, Set, Tuple
 
 from . import astutil
 from .core import Finding, LintContext, register
@@ -131,3 +139,90 @@ def check(ctx: LintContext) -> Iterator[Finding]:
                     f"mutable global {node.id!r} (defined line "
                     f"{mutable_globals[node.id]}) — its value is frozen "
                     "into the compiled program at first trace")
+
+    if "inference" in pathlib.PurePath(ctx.path).parts:
+        yield from _per_request_shape_hazards(ctx)
+
+
+def _len_taint(tree: ast.AST) -> Tuple[Set[str], "callable"]:
+    """Names whose bound value involves ``len(...)`` (transitively through
+    plain-name assignments), plus the taint predicate itself."""
+    derived: Set[str] = set()
+
+    def mentions_len(expr: ast.AST) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id == "len":
+                return True
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in derived:
+                return True
+        return False
+
+    changed = True
+    while changed:  # fixpoint over chained `n = len(q)`, `m = n + 1`
+        changed = False
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = getattr(node, "value", None)
+                if value is None or not mentions_len(value):
+                    continue
+                tgts = (node.targets if isinstance(node, ast.Assign)
+                        else [node.target])
+                for t in tgts:
+                    if isinstance(t, ast.Name) and t.id not in derived:
+                        derived.add(t.id)
+                        changed = True
+    return derived, mentions_len
+
+
+def _per_request_shape_hazards(ctx: LintContext) -> Iterator[Finding]:
+    """Serving-path extension: array operands of jitted calls whose shape
+    follows the live-request count (``len(...)``)."""
+    derived, mentions_len = _len_taint(ctx.tree)
+
+    def shape_from_len(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call) and \
+                astutil.tail_name(expr.func) in _ARRAY_CTORS:
+            root = astutil.root_name(expr.func)
+            if root in _ARRAY_ROOTS or root is None:
+                operands = list(expr.args) + [k.value for k in expr.keywords]
+                return any(mentions_len(a) for a in operands)
+        return False
+
+    # names bound to jax.jit(...) results, and names assigned a
+    # len-shaped array (one hop of indirection each)
+    jit_names: Set[str] = set()
+    hazard_arrays: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if isinstance(node.value, ast.Call) and \
+                astutil.tail_name(node.value.func) == "jit":
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    jit_names.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    jit_names.add(t.attr)   # self._step = jax.jit(...)
+        if shape_from_len(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    hazard_arrays.add(t.id)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = astutil.tail_name(node.func)
+        direct_jit = (isinstance(node.func, ast.Call) and
+                      astutil.tail_name(node.func.func) == "jit")
+        if fname not in jit_names and not direct_jit:
+            continue
+        for a in list(node.args) + [k.value for k in node.keywords]:
+            if shape_from_len(a) or \
+                    (isinstance(a, ast.Name) and a.id in hazard_arrays):
+                yield Finding(
+                    ctx.path, a.lineno, a.col_offset, "recompile-hazard",
+                    f"call to jitted {fname or '<expr>'!r} with an operand "
+                    "shaped from a per-request value (len(...)) — every "
+                    "live-request count retraces; pack into a fixed "
+                    "token-budget shape instead")

@@ -6,27 +6,40 @@
   ``StateInitializer`` buffers).
 * :mod:`.generation` — prefill/decode loop (reference serving examples).
 * :mod:`.sampling` — greedy/top-k/top-p (reference ``utils/sampling.py``).
+* :mod:`.paging` — paged KV block pool + host-side block allocator.
+* :mod:`.engine` — continuous-batching serving engine over the paged pool.
 """
 
 from . import generation
 from . import kv_cache
 from . import model_builder
 from . import benchmark
+from . import paging
+from . import engine
 from . import sampling
 from . import speculative
-from .generation import decode_step, generate, pick_bucket, prefill
+from .engine import EngineConfig, EngineStats, RequestResult, ServingEngine
+from .generation import (DECODE_BUCKETS, decode_step, generate, pick_bucket,
+                         prefill)
 from .kv_cache import KVCache, init_kv_cache
 from .model_builder import (ModelBuilder, NxDModel, bundle_generate,
                             bundle_speculative_generate, generate_buckets,
                             shard_checkpoint)
+from .paging import (BlockAllocator, CacheExhaustedError, PagedKVCache,
+                     QuantizedPagedKVCache, init_paged_kv_cache,
+                     init_quantized_paged_kv_cache)
 from .sampling import SamplingConfig, sample
 from .speculative import make_speculation_round_fn
 
 __all__ = [
     "generation", "kv_cache", "model_builder", "sampling",
-    "benchmark", "speculative",
-    "decode_step", "generate", "pick_bucket", "prefill",
+    "benchmark", "speculative", "paging", "engine",
+    "DECODE_BUCKETS", "decode_step", "generate", "pick_bucket", "prefill",
     "KVCache", "init_kv_cache",
+    "BlockAllocator", "CacheExhaustedError", "PagedKVCache",
+    "QuantizedPagedKVCache", "init_paged_kv_cache",
+    "init_quantized_paged_kv_cache",
+    "ServingEngine", "EngineConfig", "EngineStats", "RequestResult",
     "ModelBuilder", "NxDModel", "generate_buckets", "shard_checkpoint",
     "bundle_generate", "bundle_speculative_generate",
     "make_speculation_round_fn",

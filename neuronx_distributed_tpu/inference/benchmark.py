@@ -81,6 +81,14 @@ def decode_benchmark_suite(cfg, params, draft_cfg=None, draft_params=None,
     out["greedy"] = with_tps(benchmark(
         lambda: generate(cfg, params, ids, plen, new_tokens,
                          buckets=buckets), n_runs=n_runs))
+    # TTFT = prefill + first sampled token (the latency a user waits
+    # before streaming starts); p99 of the full-generation latency is
+    # already in the report (LatencyCollector percentiles)
+    ttft = benchmark(
+        lambda: generate(cfg, params, ids, plen, 1, buckets=buckets),
+        n_runs=n_runs)
+    out["greedy"]["ttft_ms"] = ttft["p50_ms"]
+    out["greedy"]["ttft_p99_ms"] = ttft["p99_ms"]
     if draft_cfg is not None:
         out["speculative"] = with_tps(benchmark(
             lambda: speculative_generate(cfg, params, draft_cfg,
@@ -88,3 +96,61 @@ def decode_benchmark_suite(cfg, params, draft_cfg=None, draft_params=None,
                                          new_tokens, buckets=buckets)[0],
             n_runs=n_runs))
     return out
+
+
+def emit_json_line(suite: Dict[str, Dict], platform: str = "",
+                   stream=None) -> str:
+    """Serialize a :func:`decode_benchmark_suite` result as exactly ONE
+    JSON line in the ``bench.py`` convention: ``{"metric", "value",
+    "unit", "vs_baseline", "aux"}`` with the greedy decode rate as the
+    headline and everything else nested under ``aux``."""
+    import json
+    import sys
+
+    tag = f"_{platform}" if platform else ""
+    aux = {}
+    for name, rep in suite.items():
+        for field_name, val in rep.items():
+            aux[f"{name}_{field_name}{tag}"] = round(float(val), 4)
+    line = json.dumps({
+        "metric": f"decode_tokens_per_sec{tag}",
+        "value": round(float(suite["greedy"]["tokens_per_sec"]), 2),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+        "aux": aux,
+    })
+    print(line, file=stream or sys.stdout, flush=True)
+    return line
+
+
+def main(argv=None) -> None:
+    """CLI: benchmark greedy decode on a small llama and print ONE JSON
+    line (stderr carries any chatter; stdout is machine-parseable)."""
+    import argparse
+
+    import jax.numpy as jnp
+    from flax.core import meta
+
+    from ..models import llama
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--prompt-len", type=int, default=64)
+    p.add_argument("--new-tokens", type=int, default=32)
+    p.add_argument("--n-runs", type=int, default=3)
+    p.add_argument("--layers", type=int, default=2)
+    args = p.parse_args(argv)
+
+    cfg = llama.tiny_config(num_layers=args.layers, dtype=jnp.float32,
+                            param_dtype=jnp.float32)
+    params = meta.unbox(llama.LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    suite = decode_benchmark_suite(
+        cfg, params, batch=args.batch, prompt_len=args.prompt_len,
+        new_tokens=args.new_tokens, n_runs=args.n_runs,
+        buckets=(args.prompt_len,))
+    emit_json_line(suite, platform=jax.devices()[0].platform)
+
+
+if __name__ == "__main__":
+    main()

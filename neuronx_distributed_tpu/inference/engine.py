@@ -1,0 +1,424 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+vLLM/Orca-style serving on fixed-shape JAX: one compiled step serves any
+mix of live requests. Each step the host scheduler packs, into a single
+``[1, token_budget]`` token batch,
+
+* one decode token for every slot that is actively generating, and
+* chunked prefill rows for newly admitted requests (a prompt may take
+  several steps, ``token_budget`` tokens at a time),
+
+then runs the jitted step (:func:`..models.llama.llama_forward_with_cache`
+on the paged cache protocol). Every device array the step sees —
+tokens, positions, slot ids, block tables, the pool — has a fixed shape,
+so the step compiles exactly once per (model, budget) no matter how the
+load varies; nxdlint's recompile-hazard rule polices the opposite
+anti-pattern (shapes derived from ``len(requests)``).
+
+Block allocation is lazy and host-side: a slot gets pool blocks as its
+positions first touch them. When the pool runs dry the youngest running
+request is preempted (blocks freed, restarted from its prompt later) —
+admission control rejects requests that could never fit. Finished slots
+(EOS / max tokens) free their blocks at the same step boundary, so new
+requests are admitted mid-flight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import LlamaConfig, llama_forward_with_cache
+from .kv_cache import PAD_POSITION
+from .paging import (BlockAllocator, CacheExhaustedError,
+                     init_paged_kv_cache, init_quantized_paged_kv_cache)
+from .sampling import SamplingConfig, sample
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Serving-side knobs (the model config stays in ``LlamaConfig``).
+
+    ``token_budget`` is the packed step width: decode rows (one per
+    running slot) plus prefill chunk rows, padded up to this fixed size.
+    ``max_slots`` bounds concurrent requests; the pool is ``num_blocks *
+    block_size`` KV slots shared by all of them."""
+
+    block_size: int = 16
+    num_blocks: int = 64
+    max_slots: int = 8
+    max_blocks_per_seq: int = 16
+    token_budget: int = 32
+    quantized: bool = False
+    kv_dtype: Any = None            # None -> model dtype (fp pool only)
+    eos_id: Optional[int] = None
+    sampling: SamplingConfig = SamplingConfig(greedy=True)
+
+
+@dataclasses.dataclass
+class _RequestState:
+    uid: str
+    prompt: List[int]
+    max_new_tokens: int
+    arrival_time: float
+    generated: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    n_cached: int = 0               # tokens whose K/V are in the pool
+    first_token_time: Optional[float] = None
+    admit_seq: int = -1             # admission order, for preemption choice
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def tokens(self) -> List[int]:
+        return self.prompt + self.generated
+
+    @property
+    def decoding(self) -> bool:
+        # prefill done and one sampled token waits to be fed back
+        return self.n_cached >= self.prompt_len
+
+    def restart(self) -> None:
+        self.generated = []
+        self.slot = None
+        self.n_cached = 0
+        self.first_token_time = None
+
+
+@dataclasses.dataclass
+class RequestResult:
+    uid: str
+    prompt_len: int
+    tokens: List[int]
+    status: str                     # "completed" | "rejected"
+    ttft_s: Optional[float] = None
+    finish_s: Optional[float] = None
+
+
+@dataclasses.dataclass
+class EngineStats:
+    steps: int = 0
+    completed: int = 0
+    rejected: int = 0
+    preempted: int = 0
+    tokens_generated: int = 0
+    ttft_s: List[float] = dataclasses.field(default_factory=list)
+    step_latency_s: List[float] = dataclasses.field(default_factory=list)
+    occupancy: List[float] = dataclasses.field(default_factory=list)
+    first_step_t: Optional[float] = None
+    last_step_t: Optional[float] = None
+
+    def report(self) -> Dict[str, float]:
+        span = ((self.last_step_t - self.first_step_t)
+                if self.steps and self.last_step_t > self.first_step_t
+                else 0.0)
+        lat = np.asarray(self.step_latency_s or [0.0])
+        ttft = np.asarray(self.ttft_s or [0.0])
+        return {
+            "steps": self.steps,
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "preempted": self.preempted,
+            "tokens_generated": self.tokens_generated,
+            "tokens_per_s": (self.tokens_generated / span) if span else 0.0,
+            "ttft_p50_ms": float(np.percentile(ttft, 50)) * 1e3,
+            "ttft_p99_ms": float(np.percentile(ttft, 99)) * 1e3,
+            "step_latency_p50_ms": float(np.percentile(lat, 50)) * 1e3,
+            "step_latency_p99_ms": float(np.percentile(lat, 99)) * 1e3,
+            "pool_occupancy_mean": (float(np.mean(self.occupancy))
+                                    if self.occupancy else 0.0),
+        }
+
+
+class ServingEngine:
+    """Request queue + slot map + token-budget scheduler over one
+    compiled fixed-shape step."""
+
+    def __init__(self, model_cfg: LlamaConfig, params,
+                 engine_cfg: EngineConfig = EngineConfig(),
+                 rng: Optional[jax.Array] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        self.model_cfg = model_cfg
+        self.params = params
+        self.ecfg = engine_cfg
+        self.allocator = BlockAllocator(engine_cfg.num_blocks)
+        self.stats = EngineStats()
+        self.results: Dict[str, RequestResult] = {}
+        self._queue: Deque[_RequestState] = deque()
+        self._slots: List[Optional[_RequestState]] = (
+            [None] * engine_cfg.max_slots)
+        self._tables = np.full(
+            (engine_cfg.max_slots, engine_cfg.max_blocks_per_seq), -1,
+            np.int32)
+        self._slot_blocks: List[List[int]] = (
+            [[] for _ in range(engine_cfg.max_slots)])
+        self._rng = rng if rng is not None else jax.random.key(0)
+        self._clock = clock or time.monotonic
+        self._t0 = self._clock()
+        self._admit_counter = 0
+        self._uid_counter = 0
+        self.cache = self._init_cache()
+        self._step_fn = self._build_step()
+
+    # -- construction -----------------------------------------------------
+
+    def _init_cache(self):
+        e, m = self.ecfg, self.model_cfg
+        if e.quantized:
+            cache = init_quantized_paged_kv_cache(
+                m.num_layers, e.num_blocks, e.block_size, m.num_kv_heads,
+                m.head_dim_, e.max_slots, e.max_blocks_per_seq)
+        else:
+            cache = init_paged_kv_cache(
+                m.num_layers, e.num_blocks, e.block_size, m.num_kv_heads,
+                m.head_dim_, e.max_slots, e.max_blocks_per_seq,
+                dtype=e.kv_dtype or m.dtype)
+        # commit to the sharding the jitted step will leave its outputs
+        # on (replicated over the active mesh, else the default device):
+        # an uncommitted first-step cache has a different sharding key
+        # than the committed cache every later step carries, which would
+        # cost a second (identical) compile
+        from ..parallel import mesh as ps
+
+        if ps.model_parallel_is_initialized():
+            sharding = jax.sharding.NamedSharding(
+                ps.get_mesh(), jax.sharding.PartitionSpec())
+        else:
+            sharding = jax.devices()[0]
+        return jax.device_put(cache, sharding)
+
+    def _build_step(self):
+        model_cfg, sampling = self.model_cfg, self.ecfg.sampling
+
+        def step_fn(params, cache, tokens, positions, slot_ids, rng):
+            logits, cache = llama_forward_with_cache(
+                model_cfg, params, tokens, positions, cache,
+                slot_ids=slot_ids)
+            toks = sample(logits[0], rng, sampling)
+            return toks, cache
+
+        # donation gives in-place pool update on TPU; CPU donation only
+        # warns, so keep it off there
+        donate = (1,) if jax.default_backend() in ("tpu", "axon") else ()
+        return jax.jit(step_fn, donate_argnums=donate)
+
+    def compile_count(self) -> int:
+        """Number of distinct compilations of the serving step (the
+        no-recompile invariant: stays 1 as the live-request mix varies)."""
+        try:
+            return int(self._step_fn._cache_size())
+        except Exception:  # pragma: no cover - jit internals moved
+            return -1
+
+    # -- public API -------------------------------------------------------
+
+    def _now(self) -> float:
+        return self._clock() - self._t0
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               uid: Optional[str] = None,
+               arrival_time: Optional[float] = None) -> str:
+        """Enqueue a request. Over-capacity requests (could never fit the
+        pool / block table / model context even alone) are rejected
+        immediately and show up in ``results`` with status "rejected"."""
+        if uid is None:
+            uid = f"req{self._uid_counter}"
+            self._uid_counter += 1
+        prompt = [int(t) for t in prompt]
+        req = _RequestState(
+            uid=uid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+            arrival_time=(self._now() if arrival_time is None
+                          else float(arrival_time)))
+        e = self.ecfg
+        total = req.prompt_len + req.max_new_tokens
+        blocks_needed = -(-total // e.block_size)
+        if (not prompt or total > self.model_cfg.max_seq_len
+                or blocks_needed > e.max_blocks_per_seq
+                or blocks_needed > e.num_blocks):
+            self.stats.rejected += 1
+            self.results[uid] = RequestResult(
+                uid=uid, prompt_len=req.prompt_len, tokens=[],
+                status="rejected")
+            return uid
+        self._queue.append(req)
+        return uid
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or any(s is not None for s in self._slots)
+
+    def run(self) -> Dict[str, RequestResult]:
+        """Drive :meth:`step` until queue and slots drain. With the real
+        clock, waits out gaps before future ``arrival_time``s; an injected
+        clock should drive :meth:`step` directly instead."""
+        while self.has_work():
+            if not any(s is not None for s in self._slots):
+                pending = [r.arrival_time for r in self._queue]
+                gap = min(pending) - self._now() if pending else 0.0
+                if gap > 0:
+                    if self._clock is not time.monotonic:
+                        self._t0 -= gap  # fake clock: fast-forward
+                    else:
+                        time.sleep(min(gap, 0.05))
+                        continue
+            self.step()
+        return self.results
+
+    # -- scheduling -------------------------------------------------------
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _admit(self) -> None:
+        free = self._free_slots()
+        now = self._now()
+        while free and self._queue and self._queue[0].arrival_time <= now:
+            req = self._queue.popleft()
+            slot = free.pop(0)
+            req.slot = slot
+            req.admit_seq = self._admit_counter
+            self._admit_counter += 1
+            self._slots[slot] = req
+
+    def _ensure_block(self, req: _RequestState, position: int) -> None:
+        """Map the block covering ``position`` into the slot's table,
+        allocating from the pool (raises CacheExhaustedError dry)."""
+        blk_i = position // self.ecfg.block_size
+        if self._tables[req.slot, blk_i] >= 0:
+            return
+        blk = self.allocator.alloc(1)[0]
+        self._tables[req.slot, blk_i] = blk
+        self._slot_blocks[req.slot].append(blk)
+
+    def _release(self, req: _RequestState) -> None:
+        slot = req.slot
+        self.allocator.free(self._slot_blocks[slot])
+        self._slot_blocks[slot] = []
+        self._tables[slot, :] = -1
+        self._slots[slot] = None
+
+    def _preempt_youngest(self, keep: _RequestState) -> None:
+        """Evict the most recently admitted running request — possibly
+        ``keep`` itself — back to the queue front; its generated tokens
+        are discarded and it restarts from the prompt. Always taking the
+        true youngest means the oldest running request is never evicted,
+        so it monotonically advances and the schedule cannot livelock
+        (two requests ping-ponging each other's blocks)."""
+        candidates = [s for s in self._slots if s is not None]
+        if not candidates:
+            raise CacheExhaustedError(
+                "pool exhausted with no running request to preempt")
+        victim = max(candidates, key=lambda r: r.admit_seq)
+        self._release(victim)
+        victim.restart()
+        self._queue.appendleft(victim)
+        self.stats.preempted += 1
+
+    def _build_schedule(self):
+        """Pack this step's rows: (req, token, position, produce) — one
+        decode row per decoding slot, then prefill chunks into the
+        remaining budget. Preempts (youngest first) when a decode row
+        can't get its next block; prefill chunks merely truncate."""
+        budget = self.ecfg.token_budget
+        while True:
+            try:
+                rows = []
+                for req in sorted(
+                        (s for s in self._slots
+                         if s is not None and s.decoding),
+                        key=lambda r: r.admit_seq):
+                    if len(rows) >= budget:
+                        break
+                    pos = req.n_cached
+                    self._ensure_block(req, pos)
+                    rows.append((req, req.tokens[pos], pos, True))
+                break
+            except CacheExhaustedError:
+                self._preempt_youngest(req)
+        for req in sorted((s for s in self._slots
+                           if s is not None and not s.decoding),
+                          key=lambda r: r.admit_seq):
+            room = budget - len(rows)
+            if room <= 0:
+                break
+            chunk = min(room, req.prompt_len - req.n_cached)
+            for i in range(chunk):
+                pos = req.n_cached + i
+                try:
+                    self._ensure_block(req, pos)
+                except CacheExhaustedError:
+                    chunk = i  # defer the rest of this prompt
+                    break
+                produce = (pos == req.prompt_len - 1)
+                rows.append((req, req.prompt[pos], pos, produce))
+            req.n_cached += chunk
+        return rows
+
+    def step(self) -> int:
+        """One fixed-shape serving step. Returns the number of live rows
+        packed (0 = nothing was runnable)."""
+        self._admit()
+        rows = self._build_schedule()
+        if not rows:
+            return 0
+        t_start = self._now()
+        if self.stats.first_step_t is None:
+            self.stats.first_step_t = t_start
+        budget = self.ecfg.token_budget
+        tokens = np.zeros((1, budget), np.int32)
+        positions = np.full((1, budget), PAD_POSITION, np.int32)
+        slot_ids = np.full((budget,), self.ecfg.max_slots, np.int32)
+        for i, (req, tok, pos, _) in enumerate(rows):
+            tokens[0, i] = tok
+            positions[0, i] = pos
+            slot_ids[i] = req.slot
+        self.cache = self.cache.replace(
+            block_tables=jnp.asarray(self._tables),
+            lengths=jnp.asarray(
+                np.asarray([0 if s is None else s.n_cached
+                            for s in self._slots], np.int32)))
+        self._rng, sub = jax.random.split(self._rng)
+        sampled, self.cache = self._step_fn(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(slot_ids), sub)
+        sampled = np.asarray(sampled)
+
+        now = self._now()
+        for i, (req, _, pos, produce) in enumerate(rows):
+            if req.decoding and pos == req.n_cached:
+                req.n_cached += 1  # this decode row cached its token
+            if not produce:
+                continue
+            tok = int(sampled[i])
+            req.generated.append(tok)
+            self.stats.tokens_generated += 1
+            if req.first_token_time is None:
+                req.first_token_time = now
+                self.stats.ttft_s.append(now - req.arrival_time)
+            if (len(req.generated) >= req.max_new_tokens
+                    or (self.ecfg.eos_id is not None
+                        and tok == self.ecfg.eos_id)):
+                self._retire(req, now)
+        self.stats.steps += 1
+        self.stats.step_latency_s.append(now - t_start)
+        self.stats.last_step_t = now
+        self.stats.occupancy.append(
+            self.allocator.num_allocated / self.ecfg.num_blocks)
+        return len(rows)
+
+    def _retire(self, req: _RequestState, now: float) -> None:
+        self._release(req)
+        self.stats.completed += 1
+        self.results[req.uid] = RequestResult(
+            uid=req.uid, prompt_len=req.prompt_len,
+            tokens=list(req.generated), status="completed",
+            ttft_s=(req.first_token_time - req.arrival_time
+                    if req.first_token_time is not None else None),
+            finish_s=now)

@@ -21,14 +21,20 @@ from .kv_cache import KVCache, init_kv_cache
 from .sampling import SamplingConfig, sample
 
 
+#: Decode-length buckets: the scan length compiles per bucket, not per
+#: distinct ``max_new_tokens`` (early-exit masking pads the difference).
+DECODE_BUCKETS = (64, 256, 1024)
+
+
 def pick_bucket(length: int, buckets: Sequence[int]) -> int:
     """Smallest bucket >= length (reference: bucketed input shapes,
     ``model_builder.py:495``)."""
-    for b in sorted(buckets):
+    ordered = sorted(buckets)
+    for b in ordered:
         if b >= length:
             return b
     raise ValueError(f"prompt length {length} exceeds largest bucket "
-                     f"{max(buckets)}")
+                     f"{ordered[-1]}")
 
 
 def prefill(cfg: LlamaConfig, params, input_ids: jax.Array,
@@ -62,11 +68,16 @@ def generate(cfg: LlamaConfig, params, input_ids, prompt_len,
              sampling: SamplingConfig = SamplingConfig(greedy=True),
              rng: Optional[jax.Array] = None,
              buckets: Sequence[int] = (128, 512, 2048),
-             kv_dtype=None, eos_id: Optional[int] = None) -> jax.Array:
+             kv_dtype=None, eos_id: Optional[int] = None,
+             decode_buckets: Sequence[int] = DECODE_BUCKETS) -> jax.Array:
     """Generate ``[B, max_new_tokens]`` continuations.
 
     ``input_ids [B, S]`` right-padded prompts, ``prompt_len [B]`` real
-    lengths. The decode loop is one compiled ``lax.scan``.
+    lengths. The decode loop is one compiled ``lax.scan`` whose length is
+    bucketed over ``decode_buckets`` (``max_new_tokens`` is a traced
+    scalar, so distinct request lengths within a bucket share one
+    compile; steps past the request are early-exit masked and sliced
+    off). Lengths beyond the largest bucket compile exactly.
     """
     import numpy as np
 
@@ -78,8 +89,10 @@ def generate(cfg: LlamaConfig, params, input_ids, prompt_len,
         input_ids = jnp.pad(input_ids, ((0, 0), (0, bucket - s)))
     rng = rng if rng is not None else jax.random.key(0)
 
+    steps = (pick_bucket(max_new_tokens, decode_buckets)
+             if max_new_tokens <= max(decode_buckets) else max_new_tokens)
     n_kv = cfg.num_kv_heads
-    cache = init_kv_cache(cfg.num_layers, b, bucket + max_new_tokens,
+    cache = init_kv_cache(cfg.num_layers, b, bucket + steps,
                           n_kv, cfg.head_dim_,
                           dtype=kv_dtype or cfg.dtype)
 
@@ -87,9 +100,10 @@ def generate(cfg: LlamaConfig, params, input_ids, prompt_len,
                                            cache)
 
     done0 = jnp.zeros((b,), bool)
-    (cache, _, _, _, _), tokens = _jit_decode_scan(cfg, max_new_tokens)(
-        cache, last_logits, prompt_len, rng, done0, params, sampling, eos_id)
-    return jnp.swapaxes(tokens, 0, 1)  # [B, T]
+    (cache, _, _, _, _), tokens = _jit_decode_scan(cfg, steps)(
+        cache, last_logits, prompt_len, rng, done0,
+        jnp.int32(max_new_tokens), params, sampling, eos_id)
+    return jnp.swapaxes(tokens[:max_new_tokens], 0, 1)  # [B, T]
 
 
 @functools.lru_cache(maxsize=None)
@@ -99,19 +113,26 @@ def _jit_prefill(cfg: LlamaConfig):
 
 @functools.lru_cache(maxsize=None)
 def _jit_decode_scan(cfg: LlamaConfig, steps: int):
-    def run(cache, logits, pos, rng, done, params, sampling, eos_id):
-        def step(carry, _):
+    """Compiled once per (cfg, decode BUCKET): ``max_new`` is a traced
+    scalar, so any request length within the bucket reuses the program.
+    Steps at or past ``max_new`` mark every row done — with an ``eos_id``
+    their tokens pin to eos, and the caller slices them off either way."""
+
+    def run(cache, logits, pos, rng, done, max_new, params, sampling,
+            eos_id):
+        def step(carry, i):
             cache, logits, pos, rng, done = carry
             rng, sub = jax.random.split(rng)
             tok = sample(logits, sub, sampling)
             if eos_id is not None:
                 tok = jnp.where(done, eos_id, tok)
                 done = done | (tok == eos_id)
+            done = done | (i + 1 >= max_new)
             new_logits, cache = decode_step(cfg, params, tok, pos, cache)
             return (cache, new_logits, pos + 1, rng, done), tok
 
-        return jax.lax.scan(step, (cache, logits, pos, rng, done), None,
-                            length=steps)
+        return jax.lax.scan(step, (cache, logits, pos, rng, done),
+                            jnp.arange(steps))
 
     return jax.jit(run, static_argnames=("sampling", "eos_id"),
                    donate_argnums=(0,))

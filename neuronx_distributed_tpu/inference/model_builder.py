@@ -481,12 +481,25 @@ class NxDModel:
 
     def init_state(self):
         """Fresh KV state buffers from the packaged spec (reference
-        ``StateInitializer``, ``base_nxd_model.py:11``)."""
+        ``StateInitializer``, ``base_nxd_model.py:11``). A spec with
+        ``kind: "paged"`` builds the block-pool cache of :mod:`.paging`
+        (optionally int8 via ``quantized: true``) instead of the
+        contiguous per-slot cache."""
         if not getattr(self, "state_spec", None):
             raise ValueError("bundle was saved without a state_spec")
         from .kv_cache import init_kv_cache
+        from .paging import init_paged_kv_cache, init_quantized_paged_kv_cache
 
         spec = dict(self.state_spec)
+        kind = spec.pop("kind", "contiguous")
+        if kind == "paged":
+            if spec.pop("quantized", False):
+                spec.pop("dtype", None)
+                return init_quantized_paged_kv_cache(**spec)
+            spec["dtype"] = jnp.dtype(spec.get("dtype", "bfloat16"))
+            return init_paged_kv_cache(**spec)
+        if kind != "contiguous":
+            raise ValueError(f"unknown state_spec kind: {kind!r}")
         spec["dtype"] = jnp.dtype(spec.get("dtype", "bfloat16"))
         return init_kv_cache(**spec)
 

@@ -1,0 +1,258 @@
+"""Paged KV cache (vLLM/Orca-style) in fixed-shape JAX.
+
+The contiguous :class:`.kv_cache.KVCache` reserves ``max_len`` slots per
+request up front, so a ragged serving mix wastes most of its HBM on
+padding. Here every layer shares ONE block pool ``[L, num_blocks,
+block_size, KV, D]``; a request owns an arbitrary *set* of blocks, named
+by its row of the ``block_tables`` array. Allocation decisions happen on
+the host at step boundaries (:class:`BlockAllocator`); everything the
+compiled step touches — the pool, the tables, the per-slot positions —
+is a fixed-shape device array, so the step compiles once and serves any
+live-request mix (the shape-churn hazard nxdlint's recompile-hazard rule
+flags).
+
+Masking follows the contiguous cache's convention: each pool slot stores
+the true token position it holds (``PAD_POSITION`` when empty), and the
+causal mask is ``q_pos >= slot_pos`` — empty slots and unmapped table
+entries are never attended, so no separate attention mask is plumbed.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .kv_cache import PAD_POSITION
+
+
+class CacheExhaustedError(RuntimeError):
+    """The block pool has no free block for a required allocation."""
+
+
+class PagedKVCache(struct.PyTreeNode):
+    """Shared-pool paged cache.
+
+    ``k``/``v`` ``[L, num_blocks, block_size, KV, D]``; ``pos``
+    ``[num_blocks, block_size]`` true token position per pool slot
+    (PAD_POSITION when empty; shared by all layers); ``block_tables``
+    ``[max_slots, max_blocks_per_seq]`` int32, entry ``-1`` = unmapped;
+    ``lengths`` ``[max_slots]`` int32 tokens resident per slot
+    (host-maintained bookkeeping, not read by the compiled step).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    pos: jax.Array
+    block_tables: jax.Array
+    lengths: jax.Array
+    block_size: int = struct.field(pytree_node=False, default=16)
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[1] * self.k.shape[2]
+
+    @property
+    def max_slots(self) -> int:
+        return self.block_tables.shape[0]
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return self.block_tables.shape[1]
+
+
+class QuantizedPagedKVCache(struct.PyTreeNode):
+    """Int8 pool variant: K/V int8 with one fp32 scale per pool vector
+    (``[L, num_blocks, block_size, KV]``), same symmetric per-vector
+    scheme as :class:`.kv_cache.QuantizedKVCache` (``quantize_kv``)."""
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: jax.Array
+    v_scale: jax.Array
+    pos: jax.Array
+    block_tables: jax.Array
+    lengths: jax.Array
+    block_size: int = struct.field(pytree_node=False, default=16)
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[1] * self.k.shape[2]
+
+    @property
+    def max_slots(self) -> int:
+        return self.block_tables.shape[0]
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return self.block_tables.shape[1]
+
+
+class PagedCacheView(struct.PyTreeNode):
+    """One layer's pool slice plus this step's routing arrays, threaded
+    through ``LlamaDecoderLayer`` in place of the contiguous
+    ``(k, v, slot_pos)`` cache tuple. ``tables [T, max_blocks_per_seq]``
+    is the per-token block table (each packed token carries its own
+    slot's row); ``write_idx [T]`` is the precomputed flat pool index for
+    this step's K/V rows (== pool capacity for rows that must not land —
+    scatters use ``mode="drop"``)."""
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: Optional[jax.Array]
+    v_scale: Optional[jax.Array]
+    pos: jax.Array
+    tables: jax.Array
+    write_idx: jax.Array
+
+
+# Registered for jax.export bundles like the contiguous caches
+# (model_builder packages the KV state spec in its manifest).
+try:
+    from jax import export as _jax_export
+
+    for _cls, _nm in ((PagedKVCache, "PagedKVCache"),
+                      (QuantizedPagedKVCache, "QuantizedPagedKVCache")):
+        _jax_export.register_pytree_node_serialization(
+            _cls,
+            serialized_name=f"neuronx_distributed_tpu.inference.{_nm}",
+            serialize_auxdata=lambda aux: json.dumps(list(aux)).encode(),
+            deserialize_auxdata=lambda b: tuple(json.loads(b)))
+except ValueError:  # pragma: no cover - double import/registration
+    pass
+
+
+def init_paged_kv_cache(num_layers: int, num_blocks: int, block_size: int,
+                        num_kv_heads: int, head_dim: int, max_slots: int,
+                        max_blocks_per_seq: int,
+                        dtype: Any = jnp.bfloat16) -> PagedKVCache:
+    shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+    return PagedKVCache(
+        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+        pos=jnp.full((num_blocks, block_size), PAD_POSITION, jnp.int32),
+        block_tables=jnp.full((max_slots, max_blocks_per_seq), -1,
+                              jnp.int32),
+        lengths=jnp.zeros((max_slots,), jnp.int32),
+        block_size=block_size)
+
+
+def init_quantized_paged_kv_cache(num_layers: int, num_blocks: int,
+                                  block_size: int, num_kv_heads: int,
+                                  head_dim: int, max_slots: int,
+                                  max_blocks_per_seq: int
+                                  ) -> QuantizedPagedKVCache:
+    shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+    return QuantizedPagedKVCache(
+        k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+        k_scale=jnp.ones(shape[:-1], jnp.float32),
+        v_scale=jnp.ones(shape[:-1], jnp.float32),
+        pos=jnp.full((num_blocks, block_size), PAD_POSITION, jnp.int32),
+        block_tables=jnp.full((max_slots, max_blocks_per_seq), -1,
+                              jnp.int32),
+        lengths=jnp.zeros((max_slots,), jnp.int32),
+        block_size=block_size)
+
+
+# ---------------------------------------------------------------------------
+# Host-side block allocation. Runs between compiled steps; the device only
+# ever sees the resulting (fixed-shape) block tables.
+# ---------------------------------------------------------------------------
+
+class BlockAllocator:
+    """Free-list over the shared pool's ``num_blocks`` block ids."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks <= 0:
+            raise ValueError(f"num_blocks must be positive, got {num_blocks}")
+        self.num_blocks = num_blocks
+        self.reset()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def alloc(self, n: int = 1) -> List[int]:
+        """Take ``n`` blocks off the free list; raises
+        :class:`CacheExhaustedError` (allocating nothing) when fewer than
+        ``n`` are free — the caller decides whether to preempt, defer, or
+        reject."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            raise CacheExhaustedError(
+                f"requested {n} block(s) but only {len(self._free)} of "
+                f"{self.num_blocks} are free")
+        out = [self._free.pop() for _ in range(n)]
+        self._allocated.update(out)
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b not in self._allocated:
+                raise ValueError(
+                    f"block {b} is not allocated (double free?)")
+            self._allocated.discard(b)
+            self._free.append(b)
+
+    def reset(self) -> None:
+        # lowest block ids pop first — keeps tests/debug dumps readable
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._allocated: set = set()
+
+
+# ---------------------------------------------------------------------------
+# jit-compatible pool writes. Allocation already happened on the host; the
+# device work is pure index arithmetic + scatter with OOB-drop, so these
+# trace into the fixed-shape serving step.
+# ---------------------------------------------------------------------------
+
+def flat_write_indices(tok_tables: jax.Array, positions: jax.Array,
+                       block_size: int, capacity: int) -> jax.Array:
+    """``[T, max_blocks_per_seq]`` per-token block tables + ``[T]`` true
+    positions -> ``[T]`` flat pool indices. Rows whose position is padding
+    (PAD_POSITION), beyond the table, or mapped to ``-1`` get index ==
+    ``capacity`` — out of bounds, so ``mode="drop"`` scatters discard
+    them."""
+    blk_of_pos = positions // block_size
+    maxb = tok_tables.shape[1]
+    safe = jnp.clip(blk_of_pos, 0, maxb - 1)
+    blk = jnp.take_along_axis(tok_tables, safe[:, None], axis=1)[:, 0]
+    flat = blk * block_size + positions % block_size
+    valid = (positions < PAD_POSITION) & (blk_of_pos < maxb) & (blk >= 0)
+    return jnp.where(valid, flat, capacity)
+
+
+def write_pool_rows(pool: jax.Array, rows: jax.Array,
+                    flat_idx: jax.Array) -> jax.Array:
+    """Scatter ``rows [T, ...]`` into ``pool [num_blocks, block_size,
+    ...]`` at the flat indices from :func:`flat_write_indices`."""
+    nb, bs = pool.shape[:2]
+    flat = pool.reshape((nb * bs,) + pool.shape[2:])
+    flat = flat.at[flat_idx].set(rows.astype(pool.dtype), mode="drop")
+    return flat.reshape(pool.shape)
+
+
+def write_pool_positions(pos: jax.Array, positions: jax.Array,
+                         flat_idx: jax.Array) -> jax.Array:
+    """Record this step's true token positions in the ``[num_blocks,
+    block_size]`` slot-position table (shared by all layers, written once
+    per step)."""
+    nb, bs = pos.shape
+    flat = pos.reshape(nb * bs).at[flat_idx].set(
+        positions.astype(pos.dtype), mode="drop")
+    return flat.reshape(nb, bs)

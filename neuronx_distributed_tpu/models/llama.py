@@ -150,6 +150,48 @@ def tiny_config(**kw) -> LlamaConfig:
     return LlamaConfig(**base)
 
 
+def _is_paged_cache_view(cache) -> bool:
+    from ..inference.paging import PagedCacheView
+
+    return isinstance(cache, PagedCacheView)
+
+
+def _paged_cache_attend(cfg: LlamaConfig, q, k, v, positions, view):
+    """Attention against the paged block pool: (optionally quantize and)
+    scatter this step's K/V rows into the layer's pool slice at the
+    precomputed flat indices, then gather-attend through the per-token
+    block tables (:mod:`..ops.paged_attention`). The packed batch is
+    ``[1, T]``; rows with a dropped write index (pads, preempted slots)
+    never land in the pool and their outputs are discarded by the caller.
+    """
+    import math as _math
+
+    from ..inference import paging
+    from ..inference.kv_cache import quantize_kv
+    from ..ops.paged_attention import paged_attention
+
+    k_rows, v_rows = k[0], v[0]                      # [T, KV_local, D]
+    if view.k_scale is not None:
+        qk, ks = quantize_kv(k_rows)
+        qv, vs = quantize_kv(v_rows)
+        new_k = paging.write_pool_rows(view.k, qk, view.write_idx)
+        new_v = paging.write_pool_rows(view.v, qv, view.write_idx)
+        new_ks = paging.write_pool_rows(view.k_scale, ks, view.write_idx)
+        new_vs = paging.write_pool_rows(view.v_scale, vs, view.write_idx)
+    else:
+        new_k = paging.write_pool_rows(view.k, k_rows, view.write_idx)
+        new_v = paging.write_pool_rows(view.v, v_rows, view.write_idx)
+        new_ks = new_vs = None
+    out = paged_attention(
+        q[0], new_k, new_v, view.pos, view.tables, positions[0],
+        k_scale=new_ks, v_scale=new_vs,
+        scale=1.0 / _math.sqrt(q.shape[-1]),
+        force_pallas=cfg.attn_force_pallas)[None]
+    new_view = view.replace(k=new_k, v=new_v, k_scale=new_ks,
+                            v_scale=new_vs)
+    return out.astype(cfg.dtype), new_view
+
+
 class LlamaAttention(nn.Module):
     """Attention with optional KV cache for autoregressive decode.
 
@@ -182,7 +224,12 @@ class LlamaAttention(nn.Module):
         q = attn_mod.apply_rotary(q, cos, sin, positions)
         k = attn_mod.apply_rotary(k, cos, sin, positions)
         new_cache = None
-        if cache is not None:
+        if cache is not None and _is_paged_cache_view(cache):
+            # paged pool (inference/paging.py): write this step's rows at
+            # the precomputed flat indices, gather-attend via block tables
+            out, new_cache = _paged_cache_attend(cfg, q, k, v, positions,
+                                                 cache)
+        elif cache is not None:
             # cache = (k_cache, v_cache, slot_positions); slot_positions
             # [B, S_max] holds each slot's true token position (PAD_POSITION
             # sentinel for pads), updated once per step by the caller.
@@ -448,6 +495,36 @@ class _DecodeScanBody(nn.Module):
         return x, (nk, nv)
 
 
+class _PagedScanBody(nn.Module):
+    """nn.scan body for paged decode: carries hidden states, maps each
+    layer's pool slice (leading layer dim) through, broadcasts the step's
+    routing arrays (pool positions, per-token block tables, flat write
+    indices). Parameter layout is identical to :class:`_DecodeScanBody`
+    (same ``layer`` scope), so the same checkpoint serves both cache
+    protocols."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cache_kv, pool_pos, tables, write_idx, cos, sin,
+                 positions):
+        from ..inference.paging import PagedCacheView
+
+        if len(cache_kv) == 4:
+            k_l, v_l, ks_l, vs_l = cache_kv
+        else:
+            (k_l, v_l), ks_l, vs_l = cache_kv, None, None
+        view = PagedCacheView(k=k_l, v=v_l, k_scale=ks_l, v_scale=vs_l,
+                              pos=pool_pos, tables=tables,
+                              write_idx=write_idx)
+        x, new_view = LlamaDecoderLayer(self.cfg, name="layer")(
+            x, cos, sin, positions, cache=view, cache_index=None)
+        if len(cache_kv) == 4:
+            return x, (new_view.k, new_view.v, new_view.k_scale,
+                       new_view.v_scale)
+        return x, (new_view.k, new_view.v)
+
+
 class LlamaModel(nn.Module):
     """Transformer body: embedding + decoder stack + final norm."""
 
@@ -588,7 +665,7 @@ class LlamaForCausalLM(nn.Module):
 
 def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
                              positions: jax.Array, kv_cache,
-                             return_hidden: bool = False):
+                             return_hidden: bool = False, slot_ids=None):
     """KV-cached forward for prefill ("context_encoding") and decode
     ("token_generation") — the two compiled graphs of the reference's
     serving path (``trace/model_builder.py:495`` keys).
@@ -598,11 +675,26 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
     :class:`..inference.kv_cache.QuantizedKVCache` (int8 cache; reference
     kv_cache_quant, ``quantization_config.py:72``). Writes this step's K/V
     at ``kv_cache.index`` and returns ``(logits, new_cache)``.
+
+    Paged protocol: pass a :class:`..inference.paging.PagedKVCache` /
+    ``QuantizedPagedKVCache`` plus ``slot_ids [T]`` mapping each packed
+    token (``input_ids [1, T]``) to its cache slot; K/V land in the slot's
+    block-table blocks instead of at a contiguous write index. Contiguous
+    callers are untouched.
     """
     from ..inference.kv_cache import KVCache, QuantizedKVCache
+    from ..inference.paging import PagedKVCache, QuantizedPagedKVCache
 
     if not cfg.scan_layers:
         raise ValueError("cached decode requires scan_layers=True")
+    paged = isinstance(kv_cache, (PagedKVCache, QuantizedPagedKVCache))
+    if paged:
+        if slot_ids is None:
+            raise ValueError("paged cache forward requires slot_ids [T]")
+        if input_ids.shape[0] != 1:
+            raise ValueError(
+                "paged decode packs requests into one row batch [1, T]; "
+                f"got batch {input_ids.shape[0]}")
     p = params["params"]
     b, s = input_ids.shape
     positions = jnp.asarray(positions, jnp.int32)
@@ -615,39 +707,70 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
     cos, sin = attn_mod.precompute_rope(
         cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta,
         use_scaled=cfg.rope_scaling)
-
-    # record this step's true positions in the slot-position table (pads
-    # carry the PAD_POSITION sentinel and are thereby never attended);
-    # shared by all layers, updated once here
-    if cfg.use_flash_decoding:
-        from ..inference.kv_cache import sharded_slot_update
-
-        slot_pos = sharded_slot_update(kv_cache.pos, positions,
-                                       kv_cache.index, ps.CP_AXIS,
-                                       slot_dim=1)
-    else:
-        slot_pos = jax.lax.dynamic_update_slice_in_dim(
-            kv_cache.pos, positions, kv_cache.index, axis=1)
     # rope lookup needs in-table indices; sentinel pads clamp to the last
     # entry (their K values are garbage but masked out)
     rope_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
 
-    scanned = nn.scan(
-        _DecodeScanBody,
-        variable_axes={"params": 0},
-        split_rngs={"params": True},
-        in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast,
-                 nn.broadcast),
-        out_axes=0,
-        length=cfg.num_layers,
-    )(cfg)
-    quantized = isinstance(kv_cache, QuantizedKVCache)
-    cache_kv = ((kv_cache.k, kv_cache.v, kv_cache.k_scale,
-                 kv_cache.v_scale) if quantized
-                else (kv_cache.k, kv_cache.v))
-    x, new_kv = scanned.apply(
-        {"params": p["model"]["layers"]}, x, cache_kv,
-        slot_pos, cos, sin, rope_pos, kv_cache.index)
+    if paged:
+        from ..inference import paging as _paging
+
+        slot_ids = jnp.asarray(slot_ids, jnp.int32)
+        # per-token routing: each packed token carries its slot's block
+        # table row and a flat pool index for this step's K/V write (==
+        # capacity for pad rows -> dropped by the mode="drop" scatters)
+        tok_tables = kv_cache.block_tables[
+            jnp.clip(slot_ids, 0, kv_cache.max_slots - 1)]
+        write_idx = _paging.flat_write_indices(
+            tok_tables, positions[0], kv_cache.block_size,
+            kv_cache.capacity)
+        slot_pos = _paging.write_pool_positions(kv_cache.pos, positions[0],
+                                                write_idx)
+        quantized = isinstance(kv_cache, QuantizedPagedKVCache)
+        cache_kv = ((kv_cache.k, kv_cache.v, kv_cache.k_scale,
+                     kv_cache.v_scale) if quantized
+                    else (kv_cache.k, kv_cache.v))
+        scanned = nn.scan(
+            _PagedScanBody,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
+                     nn.broadcast, nn.broadcast, nn.broadcast),
+            out_axes=0,
+            length=cfg.num_layers,
+        )(cfg)
+        x, new_kv = scanned.apply(
+            {"params": p["model"]["layers"]}, x, cache_kv, slot_pos,
+            tok_tables, write_idx, cos, sin, rope_pos)
+    else:
+        # record this step's true positions in the slot-position table
+        # (pads carry the PAD_POSITION sentinel and are thereby never
+        # attended); shared by all layers, updated once here
+        if cfg.use_flash_decoding:
+            from ..inference.kv_cache import sharded_slot_update
+
+            slot_pos = sharded_slot_update(kv_cache.pos, positions,
+                                           kv_cache.index, ps.CP_AXIS,
+                                           slot_dim=1)
+        else:
+            slot_pos = jax.lax.dynamic_update_slice_in_dim(
+                kv_cache.pos, positions, kv_cache.index, axis=1)
+
+        scanned = nn.scan(
+            _DecodeScanBody,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
+                     nn.broadcast, nn.broadcast),
+            out_axes=0,
+            length=cfg.num_layers,
+        )(cfg)
+        quantized = isinstance(kv_cache, QuantizedKVCache)
+        cache_kv = ((kv_cache.k, kv_cache.v, kv_cache.k_scale,
+                     kv_cache.v_scale) if quantized
+                    else (kv_cache.k, kv_cache.v))
+        x, new_kv = scanned.apply(
+            {"params": p["model"]["layers"]}, x, cache_kv,
+            slot_pos, cos, sin, rope_pos, kv_cache.index)
 
     norm = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype)
     x = norm.apply({"params": p["model"]["norm"]}, x)
@@ -661,7 +784,15 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             **_lora_kw(cfg, "lm_head"))
         logits = head.apply({"params": p["lm_head"]}, x)
-    if quantized:
+    if paged:
+        if quantized:
+            new_k, new_v, nks, nvs = new_kv
+            new_cache = kv_cache.replace(k=new_k, v=new_v, k_scale=nks,
+                                         v_scale=nvs, pos=slot_pos)
+        else:
+            new_k, new_v = new_kv
+            new_cache = kv_cache.replace(k=new_k, v=new_v, pos=slot_pos)
+    elif quantized:
         new_k, new_v, nks, nvs = new_kv
         new_cache = QuantizedKVCache(
             k=new_k, v=new_v, k_scale=nks, v_scale=nvs, pos=slot_pos,

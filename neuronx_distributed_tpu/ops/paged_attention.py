@@ -1,0 +1,221 @@
+"""Paged decode attention over a shared block pool.
+
+Decode-time attention where K/V live in the paged pool of
+:mod:`..inference.paging` (``[num_blocks, block_size, KV, D]`` per layer)
+and each query token reads the blocks named by its slot's block table —
+the attention half of the vLLM design, on the fixed-shape serving step.
+
+Two implementations behind one signature, following
+:mod:`.flash_attention` / :mod:`.flash_decoding`:
+
+* ``_paged_attention_xla`` — pure-``jnp`` gather-based reference. It
+  mirrors the contiguous cache path's numerics exactly (same fp32
+  einsums, same ``-1e30`` position-sentinel masking), so paged decode is
+  bit-for-bit comparable with :func:`..models.llama.llama_forward_with_cache`
+  on the contiguous cache; runs everywhere and is the tier-1/CPU path.
+* ``_paged_attention_pallas`` — a Mosaic TPU kernel: grid ``(tokens,
+  max_blocks_per_seq)``, the block table scalar-prefetched into SMEM so
+  each grid step DMAs exactly one pool block into VMEM (online-softmax
+  m/l/acc in VMEM scratch). Unmapped table entries clamp to block 0 —
+  consecutive same-block DMAs are elided — and are masked in-kernel.
+
+Auto-dispatch picks the kernel on TPU when the shapes tile; CPU runs the
+kernel in interpret mode when forced (CI coverage of the mask path).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..inference.kv_cache import PAD_POSITION, dequantize_kv
+from ..modules.attention import repeat_kv
+from .pallas_utils import compiler_params as _compiler_params
+
+
+def _paged_attention_xla(q, k_pool, v_pool, pool_pos, tables, q_pos,
+                         k_scale, v_scale, scale):
+    t, n, d = q.shape
+    nb, bs, kv, _ = k_pool.shape
+    n_rep = n // kv
+    safe = jnp.clip(tables, 0, nb - 1)
+    kg = k_pool[safe]                          # [T, maxb, bs, KV, D]
+    vg = v_pool[safe]
+    pg = pool_pos[safe]                        # [T, maxb, bs]
+    # entries gathered through an unmapped (-1) table slot are another
+    # sequence's data — force their stored position to the pad sentinel
+    pg = jnp.where(tables[:, :, None] >= 0, pg, PAD_POSITION)
+    if k_scale is not None:
+        kg = dequantize_kv(kg, k_scale[safe], q.dtype)
+        vg = dequantize_kv(vg, v_scale[safe], q.dtype)
+    length = tables.shape[1] * bs
+    k_full = repeat_kv(kg.reshape(t, length, kv, d).astype(q.dtype), n_rep)
+    v_full = repeat_kv(vg.reshape(t, length, kv, d).astype(q.dtype), n_rep)
+    pg = pg.reshape(t, length)
+    scores = jnp.einsum("bqnd,bknd->bnqk", q[:, None].astype(jnp.float32),
+                        k_full.astype(jnp.float32)) * scale
+    mask = q_pos[:, None, None, None] >= pg[:, None, None, :]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bnqk,bknd->bqnd", probs, v_full.astype(jnp.float32))
+    return out[:, 0].astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+def _paged_kernel(tables_ref, qpos_ref, q_ref, k_ref, v_ref, pos_ref, *rest,
+                  num_blocks_per_seq: int, n_rep: int, scale: float,
+                  quantized: bool):
+    from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale           # [N, D]
+    k = k_ref[0]                                       # [BS, KV, D]
+    v = v_ref[0]
+    if quantized:
+        k = k.astype(jnp.float32) * ks_ref[0][..., None]
+        v = v.astype(jnp.float32) * vs_ref[0][..., None]
+    k = jnp.repeat(k.astype(jnp.float32), n_rep, axis=1)   # [BS, N, D]
+    v = jnp.repeat(v.astype(jnp.float32), n_rep, axis=1)
+    # s[n, slot] = q[n] . k[slot, n] — batch over heads, contract head_dim:
+    # lhs [N, D], rhs [N, BS, D] -> [N, BS]
+    s = jax.lax.dot_general(q, jnp.swapaxes(k, 0, 1),
+                            (((1,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)
+    valid = (qpos_ref[t] >= pos_ref[0]) & (tables_ref[t, j] >= 0)  # [BS]
+    s = jnp.where(valid[None, :], s, -jnp.inf)
+    m_prev = m_ref[:]
+    l_prev = l_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe[:, None]), 0.0)
+    corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+    m_ref[:] = m_new
+    l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1)
+    acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+        p, jnp.swapaxes(v, 0, 1), (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_blocks_per_seq - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:]
+                    / jnp.maximum(l_ref[:], 1e-30)[:, None]
+                    ).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pool, v_pool, pool_pos, tables, q_pos,
+                            k_scale, v_scale, scale, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    t, n, d = q.shape
+    nb, bs, kv, _ = k_pool.shape
+    maxb = tables.shape[1]
+    n_rep = n // kv
+    quantized = k_scale is not None
+
+    # unmapped (-1) entries clamp to block 0: the DMA is elided when the
+    # previous grid step already held it, and the kernel masks the rows
+    def blk(ti, j, tables_s, qpos_s):
+        return (jnp.maximum(tables_s[ti, j], 0), 0, 0, 0)
+
+    def blk2(ti, j, tables_s, qpos_s):
+        return (jnp.maximum(tables_s[ti, j], 0), 0)
+
+    def blk3(ti, j, tables_s, qpos_s):
+        return (jnp.maximum(tables_s[ti, j], 0), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, n, d), lambda ti, j, *_: (ti, 0, 0)),
+        pl.BlockSpec((1, bs, kv, d), blk),
+        pl.BlockSpec((1, bs, kv, d), blk),
+        pl.BlockSpec((1, bs), blk2),
+    ]
+    operands = [q, k_pool, v_pool, pool_pos]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, bs, kv), blk3),
+                     pl.BlockSpec((1, bs, kv), blk3)]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(t, maxb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, n, d), lambda ti, j, *_: (ti, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((n,), jnp.float32),
+                        pltpu.VMEM((n,), jnp.float32),
+                        pltpu.VMEM((n, d), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, num_blocks_per_seq=maxb,
+                          n_rep=n_rep, scale=scale, quantized=quantized),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, n, d), q.dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
+    )(tables.astype(jnp.int32), q_pos.astype(jnp.int32), *operands)
+    return out
+
+
+def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                    pool_pos: jax.Array, tables: jax.Array,
+                    q_pos: jax.Array,
+                    k_scale: Optional[jax.Array] = None,
+                    v_scale: Optional[jax.Array] = None,
+                    scale: Optional[float] = None,
+                    force_pallas: Optional[bool] = None) -> jax.Array:
+    """Paged decode attention.
+
+    ``q [T, N, D]`` one query row per packed token; ``k_pool``/``v_pool``
+    ``[num_blocks, block_size, KV, D]`` (int8 when ``k_scale``/``v_scale``
+    ``[num_blocks, block_size, KV]`` are given); ``pool_pos [num_blocks,
+    block_size]`` stored token positions (PAD_POSITION = empty);
+    ``tables [T, max_blocks_per_seq]`` per-token block table (-1 =
+    unmapped); ``q_pos [T]`` query positions. Returns ``[T, N, D]``.
+
+    ``force_pallas``: ``True`` forces the TPU kernel (interpret mode off
+    TPU), ``False`` forces the XLA reference, ``None`` auto-selects.
+    """
+    t, n, d = q.shape
+    nb, bs, kv, _ = k_pool.shape
+    if n % kv != 0:
+        raise ValueError(f"q heads {n} not a multiple of kv heads {kv}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    scale_ = (1.0 / math.sqrt(d)) if scale is None else scale
+
+    tileable = d % 128 == 0 and bs % 128 == 0 and n % 8 == 0
+    if force_pallas:
+        interpret = jax.default_backend() == "cpu"
+        if not interpret and not tileable:
+            raise ValueError(
+                f"force_pallas: paged shapes (d={d}, block_size={bs}, "
+                f"heads={n}) don't tile for the TPU kernel; non-tiling "
+                "shapes are only valid in CPU interpret mode")
+        return _paged_attention_pallas(q, k_pool, v_pool, pool_pos, tables,
+                                       q_pos, k_scale, v_scale, scale_,
+                                       interpret=interpret)
+    if force_pallas is None and \
+            jax.default_backend() in ("tpu", "axon") and tileable:
+        return _paged_attention_pallas(q, k_pool, v_pool, pool_pos, tables,
+                                       q_pos, k_scale, v_scale, scale_)
+    return _paged_attention_xla(q, k_pool, v_pool, pool_pos, tables, q_pos,
+                                k_scale, v_scale, scale_)

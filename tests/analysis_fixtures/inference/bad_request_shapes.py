@@ -1,0 +1,28 @@
+"""Fixture: serving step fed arrays shaped from the live-request count.
+
+Every distinct ``len(requests)`` is a distinct operand shape, so the
+jitted step retraces as load varies — the anti-pattern the fixed
+token-budget packing in ``inference/engine.py`` exists to avoid.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def forward(tokens, positions):
+    return tokens + positions
+
+
+step = jax.jit(forward)
+
+
+def serve(requests):
+    n = len(requests)
+    tokens = jnp.zeros((1, n), jnp.int32)          # shape follows the batch
+    positions = jnp.arange(len(requests))[None]    # ditto, inline
+    return step(tokens, positions)
+
+
+def serve_inline(requests):
+    batch = len(requests)
+    return jax.jit(forward)(jnp.ones((batch, 4)), jnp.zeros((batch, 4)))

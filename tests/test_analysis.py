@@ -97,6 +97,37 @@ def test_recompile_hazard_fires_on_fixture():
     assert "_SCALE_TABLE" in msgs
 
 
+def test_recompile_hazard_per_request_shapes_fixture():
+    fs = _lint(os.path.join("inference", "bad_request_shapes.py"))
+    assert _rules(fs) == {"recompile-hazard"}
+    msgs = " | ".join(f.message for f in fs)
+    assert "per-request value" in msgs
+    assert "jitted 'step'" in msgs
+    # the inline jax.jit(f)(...) form is caught too
+    assert "'<expr>'" in msgs
+
+
+def test_per_request_rule_scoped_to_inference_paths():
+    src = ("import jax, jax.numpy as jnp\n"
+           "step = jax.jit(lambda x: x)\n"
+           "def serve(reqs):\n"
+           "    return step(jnp.zeros((len(reqs),)))\n")
+    # outside inference/ the serving-shape extension stays quiet...
+    assert analyze_source(src, "mymodel/train.py",
+                          axes=DEFAULT_AXES) == []
+    # ...inside it fires
+    flagged = analyze_source(src, "mymodel/inference/serve.py",
+                             axes=DEFAULT_AXES)
+    assert [f.rule for f in flagged] == ["recompile-hazard"]
+
+
+def test_inference_package_self_gate():
+    # the serving engine must pass the rule it motivated: every step
+    # array is packed to the fixed token budget, never len(requests)
+    pkg = os.path.join(REPO, "neuronx_distributed_tpu", "inference")
+    assert analyze_paths([pkg]) == []
+
+
 # ---------------------------------------------------------------------------
 # silence on clean code
 # ---------------------------------------------------------------------------

@@ -1,0 +1,288 @@
+"""Paged KV cache tests: block allocator, pool write/gather plumbing,
+paged attention (XLA reference + Pallas interpret) and full-model
+paged-vs-contiguous decode parity (fp32 bit-exact, int8 within tolerance).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+
+from neuronx_distributed_tpu.inference import kv_cache as kvc
+from neuronx_distributed_tpu.inference.kv_cache import (PAD_POSITION,
+                                                        quantize_kv)
+from neuronx_distributed_tpu.inference.paging import (
+    BlockAllocator, CacheExhaustedError, flat_write_indices,
+    init_paged_kv_cache, init_quantized_paged_kv_cache, write_pool_rows)
+from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                  llama_forward_with_cache,
+                                                  tiny_config)
+from neuronx_distributed_tpu.ops.paged_attention import paged_attention
+from neuronx_distributed_tpu.parallel import mesh as ps
+
+
+# ---------------------------------------------------------------------------
+# BlockAllocator
+# ---------------------------------------------------------------------------
+
+def test_allocator_alloc_free_reuse():
+    a = BlockAllocator(4)
+    first = a.alloc(2)
+    assert len(first) == 2 and a.num_free == 2 and a.num_allocated == 2
+    rest = a.alloc(2)
+    assert sorted(first + rest) == [0, 1, 2, 3]
+    a.free(first)
+    assert a.num_free == 2
+    again = a.alloc(2)
+    assert sorted(again) == sorted(first)  # freed blocks come back
+
+
+def test_allocator_oom_allocates_nothing():
+    a = BlockAllocator(3)
+    a.alloc(2)
+    with pytest.raises(CacheExhaustedError):
+        a.alloc(2)
+    # the failed alloc must not leak partial allocations
+    assert a.num_free == 1
+    assert len(a.alloc(1)) == 1
+
+
+def test_allocator_double_free_rejected():
+    a = BlockAllocator(2)
+    blks = a.alloc(1)
+    a.free(blks)
+    with pytest.raises(ValueError):
+        a.free(blks)
+
+
+def test_allocator_reset():
+    a = BlockAllocator(4)
+    a.alloc(3)
+    a.reset()
+    assert a.num_free == 4 and a.num_allocated == 0
+    assert len(a.alloc(4)) == 4
+
+
+# ---------------------------------------------------------------------------
+# pool write plumbing
+# ---------------------------------------------------------------------------
+
+def test_flat_write_indices_routes_pads_out_of_range():
+    bs, maxb, nb = 4, 3, 8
+    tables = jnp.asarray([[2, 5, -1]] * 3, jnp.int32)
+    positions = jnp.asarray([1, 6, PAD_POSITION], jnp.int32)
+    idx = flat_write_indices(tables, positions, bs, nb * bs)
+    # pos 1 -> block 2 offset 1; pos 6 -> block 5 offset 2
+    assert idx.tolist()[:2] == [2 * bs + 1, 5 * bs + 2]
+    assert idx.tolist()[2] == nb * bs  # pad routed past the pool
+
+
+def test_write_pool_rows_drops_invalid_rows():
+    pool = jnp.zeros((2, 2, 3), jnp.float32)
+    rows = jnp.ones((2, 3), jnp.float32)
+    out = write_pool_rows(pool, rows, jnp.asarray([1, 4], jnp.int32))
+    out = np.asarray(out)
+    assert out[0, 1].tolist() == [1, 1, 1]
+    assert out.sum() == 3  # the index-4 (== capacity) row was dropped
+
+
+# ---------------------------------------------------------------------------
+# paged attention op
+# ---------------------------------------------------------------------------
+
+def _rand_pool(rng, quantized=False):
+    T, N, D, NB, BS, KV, MAXB = 5, 4, 16, 8, 4, 2, 3
+    q = jnp.asarray(rng.randn(T, N, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(NB, BS, KV, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(NB, BS, KV, D).astype(np.float32))
+    pool_pos = jnp.asarray(rng.randint(0, 12, (NB, BS)).astype(np.int32))
+    pool_pos = pool_pos.at[0, 2].set(PAD_POSITION)
+    tables = jnp.asarray(rng.randint(-1, NB, (T, MAXB)).astype(np.int32))
+    q_pos = jnp.asarray(rng.randint(0, 12, (T,)).astype(np.int32))
+    if not quantized:
+        return q, k, v, pool_pos, tables, q_pos, None, None
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    return q, kq, vq, pool_pos, tables, q_pos, ks, vs
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_paged_attention_pallas_interpret_matches_xla(quantized):
+    q, k, v, pp, tb, qp, ks, vs = _rand_pool(np.random.RandomState(1),
+                                             quantized)
+    ref = paged_attention(q, k, v, pp, tb, qp, k_scale=ks, v_scale=vs,
+                          force_pallas=False)
+    ker = paged_attention(q, k, v, pp, tb, qp, k_scale=ks, v_scale=vs,
+                          force_pallas=True)
+    np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_paged_attention_validates_scales_and_heads():
+    q, k, v, pp, tb, qp, ks, vs = _rand_pool(np.random.RandomState(2), True)
+    with pytest.raises(ValueError):
+        paged_attention(q, k, v, pp, tb, qp, k_scale=ks)  # missing v_scale
+    with pytest.raises(ValueError):
+        paged_attention(q[:, :3], k, v, pp, tb, qp)  # 3 heads vs 2 kv
+
+
+# ---------------------------------------------------------------------------
+# full-model parity vs the contiguous cache
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def tiny_model():
+    ps.initialize_model_parallel()
+    cfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                      num_layers=2)
+    params = meta.unbox(LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    return cfg, params
+
+
+def _contiguous_decode(cfg, params, toks, quantized=False):
+    init = (kvc.init_quantized_kv_cache if quantized else
+            lambda *a, **k: kvc.init_kv_cache(*a, dtype=jnp.float32, **k))
+    cache = init(cfg.num_layers, 1, 16, cfg.num_kv_heads, cfg.head_dim_)
+    out = []
+    for i in range(toks.shape[1]):
+        lg, cache = llama_forward_with_cache(
+            cfg, params, toks[:, i:i + 1], jnp.array([[i]], jnp.int32),
+            cache)
+        out.append(lg[0, 0])
+    return jnp.stack(out)
+
+
+def _paged_cache(cfg, quantized=False):
+    """Pool with a deliberately scrambled block order for slot 0."""
+    if quantized:
+        cache = init_quantized_paged_kv_cache(
+            cfg.num_layers, 8, 4, cfg.num_kv_heads, cfg.head_dim_, 2, 4)
+    else:
+        cache = init_paged_kv_cache(
+            cfg.num_layers, 8, 4, cfg.num_kv_heads, cfg.head_dim_, 2, 4,
+            dtype=jnp.float32)
+    tables = np.full((2, 4), -1, np.int32)
+    tables[0, :4] = [5, 2, 7, 0]
+    return cache.replace(block_tables=jnp.asarray(tables))
+
+
+def test_paged_decode_bitwise_matches_contiguous_fp32(tiny_model):
+    cfg, params = tiny_model
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 10)), jnp.int32)
+    ref = _contiguous_decode(cfg, params, toks)
+
+    cache = _paged_cache(cfg)
+    out = []
+    for i in range(10):
+        lg, cache = llama_forward_with_cache(
+            cfg, params, toks[:, i:i + 1], jnp.array([[i]], jnp.int32),
+            cache, slot_ids=jnp.array([0], jnp.int32))
+        out.append(lg[0, 0])
+    got = jnp.stack(out)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=0, atol=1e-5)
+    assert bool(jnp.all(jnp.argmax(got, -1) == jnp.argmax(ref, -1)))
+
+
+def test_paged_chunked_prefill_matches_token_by_token(tiny_model):
+    """Chunk boundaries are invisible: prefilling 4+3+3 tokens produces
+    the same logits as 10 single-token steps (the engine relies on
+    this to pack partial prompts)."""
+    cfg, params = tiny_model
+    rng = np.random.RandomState(1)
+    toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 10)), jnp.int32)
+    ref = _contiguous_decode(cfg, params, toks)
+
+    cache = _paged_cache(cfg)
+    out = []
+    for a, b in ((0, 4), (4, 7), (7, 10)):
+        pos = jnp.arange(a, b, dtype=jnp.int32)[None]
+        lg, cache = llama_forward_with_cache(
+            cfg, params, toks[:, a:b], pos, cache,
+            slot_ids=jnp.full((b - a,), 0, jnp.int32))
+        out.append(lg[0])
+    got = jnp.concatenate(out)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=0, atol=1e-5)
+
+
+def test_paged_decode_int8_pool_close_to_contiguous(tiny_model):
+    """int8 pools: the contiguous path attends the current step's K/V in
+    fresh fp precision and quantizes after, the paged pool quantizes on
+    write — so parity is tolerance-based, with greedy tokens equal."""
+    cfg, params = tiny_model
+    rng = np.random.RandomState(2)
+    toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 10)), jnp.int32)
+    ref = _contiguous_decode(cfg, params, toks, quantized=True)
+
+    cache = _paged_cache(cfg, quantized=True)
+    out = []
+    for i in range(10):
+        lg, cache = llama_forward_with_cache(
+            cfg, params, toks[:, i:i + 1], jnp.array([[i]], jnp.int32),
+            cache, slot_ids=jnp.array([0], jnp.int32))
+        out.append(lg[0, 0])
+    got = jnp.stack(out)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=0, atol=0.15)
+    assert bool(jnp.all(jnp.argmax(got, -1) == jnp.argmax(ref, -1)))
+
+
+def test_paged_forward_requires_slot_ids(tiny_model):
+    cfg, params = tiny_model
+    cache = _paged_cache(cfg)
+    with pytest.raises(ValueError, match="slot_ids"):
+        llama_forward_with_cache(cfg, params, jnp.zeros((1, 1), jnp.int32),
+                                 jnp.zeros((1, 1), jnp.int32), cache)
+
+
+def test_two_slots_are_isolated(tiny_model):
+    """A second sequence interleaved into other pool blocks never leaks
+    into slot 0's attention."""
+    cfg, params = tiny_model
+    rng = np.random.RandomState(3)
+    ta = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 6)), jnp.int32)
+    tb = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 6)), jnp.int32)
+    ref = _contiguous_decode(cfg, params, ta)
+
+    cache = init_paged_kv_cache(cfg.num_layers, 8, 4, cfg.num_kv_heads,
+                                cfg.head_dim_, 2, 4, dtype=jnp.float32)
+    tables = np.full((2, 4), -1, np.int32)
+    tables[0, :2] = [3, 6]
+    tables[1, :2] = [1, 4]
+    cache = cache.replace(block_tables=jnp.asarray(tables))
+    out = []
+    for i in range(6):
+        lg, cache = llama_forward_with_cache(
+            cfg, params, ta[:, i:i + 1], jnp.array([[i]], jnp.int32),
+            cache, slot_ids=jnp.array([0], jnp.int32))
+        out.append(lg[0, 0])
+        _, cache = llama_forward_with_cache(
+            cfg, params, tb[:, i:i + 1], jnp.array([[i]], jnp.int32),
+            cache, slot_ids=jnp.array([1], jnp.int32))
+    np.testing.assert_allclose(np.asarray(jnp.stack(out)), np.asarray(ref),
+                               rtol=0, atol=1e-5)
+
+
+def test_model_builder_init_state_paged_kind():
+    from neuronx_distributed_tpu.inference.model_builder import NxDModel
+    from neuronx_distributed_tpu.inference.paging import (
+        PagedKVCache, QuantizedPagedKVCache)
+
+    spec = dict(kind="paged", num_layers=2, num_blocks=8, block_size=4,
+                num_kv_heads=2, head_dim=16, max_slots=2,
+                max_blocks_per_seq=4, dtype="float32")
+    m = NxDModel.__new__(NxDModel)
+    m.state_spec = spec
+    cache = m.init_state()
+    assert isinstance(cache, PagedKVCache)
+    assert cache.k.shape == (2, 8, 4, 2, 16)
+
+    m.state_spec = dict(spec, quantized=True)
+    qcache = m.init_state()
+    assert isinstance(qcache, QuantizedPagedKVCache)
+    assert qcache.k.dtype == jnp.int8
