@@ -1,0 +1,118 @@
+"""Rule ``tp-overlap``: blocking collective + matmul pairs serialize the
+TP hot path.
+
+A raw ``all_gather``/``psum`` whose result immediately feeds a matmul
+(``einsum``/``dot``/``matmul``/``tensordot``/``@``) is the fully
+serialized form of a tensor-parallel linear: the wire is idle during the
+matmul and the MXU is idle during the collective.
+:mod:`..ops.collective_matmul` provides the decomposed equivalents
+(``all_gather_matmul``, ``matmul_reduce_scatter``, ``matmul_all_reduce``,
+``copy_matmul``) that stream shards around a ``ppermute`` ring while each
+step's partial matmul runs — bit-exact in fp32 and auto-falling-back on
+non-tileable shapes (docs/tp_overlap.md).
+
+The rule fires in model/module code when a matmul consumes a variable that
+an earlier statement in the same function assigned from a raw
+``all_gather``/``psum`` call, and the variable is activation-named
+(``x``/``h``/``hidden*``/``act*``/...). ``parallel/`` and ``ops/`` are
+exempt — the mappings, the compressed collectives and the decomposed
+primitives themselves legitimately compose raw collectives with matmuls.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List
+
+from . import astutil
+from .core import Finding, LintContext, register
+
+# activation-flavoured identifiers: the single-letter conventions (x, h,
+# y) plus the spelled-out ones; gradient/weight names must NOT match so
+# gradient psums stay the comm-compression rule's business
+_ACT_NAME = re.compile(
+    r"^(x|h|y|xs|hs|out|attn_out|mlp_out)$|hidden|activation|(^|_)acts?(_|$)",
+    re.IGNORECASE)
+
+_COLLECTIVES = ("all_gather", "psum")
+_MATMULS = ("einsum", "dot", "matmul", "tensordot")
+
+
+def _exempt(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return any(f"/{pkg}/" in norm or norm.startswith(f"{pkg}/")
+               for pkg in ("parallel", "ops"))
+
+
+def _collective_tail(node: ast.AST):
+    if isinstance(node, ast.Call):
+        tail = astutil.tail_name(node.func)
+        if tail in _COLLECTIVES:
+            return tail
+    return None
+
+
+def _name_operands(node: ast.AST) -> Iterator[str]:
+    if isinstance(node, ast.Call):
+        for arg in node.args:
+            if isinstance(arg, ast.Name):
+                yield arg.id
+    elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+        for side in (node.left, node.right):
+            if isinstance(side, ast.Name):
+                yield side.id
+
+
+@register(
+    "tp-overlap",
+    "blocking all_gather/psum followed by a matmul on the gathered "
+    "activations — use ops.collective_matmul so the transfer overlaps "
+    "the per-shard partial matmuls")
+def check(ctx: LintContext) -> Iterator[Finding]:
+    if _exempt(ctx.path):
+        return
+    findings: List[Finding] = []
+    for func in ast.walk(ctx.tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # gather (assignment | matmul-use) events and replay them in source
+        # order — ast.walk order is not statement order
+        events = []
+        for node in astutil.walk_stop_at_functions(func):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                events.append(("assign", node))
+            elif (isinstance(node, ast.Call)
+                  and astutil.tail_name(node.func) in _MATMULS) or (
+                      isinstance(node, ast.BinOp)
+                      and isinstance(node.op, ast.MatMult)):
+                events.append(("matmul", node))
+        events.sort(key=lambda e: (e[1].lineno, e[1].col_offset))
+
+        gathered: Dict[str, str] = {}  # activation var -> collective tail
+        for kind, node in events:
+            if kind == "assign":
+                # assignment from a collective marks the var; any other
+                # reassignment clears it (the gathered value was replaced)
+                name = node.targets[0].id
+                tail = _collective_tail(node.value)
+                if tail and _ACT_NAME.search(name):
+                    gathered[name] = tail
+                else:
+                    gathered.pop(name, None)
+                continue
+            for name in _name_operands(node):
+                tail = gathered.get(name)
+                if tail is None:
+                    continue
+                op = ("all_gather_matmul" if tail == "all_gather"
+                      else "matmul_all_reduce / matmul_reduce_scatter")
+                findings.append(Finding(
+                    ctx.path, node.lineno, node.col_offset, "tp-overlap",
+                    f"matmul on {name!r} produced by a blocking {tail} — "
+                    f"the collective serializes with the compute; use "
+                    f"ops.collective_matmul.{op} to overlap the transfer "
+                    "with per-shard partial matmuls (docs/tp_overlap.md)"))
+                break
+    yield from findings

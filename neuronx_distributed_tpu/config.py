@@ -35,6 +35,8 @@ def configure_model(cfg: "NxDConfig", model_cfg: Any) -> Any:
         updates["dtype"] = jnp.dtype(cfg.mixed_precision.compute_dtype)
     if "tp_size" in fields:
         updates["tp_size"] = cfg.parallel.tensor_parallel_size
+    if "overlap_comm" in fields:
+        updates["overlap_comm"] = cfg.parallel.tp_overlap_comm
     model_cfg = dataclasses.replace(model_cfg, **updates)
     if "num_experts" in fields:
         # incoherent MoE knobs fail here with actionable errors instead of
@@ -67,6 +69,10 @@ class ParallelConfig:
     # Multi-slice: this many dp groups placed across slices (DCN); None/1
     # keeps everything within one ICI domain.
     dcn_data_parallel_size: Optional[int] = None
+    # Decomposed collective-matmuls in the TP layers (docs/tp_overlap.md):
+    # None = auto (engage when the tp axis size >= 4 and shapes tile),
+    # True = engage wherever shapes allow, False = always monolithic.
+    tp_overlap_comm: Optional[bool] = None
 
     def __post_init__(self) -> None:
         for f in ("tensor_parallel_size", "pipeline_parallel_size",
@@ -80,6 +86,10 @@ class ParallelConfig:
             raise ValueError(
                 f"dcn_data_parallel_size must be a positive int or None, "
                 f"got {d!r}")
+        if self.tp_overlap_comm not in (None, True, False):
+            raise ValueError(
+                "tp_overlap_comm must be None (auto), True, or False, got "
+                f"{self.tp_overlap_comm!r}")
 
     @property
     def model_parallel_size(self) -> int:
@@ -206,6 +216,7 @@ def neuronx_distributed_config(
     init_mesh: bool = True,
     devices: Optional[Sequence[Any]] = None,
     dcn_data_parallel_size: Optional[int] = None,
+    tp_overlap_comm: Optional[bool] = None,
 ) -> NxDConfig:
     """Build an :class:`NxDConfig` and (by default) initialise the global mesh.
 
@@ -220,6 +231,7 @@ def neuronx_distributed_config(
             context_parallel_size=context_parallel_size,
             expert_parallel_size=expert_parallel_size,
             dcn_data_parallel_size=dcn_data_parallel_size,
+            tp_overlap_comm=tp_overlap_comm,
         ),
         optimizer=optimizer_config or OptimizerConfig(),
         mixed_precision=mixed_precision_config or MixedPrecisionConfig(),

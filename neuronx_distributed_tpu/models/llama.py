@@ -29,6 +29,7 @@ from flax import linen as nn
 
 from ..modules import attention as attn_mod
 from ..modules.norms import RMSNorm
+from ..ops import collective_matmul as cm
 from ..parallel import layers as pl
 from ..parallel import loss_functions as lf
 from ..parallel import mappings
@@ -97,6 +98,11 @@ class LlamaConfig:
     # Ulysses per-rank deterministic masks.
     attention_dropout: float = 0.0
     tp_size: Optional[int] = None
+    # decomposed collective-matmuls in every TP linear (qkv/o_proj/gate_up/
+    # down/lm_head — docs/tp_overlap.md): None = auto (tp axis >= 4 and
+    # shapes tile), True = on where shapes allow, False = monolithic.
+    # Threaded from ParallelConfig.tp_overlap_comm by configure_model().
+    overlap_comm: Optional[bool] = None
     # LoRA adapters (see neuronx_distributed_tpu.lora); None = disabled
     lora: Optional["LoraConfig"] = None
     # sequence-chunked LM loss (fused_linear_cross_entropy): the loss path
@@ -214,7 +220,8 @@ class LlamaAttention(nn.Module):
             num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
             head_dim=head_dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             sequence_parallel=cfg.sequence_parallel, tp_size=cfg.tp_size,
-            name="qkv", **_lora_kw(cfg, "qkv"))(x)
+            overlap_comm=cfg.overlap_comm, name="qkv",
+            **_lora_kw(cfg, "qkv"))(x)
         b, s = q.shape[0], q.shape[1]
         n_q_local = q.shape[-1] // head_dim
         n_kv_local = k.shape[-1] // head_dim
@@ -333,7 +340,8 @@ class LlamaAttention(nn.Module):
         out = pl.RowParallelLinear(
             features=cfg.num_heads * head_dim, use_bias=False,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            sequence_parallel=cfg.sequence_parallel, name="o_proj",
+            sequence_parallel=cfg.sequence_parallel,
+            overlap_comm=cfg.overlap_comm, name="o_proj",
             **_lora_kw(cfg, "o_proj"))(out)
         if cache is not None:
             return out, new_cache
@@ -372,6 +380,27 @@ class LlamaMLP(nn.Module):
             if not lora_act:
                 kernel = kernel + cfg.lora.scale * jnp.einsum(
                     "hr,rki->hki", lora_a, lora_b)
+        # the fused [H, 2, I] kernel rides the decomposed collective-matmul
+        # directly (last-dim contraction, gate/up split preserved);
+        # activation-space LoRA needs the gathered input, so it falls back
+        engaged = not lora_act and cm.overlap_engaged(
+            cfg.overlap_comm, ps.TP_AXIS, x.shape, 1,
+            needs_divisible=not cfg.sequence_parallel)
+        if engaged:
+            x = x.astype(cfg.dtype)
+            if cfg.sequence_parallel:
+                h = cm.all_gather_matmul(x, kernel.astype(cfg.dtype),
+                                         ps.TP_AXIS, 1, impl="decomposed")
+            else:
+                h = cm.copy_matmul(x, kernel.astype(cfg.dtype),
+                                   ps.TP_AXIS, 1, impl="decomposed")
+            h = nn.silu(h[..., 0, :]) * h[..., 1, :]
+            return pl.RowParallelLinear(
+                features=cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                sequence_parallel=cfg.sequence_parallel,
+                overlap_comm=cfg.overlap_comm, name="down",
+                **_lora_kw(cfg, "down"))(h)
         if cfg.sequence_parallel:
             x = mappings.gather_from_sequence_parallel_region(
                 x, seq_dim=1, to_model_parallel=True)
@@ -391,7 +420,8 @@ class LlamaMLP(nn.Module):
         return pl.RowParallelLinear(
             features=cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
-            sequence_parallel=cfg.sequence_parallel, name="down",
+            sequence_parallel=cfg.sequence_parallel,
+            overlap_comm=cfg.overlap_comm, name="down",
             **_lora_kw(cfg, "down"))(h)
 
 
@@ -651,6 +681,7 @@ class LlamaForCausalLM(nn.Module):
         logits = pl.ColumnParallelLinear(
             features=cfg.vocab_size, use_bias=False, gather_output=False,
             sequence_parallel=cfg.sequence_parallel,
+            overlap_comm=cfg.overlap_comm,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
             **_lora_kw(cfg, "lm_head"))(x)
         if labels is not None:
@@ -781,6 +812,7 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
     else:
         head = pl.ColumnParallelLinear(
             features=cfg.vocab_size, use_bias=False, gather_output=True,
+            overlap_comm=cfg.overlap_comm,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             **_lora_kw(cfg, "lm_head"))
         logits = head.apply({"params": p["lm_head"]}, x)

@@ -208,6 +208,7 @@ class MixtralForCausalLM(nn.Module):
         logits = pl.ColumnParallelLinear(
             features=cfg.vocab_size, use_bias=False, gather_output=False,
             sequence_parallel=cfg.sequence_parallel,
+            overlap_comm=cfg.overlap_comm,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head")(x)
         return logits, aux
 
@@ -284,6 +285,7 @@ def mixtral_forward_with_cache(cfg: MixtralConfig, params,
         {"params": p["model"]["norm"]}, x)
     head = pl.ColumnParallelLinear(
         features=cfg.vocab_size, use_bias=False, gather_output=True,
+        overlap_comm=cfg.overlap_comm,
         dtype=cfg.dtype, param_dtype=cfg.param_dtype)
     logits = head.apply({"params": p["lm_head"]}, x)
     new_cache = KVCache(k=new_k, v=new_v, pos=slot_pos,

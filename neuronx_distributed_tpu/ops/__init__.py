@@ -5,18 +5,23 @@ reference implementation for CPU/interpret-mode testing, mirroring the
 reference's torch golden fallbacks (``moe/blockwise.py:326``).
 """
 
+from . import collective_matmul
 from . import flash_attention
 from . import flash_decoding
 from . import operators
 from . import ring_attention
 from . import ulysses
+from .collective_matmul import (all_gather_matmul, copy_matmul,
+                                matmul_all_reduce, matmul_reduce_scatter)
 from .flash_attention import flash_attention as flash_attention_fn
 from .flash_decoding import flash_decode_attention
 from .ring_attention import ring_attention as ring_attention_fn
 from .ring_attention import ring_attention_pallas
 from .ulysses import ulysses_attention
 
-__all__ = ["flash_attention", "flash_decoding", "operators",
-           "ring_attention", "ulysses", "flash_attention_fn",
-           "flash_decode_attention", "ring_attention_fn",
-           "ring_attention_pallas", "ulysses_attention"]
+__all__ = ["collective_matmul", "flash_attention", "flash_decoding",
+           "operators", "ring_attention", "ulysses", "all_gather_matmul",
+           "copy_matmul", "matmul_all_reduce", "matmul_reduce_scatter",
+           "flash_attention_fn", "flash_decode_attention",
+           "ring_attention_fn", "ring_attention_pallas",
+           "ulysses_attention"]

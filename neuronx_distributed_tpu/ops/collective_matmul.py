@@ -1,0 +1,509 @@
+"""Decomposed (latency-hiding) tensor-parallel collective-matmuls.
+
+The TP layers' hot path interleaves matmuls with collectives: the
+sequence-parallel entry all-gathers activations before the column matmul,
+and the row matmul exits through a reduce-scatter (or, in plain TP, an
+all-reduce). Issued monolithically those collectives serialize with the
+compute they feed — the wire sits idle during the matmul and the MXU sits
+idle during the collective. This module decomposes each pair into a
+``ppermute`` ring that streams remote shards (or partial products) while
+each step's partial matmul runs, so XLA can overlap the per-step transfer
+with the independent per-step compute (the reference hides the same
+latency with hand-scheduled async all-reduce in
+``LinearWithAsyncCommunication``, ``parallel_layers/layers.py:434-504``;
+see also PAPERS.md on multi-node comm/compute overlap).
+
+Four primitives, each a ``custom_vjp`` whose backward uses the *dual*
+decomposition (grad of an all-gather-matmul is a matmul-reduce-scatter and
+vice versa):
+
+======================  ===========================  =======================
+op                      forward                      backward (dx)
+======================  ===========================  =======================
+all_gather_matmul       AG(x, dim) @ w  (ring)       matmul_reduce_scatter
+matmul_reduce_scatter   RS(x @ w, dim)  (ring)       all_gather_matmul
+matmul_all_reduce       AR(x @ w) = AG(RS(x @ w))    x-free: g @ w^T
+copy_matmul             x @ w (x replicated)         AR(g @ w^T) = AG(RS(.))
+======================  ===========================  =======================
+
+Bit-exactness contract
+----------------------
+``impl="decomposed"`` and ``impl="monolithic"`` are bit-identical in fp32
+(fwd AND grad), by construction rather than by tolerance:
+
+* XLA accumulates ``psum`` / ``psum_scatter`` contributions left-to-right
+  in ascending rank order, so the decomposed reduce-scatter delivers each
+  partial block directly to its destination (per-step shifted ppermutes),
+  buffers them by *source rank*, and performs one ordered left-to-right
+  summation — the same additions in the same order as the monolithic
+  collective.
+* matmuls are row-block stable: block ``j`` of ``concat(shards) @ w``
+  equals ``shard_j @ w`` bit-for-bit, so the ring's per-step partial
+  matmuls reproduce the monolithic product exactly.
+* gathers are pure data movement and cannot perturb bits.
+
+Bidirectional (two-stream) variants split the ring into clockwise and
+counter-clockwise halves for even axis sizes — each shard travels at most
+``n/2`` hops instead of ``n-1``, halving ring latency on bidirectional ICI
+links. The buffered ordered summation makes the result independent of the
+streaming direction, so uni/bidi are bit-identical too.
+
+Fallback
+--------
+Decomposition needs the scattered/pipelined dim to tile evenly over the
+axis (and a gather/scatter dim distinct from the contraction dim). When it
+doesn't — e.g. the serving engine's single-token decode steps — every
+entry point silently falls back to the monolithic path instead of raising;
+``will_decompose`` exposes the decision for tests and benchmarks. The
+layer-level auto knob (``overlap_comm=None``) additionally requires the
+axis size to be ≥ ``MIN_AUTO_AXIS_SIZE`` — below that a ring is all
+latency and no pipelining.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel import comm
+from ..parallel import mesh as ps
+
+Array = jax.Array
+Kernels = Union[Array, Sequence[Array]]
+
+#: auto mode (``overlap_comm=None``) engages only at axis sizes where the
+#: ring has enough steps to pipeline; below this the monolithic collective
+#: is at least as good.
+MIN_AUTO_AXIS_SIZE = 4
+
+_IMPLS = ("auto", "decomposed", "monolithic")
+
+
+# ---------------------------------------------------------------------------
+# shape/impl resolution
+# ---------------------------------------------------------------------------
+
+def _norm_dim(dim: int, ndim: int) -> int:
+    return dim % ndim
+
+
+def _dim_ok(shape: Tuple[int, ...], dim: int) -> bool:
+    """The streamed dim must exist and precede the (last) contraction dim."""
+    if len(shape) < 2:
+        return False
+    return _norm_dim(dim, len(shape)) < len(shape) - 1
+
+
+def will_decompose(impl: str, axis, x_shape: Tuple[int, ...], dim: int,
+                   *, needs_divisible: bool) -> bool:
+    """Whether the decomposed ring will actually run for this call.
+
+    False means the monolithic path is used — never an error. Mirrors the
+    in-op resolution so tests/bench can assert engagement.
+    """
+    if impl not in _IMPLS:
+        raise ValueError(f"impl must be one of {_IMPLS}, got {impl!r}")
+    if impl == "monolithic":
+        return False
+    n = comm._axis_size(axis)
+    if n is None or n <= 1:
+        return False
+    if not _dim_ok(tuple(x_shape), dim):
+        return False
+    if needs_divisible and x_shape[_norm_dim(dim, len(x_shape))] % n != 0:
+        return False
+    return True
+
+
+def _resolve_bidi(bidirectional: Optional[bool], n: int) -> bool:
+    """Two-stream ring only for even axis sizes (auto: even and ≥ 4)."""
+    if bidirectional is None:
+        return n % 2 == 0 and n >= 4
+    return bool(bidirectional) and n % 2 == 0
+
+
+def overlap_engaged(overlap_comm: Optional[bool], axis,
+                    x_shape: Tuple[int, ...], dim: int, *,
+                    needs_divisible: bool) -> bool:
+    """Layer-level engagement decision for the ``overlap_comm`` knob.
+
+    ``None`` (auto): on when the axis is bound with size ≥
+    ``MIN_AUTO_AXIS_SIZE`` and the shapes tile; ``True``: on whenever the
+    shapes tile (never an error — non-tileable shapes fall back);
+    ``False``: off.
+    """
+    if overlap_comm is False:
+        return False
+    if not will_decompose("decomposed", axis, x_shape, dim,
+                          needs_divisible=needs_divisible):
+        return False
+    if overlap_comm is None:
+        n = comm._axis_size(axis)
+        return n is not None and n >= MIN_AUTO_AXIS_SIZE
+    return True
+
+
+# ---------------------------------------------------------------------------
+# contraction helpers (shared by both impls so the arithmetic is identical)
+# ---------------------------------------------------------------------------
+
+def _as_tuple(ws: Kernels) -> Tuple[Array, ...]:
+    if isinstance(ws, (tuple, list)):
+        return tuple(ws)
+    return (ws,)
+
+
+def _contract(x: Array, w: Array) -> Array:
+    """``x [..., K] × w [K, *rest] -> [..., *rest]`` (last-dim contraction,
+    the layout every TP linear in this codebase uses)."""
+    return jnp.tensordot(x, w, axes=((x.ndim - 1,), (0,)))
+
+
+def _contract_sum(xs: Sequence[Array], ws: Sequence[Array]) -> Array:
+    """``sum_i xs[i] @ ws[i]`` with a fixed left-to-right pair order."""
+    out = _contract(xs[0], ws[0])
+    for x, w in zip(xs[1:], ws[1:]):
+        out = out + _contract(x, w)
+    return out
+
+
+def _flat_t(w: Array) -> Array:
+    """``w [K, *rest] -> w^T [prod(rest), K]`` for the dual contraction."""
+    return w.reshape(w.shape[0], -1).T
+
+
+def _flat_rest(g: Array, w: Array) -> Array:
+    """Collapse ``g``'s trailing ``rest`` dims (matching ``w [K, *rest]``)
+    to one: ``[..., L, *rest] -> [..., L, R]``."""
+    lead = g.ndim - (w.ndim - 1)
+    return g.reshape(g.shape[:lead] + (-1,))
+
+
+def _dkernel(x_full: Array, g: Array, w_shape: Tuple[int, ...]) -> Array:
+    """``dw = x_full^T · g`` contracting every leading dim (batch + the
+    gathered dim); one flattened matmul, identical for both impls."""
+    k = x_full.shape[-1]
+    xf = x_full.reshape(-1, k)
+    gf = g.reshape(xf.shape[0], -1)
+    return jnp.tensordot(xf, gf, axes=((0,), (0,))).reshape(w_shape)
+
+
+# ---------------------------------------------------------------------------
+# decomposed rings
+# ---------------------------------------------------------------------------
+
+def _shift_perm(n: int, shift: int):
+    """ppermute pairs moving every shard ``shift`` ranks forward."""
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def _ag_matmul_decomposed(x: Array, ws: Tuple[Array, ...], axis, dim: int,
+                          bidi: bool) -> Tuple[Array, ...]:
+    """Ring all-gather-matmul: remote shards stream around the ring while
+    each step's block matmul (independent of the in-flight transfer) runs."""
+    n = comm._axis_size(axis)
+    idx = lax.axis_index(axis)
+    dim = _norm_dim(dim, x.ndim)
+    l = x.shape[dim]
+
+    outs = []
+    for w in ws:
+        shape = list(x.shape[:-1]) + list(w.shape[1:])
+        shape[dim] = n * l
+        outs.append(jnp.zeros(tuple(shape), jnp.result_type(x, w)))
+
+    def write(outs, chunk, src):
+        return [lax.dynamic_update_slice_in_dim(o, _contract(chunk, w),
+                                                src * l, axis=dim)
+                for o, w in zip(outs, ws)]
+
+    outs = write(outs, x, idx)  # own block first — no transfer needed
+    if not bidi:
+        chunk = x
+        for t in range(1, n):
+            # receive the next shard from the right neighbour; the matmul
+            # below consumes the *previous* chunk's successor, so transfer
+            # t+1 can fly while block t multiplies
+            chunk = comm.ppermute(chunk, axis, _shift_perm(n, -1))
+            outs = write(outs, chunk, (idx + t) % n)
+        return tuple(outs)
+    fwd = bwd = x
+    for t in range(1, n // 2 + 1):
+        fwd = comm.ppermute(fwd, axis, _shift_perm(n, -1))
+        outs = write(outs, fwd, (idx + t) % n)
+        if t != n - t:  # at t == n/2 both streams carry the same shard
+            bwd = comm.ppermute(bwd, axis, _shift_perm(n, +1))
+            outs = write(outs, bwd, (idx - t) % n)
+    return tuple(outs)
+
+
+def _ag_matmul_monolithic(x: Array, ws: Tuple[Array, ...], axis,
+                          dim: int) -> Tuple[Array, ...]:
+    xg = comm.all_gather(x, axis, dim)
+    return tuple(_contract(xg, w) for w in ws)
+
+
+def _mm_rs_decomposed(xs: Tuple[Array, ...], ws: Tuple[Array, ...], axis,
+                      dim: int, bidi: bool) -> Array:
+    """Ring matmul-reduce-scatter: each destination's partial block is
+    computed, shipped straight to its owner (shift-``t`` ppermute — one
+    hop's worth of latency per step regardless of distance on a torus),
+    buffered by source rank, and summed once left-to-right in ascending
+    rank order — the exact addition order of XLA's ``psum_scatter``."""
+    n = comm._axis_size(axis)
+    idx = lax.axis_index(axis)
+    dim = _norm_dim(dim, xs[0].ndim)
+    big = xs[0].shape[dim]
+    l = big // n
+
+    def block(j):
+        parts = [lax.dynamic_slice_in_dim(x, j * l, l, axis=dim)
+                 for x in xs]
+        return _contract_sum(parts, ws)
+
+    own = block(idx)
+    buf = jnp.zeros((n,) + own.shape, own.dtype)
+
+    def store(buf, p, src):
+        return lax.dynamic_update_slice(
+            buf, p[None], (src,) + (0,) * p.ndim)
+
+    buf = store(buf, own, idx)
+    if not bidi:
+        for t in range(1, n):
+            p = block((idx + t) % n)
+            p = comm.ppermute(p, axis, _shift_perm(n, t))
+            buf = store(buf, p, (idx - t) % n)
+    else:
+        for t in range(1, n // 2 + 1):
+            p = block((idx + t) % n)
+            p = comm.ppermute(p, axis, _shift_perm(n, t))
+            buf = store(buf, p, (idx - t) % n)
+            if t != n - t:
+                q = block((idx - t) % n)
+                q = comm.ppermute(q, axis, _shift_perm(n, -t))
+                buf = store(buf, q, (idx + t) % n)
+    acc = buf[0]
+    for r in range(1, n):  # ascending source rank, left-to-right
+        acc = acc + buf[r]
+    return acc
+
+
+def _mm_rs_monolithic(xs: Tuple[Array, ...], ws: Tuple[Array, ...], axis,
+                      dim: int) -> Array:
+    y = _contract_sum(list(xs), list(ws))
+    return comm.reduce_scatter(y, axis, _norm_dim(dim, y.ndim))
+
+
+def _mm_rs_impl(xs, ws, axis, dim, decomposed, bidi):
+    if decomposed:
+        return _mm_rs_decomposed(xs, ws, axis, dim, bidi)
+    return _mm_rs_monolithic(xs, ws, axis, dim)
+
+
+def _ag_matmul_impl(x, ws, axis, dim, decomposed, bidi):
+    if decomposed:
+        return _ag_matmul_decomposed(x, ws, axis, dim, bidi)
+    return _ag_matmul_monolithic(x, ws, axis, dim)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp primitives (dual decomposition in the backward)
+# ---------------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _ag_matmul(x, ws, axis, dim, decomposed, bidi):
+    return _ag_matmul_impl(x, ws, axis, dim, decomposed, bidi)
+
+
+def _ag_matmul_fwd(x, ws, axis, dim, decomposed, bidi):
+    return _ag_matmul_impl(x, ws, axis, dim, decomposed, bidi), (x, ws)
+
+
+def _ag_matmul_bwd(axis, dim, decomposed, bidi, res, gs):
+    x, ws = res
+    # dx: the dual — partial input-grads reduce-scattered back onto the
+    # gathered dim, overlapped when the forward was
+    g2s = tuple(_flat_rest(g, w) for g, w in zip(gs, ws))
+    wts = tuple(_flat_t(w) for w in ws)
+    dx = _mm_rs_impl(g2s, wts, axis, dim, decomposed, bidi)
+    dx = dx.astype(x.dtype)
+    # dw: needs the gathered input; re-gathering is pure movement so both
+    # impls see identical bits
+    x_full = comm.all_gather(x, axis, _norm_dim(dim, x.ndim))
+    dws = tuple(_dkernel(x_full, g, w.shape).astype(w.dtype)
+                for g, w in zip(gs, ws))
+    return dx, dws
+
+
+_ag_matmul.defvjp(_ag_matmul_fwd, _ag_matmul_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _mm_rs(x, w, axis, dim, decomposed, bidi):
+    return _mm_rs_impl((x,), (w,), axis, dim, decomposed, bidi)
+
+
+def _mm_rs_fwd(x, w, axis, dim, decomposed, bidi):
+    return _mm_rs_impl((x,), (w,), axis, dim, decomposed, bidi), (x, w)
+
+
+def _mm_rs_bwd(axis, dim, decomposed, bidi, res, g):
+    x, w = res
+    # dx: all-gather-matmul of the scattered cotangent against w^T
+    g2 = _flat_rest(g, w)
+    (dx,) = _ag_matmul_impl(g2, (_flat_t(w),), axis, dim, decomposed, bidi)
+    dx = dx.astype(x.dtype)
+    g_full = comm.all_gather(g, axis, _norm_dim(dim, g.ndim))
+    dw = _dkernel(x, g_full, w.shape).astype(w.dtype)
+    return dx, dw
+
+
+_mm_rs.defvjp(_mm_rs_fwd, _mm_rs_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _mm_ar(x, w, axis, dim, decomposed, bidi):
+    if decomposed:
+        y = _mm_rs_decomposed((x,), (w,), axis, dim, bidi)
+        return comm.all_gather(y, axis, _norm_dim(dim, y.ndim))
+    return comm.all_reduce(_contract(x, w), axis)
+
+
+def _mm_ar_fwd(x, w, axis, dim, decomposed, bidi):
+    return _mm_ar(x, w, axis, dim, decomposed, bidi), (x, w)
+
+
+def _mm_ar_bwd(axis, dim, decomposed, bidi, res, g):
+    x, w = res
+    # the all-reduce's cotangent is replicated: dx needs no collective
+    # (identical formula both impls — cf. reduce_from_tensor_parallel_region
+    # whose backward is the identity)
+    dx = _contract(_flat_rest(g, w), _flat_t(w)).astype(x.dtype)
+    dw = _dkernel(x, g, w.shape).astype(w.dtype)
+    return dx, dw
+
+
+_mm_ar.defvjp(_mm_ar_fwd, _mm_ar_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _copy_mm(x, ws, axis, dim, decomposed, bidi):
+    return tuple(_contract(x, w) for w in ws)
+
+
+def _copy_mm_fwd(x, ws, axis, dim, decomposed, bidi):
+    return tuple(_contract(x, w) for w in ws), (x, ws)
+
+
+def _copy_mm_bwd(axis, dim, decomposed, bidi, res, gs):
+    x, ws = res
+    # dx = psum(sum_i g_i w_i^T): decomposed as reduce-scatter (overlapped
+    # with the per-block matmuls) + all-gather
+    g2s = tuple(_flat_rest(g, w) for g, w in zip(gs, ws))
+    wts = tuple(_flat_t(w) for w in ws)
+    if decomposed:
+        dx = _mm_rs_decomposed(g2s, wts, axis, dim, bidi)
+        dx = comm.all_gather(dx, axis, _norm_dim(dim, dx.ndim))
+    else:
+        dx = comm.all_reduce(_contract_sum(g2s, wts), axis)
+    dx = dx.astype(x.dtype)
+    # kernels are axis-sharded: dw is local (x is replicated)
+    dws = tuple(_dkernel(x, g, w.shape).astype(w.dtype)
+                for g, w in zip(gs, ws))
+    return dx, dws
+
+
+_copy_mm.defvjp(_copy_mm_fwd, _copy_mm_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def _prep(impl: str, axis, x: Array, dim: int, needs_divisible: bool,
+          bidirectional: Optional[bool]):
+    decomposed = will_decompose(impl, axis, x.shape, dim,
+                                needs_divisible=needs_divisible)
+    n = comm._axis_size(axis) or 1
+    return decomposed, (_resolve_bidi(bidirectional, n) if decomposed
+                        else False)
+
+
+def _unwrap(outs: Tuple[Array, ...], kernels: Kernels):
+    if isinstance(kernels, (tuple, list)):
+        return outs
+    return outs[0]
+
+
+def all_gather_matmul(x: Array, kernels: Kernels, axis=ps.TP_AXIS,
+                      gather_dim: int = 1, *, impl: str = "auto",
+                      bidirectional: Optional[bool] = None):
+    """``all_gather(x, gather_dim) @ w`` for one kernel or a fused tuple
+    (e.g. Q/K/V share one gathered stream), decomposed into a ppermute
+    ring. ``x [..., gather_dim: l_local, ..., K]``, each kernel
+    ``[K, *rest]``; returns ``[..., n*l_local, ..., *rest]`` per kernel.
+
+    The sequence-parallel entry of a column-parallel linear. Backward:
+    ``dx`` is a (decomposed) matmul-reduce-scatter, ``dw`` a re-gather +
+    single flattened matmul.
+    """
+    ws = _as_tuple(kernels)
+    decomposed, bidi = _prep(impl, axis, x, gather_dim, False, bidirectional)
+    n = comm._axis_size(axis)
+    if n is None or n <= 1:
+        return _unwrap(tuple(_contract(x, w) for w in ws), kernels)
+    return _unwrap(_ag_matmul(x, ws, axis, gather_dim, decomposed, bidi),
+                   kernels)
+
+
+def matmul_reduce_scatter(x: Array, kernel: Array, axis=ps.TP_AXIS,
+                          scatter_dim: int = 1, *, impl: str = "auto",
+                          bidirectional: Optional[bool] = None) -> Array:
+    """``reduce_scatter(x @ kernel, scatter_dim)`` decomposed so each
+    destination's partial block ships while the next block multiplies.
+
+    The sequence-parallel exit of a row-parallel linear. Requires
+    ``x.shape[scatter_dim] % axis_size == 0`` to decompose; falls back to
+    the monolithic collective otherwise (never an error).
+    """
+    decomposed, bidi = _prep(impl, axis, x, scatter_dim, True, bidirectional)
+    n = comm._axis_size(axis)
+    if n is None or n <= 1:
+        return _contract(x, kernel)
+    return _mm_rs(x, kernel, axis, scatter_dim, decomposed, bidi)
+
+
+def matmul_all_reduce(x: Array, kernel: Array, axis=ps.TP_AXIS,
+                      pipeline_dim: int = 1, *, impl: str = "auto",
+                      bidirectional: Optional[bool] = None) -> Array:
+    """``all_reduce(x @ kernel)`` decomposed as matmul-reduce-scatter over
+    ``pipeline_dim`` (overlapped) followed by an all-gather (movement).
+
+    The plain-TP exit of a row-parallel linear.
+    """
+    decomposed, bidi = _prep(impl, axis, x, pipeline_dim, True, bidirectional)
+    n = comm._axis_size(axis)
+    if n is None or n <= 1:
+        return _contract(x, kernel)
+    return _mm_ar(x, kernel, axis, pipeline_dim, decomposed, bidi)
+
+
+def copy_matmul(x: Array, kernels: Kernels, axis=ps.TP_AXIS,
+                pipeline_dim: int = 1, *, impl: str = "auto",
+                bidirectional: Optional[bool] = None):
+    """Plain-TP column entry: forward is a local matmul on the replicated
+    input (identical for both impls); the *backward* input-grad all-reduce
+    is decomposed into overlapped reduce-scatter + all-gather over
+    ``pipeline_dim``."""
+    ws = _as_tuple(kernels)
+    decomposed, bidi = _prep(impl, axis, x, pipeline_dim, True, bidirectional)
+    n = comm._axis_size(axis)
+    if n is None or n <= 1:
+        return _unwrap(tuple(_contract(x, w) for w in ws), kernels)
+    return _unwrap(_copy_mm(x, ws, axis, pipeline_dim, decomposed, bidi),
+                   kernels)

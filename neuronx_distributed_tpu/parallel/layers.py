@@ -31,6 +31,7 @@ from flax import linen as nn
 
 from . import comm, mappings
 from . import mesh as ps
+from ..ops import collective_matmul as cm
 
 Dtype = Any
 Initializer = Callable[..., jax.Array]
@@ -76,6 +77,15 @@ class ColumnParallelLinear(nn.Module):
     ``W = [W_1 .. W_p]`` along the output dim; forward enters the TP region by
     identity (backward all-reduce), or by all-gather along the sequence dim
     when ``sequence_parallel`` (reference ``layers.py:438-504``).
+
+    ``overlap_comm`` routes the entry collective + matmul through the
+    decomposed ring primitives in :mod:`..ops.collective_matmul` so the
+    transfer overlaps the per-shard partial matmuls (the reference hides the
+    same latency with ``LinearWithAsyncCommunication``). ``None`` = auto
+    (on when the tp axis is bound with size ≥ 4 and shapes tile), ``True`` =
+    on where shapes allow (silent monolithic fallback otherwise), ``False``
+    = always monolithic. LoRA's activation-space branch needs the gathered
+    input, so adapters fall back.
     """
 
     features: int  # global output features
@@ -88,6 +98,7 @@ class ColumnParallelLinear(nn.Module):
     bias_init: Initializer = nn.initializers.zeros_init()
     axis: str = ps.TP_AXIS
     seq_dim: int = 1
+    overlap_comm: Optional[bool] = None
     # LoRA adapter (reference modules/lora/tp_layer.py LoraParallelLinear):
     # 0 disables; A is replicated, B is output-sharded like the kernel.
     lora_rank: int = 0
@@ -114,6 +125,26 @@ class ColumnParallelLinear(nn.Module):
                 "lora_b",
                 _partitioned(nn.initializers.zeros_init(), (None, self.axis)),
                 (self.lora_rank, out_local), self.param_dtype)
+
+        engaged = self.lora_rank == 0 and cm.overlap_engaged(
+            self.overlap_comm, self.axis, x.shape, self.seq_dim,
+            needs_divisible=not self.sequence_parallel)
+        if engaged:
+            x = x.astype(self.dtype)
+            if self.sequence_parallel:
+                y = cm.all_gather_matmul(x, kernel.astype(self.dtype),
+                                         self.axis, self.seq_dim,
+                                         impl="decomposed")
+            else:
+                y = cm.copy_matmul(x, kernel.astype(self.dtype),
+                                   self.axis, self.seq_dim,
+                                   impl="decomposed")
+            if bias is not None:
+                y = y + bias.astype(self.dtype)
+            if self.gather_output:
+                y = mappings.gather_from_tensor_parallel_region(
+                    y, self.axis, -1)
+            return y
 
         if self.sequence_parallel:
             x = mappings.gather_from_sequence_parallel_region(
@@ -312,6 +343,13 @@ class RowParallelLinear(nn.Module):
     Reference: ``parallel_layers/layers.py:815``. ``Y = X W`` with ``W``
     sharded along the input dim; forward exits the TP region by all-reduce, or
     reduce-scatter along the sequence dim when ``sequence_parallel``.
+
+    ``overlap_comm`` (same semantics as :class:`ColumnParallelLinear`)
+    decomposes the exit reduce-scatter / all-reduce so each destination
+    block's partial product ships while the next block multiplies. Needs
+    ``x.shape[seq_dim]`` to tile over the axis (decode's single-token steps
+    fall back monolithically — the decision is static on shapes, so it adds
+    no recompiles).
     """
 
     features: int  # global output features
@@ -324,6 +362,7 @@ class RowParallelLinear(nn.Module):
     bias_init: Initializer = nn.initializers.zeros_init()
     axis: str = ps.TP_AXIS
     seq_dim: int = 1
+    overlap_comm: Optional[bool] = None
     # LoRA adapter: A is input-sharded like the kernel, B replicated; the
     # lora partial sums ride the layer's existing all-reduce/reduce-scatter.
     lora_rank: int = 0
@@ -340,6 +379,24 @@ class RowParallelLinear(nn.Module):
             _partitioned(self.kernel_init, (self.axis, None)),
             (in_local, self.features), self.param_dtype)
         x = x.astype(self.dtype)
+        engaged = self.lora_rank == 0 and cm.overlap_engaged(
+            self.overlap_comm, self.axis, x.shape, self.seq_dim,
+            needs_divisible=True)
+        if engaged:
+            if self.sequence_parallel:
+                y = cm.matmul_reduce_scatter(x, kernel.astype(self.dtype),
+                                             self.axis, self.seq_dim,
+                                             impl="decomposed")
+            else:
+                y = cm.matmul_all_reduce(x, kernel.astype(self.dtype),
+                                         self.axis, self.seq_dim,
+                                         impl="decomposed")
+            if self.use_bias:
+                bias = self.param("bias",
+                                  _partitioned(self.bias_init, (None,)),
+                                  (self.features,), self.param_dtype)
+                y = y + bias.astype(self.dtype)
+            return y
         y = jnp.dot(x, kernel.astype(self.dtype))
         if self.lora_rank > 0:
             lora_a = self.param(
@@ -458,6 +515,11 @@ class GQAQKVColumnParallelLinear(nn.Module):
     axis: str = ps.TP_AXIS
     seq_dim: int = 1
     tp_size: Optional[int] = None  # required to size KV replication
+    # Overlapped entry (see ColumnParallelLinear): the three projections
+    # share one gathered stream — all_gather_matmul((wq, wk, wv)). The
+    # replicated-KV path (kv_size_multiplier > 1) and activation-space LoRA
+    # fall back; weight-space LoRA folds into the kernels and rides along.
+    overlap_comm: Optional[bool] = None
     # LoRA adapters (weight-space; reference LoraGQAQKVParallelLinear).
     # With lora_dropout active (rate > 0 and a "dropout" rng supplied) the
     # adapters switch to activation space — dropout on the adapter input
@@ -579,6 +641,26 @@ class GQAQKVColumnParallelLinear(nn.Module):
                     bk, head * self.head_dim, self.head_dim, axis=0)
                 bv = jax.lax.dynamic_slice_in_dim(
                     bv, head * self.head_dim, self.head_dim, axis=0)
+
+        engaged = (mult == 1 and not lora_act and cm.overlap_engaged(
+            self.overlap_comm, self.axis, x.shape, self.seq_dim,
+            needs_divisible=not self.sequence_parallel))
+        if engaged:
+            x = x.astype(self.dtype)
+            kernels = (wq.astype(self.dtype), wk.astype(self.dtype),
+                       wv.astype(self.dtype))
+            if self.sequence_parallel:
+                q, k, v = cm.all_gather_matmul(x, kernels, self.axis,
+                                               self.seq_dim,
+                                               impl="decomposed")
+            else:
+                q, k, v = cm.copy_matmul(x, kernels, self.axis,
+                                         self.seq_dim, impl="decomposed")
+            if self.use_bias:
+                q = q + bq.astype(self.dtype)
+                k = k + bk.astype(self.dtype)
+                v = v + bv.astype(self.dtype)
+            return q, k, v
 
         if self.sequence_parallel:
             x = mappings.gather_from_sequence_parallel_region(

@@ -46,6 +46,24 @@ from . import comm
 from . import mesh as ps
 
 
+def _check_seq_divisible(x, axis: str, seq_dim: int, op: str) -> None:
+    """Pointed shape validation for the sequence-parallel reduce-scatters.
+
+    ``psum_scatter`` requires the scattered dim to tile evenly over the
+    axis; without this check a non-divisible sequence length surfaces as an
+    opaque XLA shape error from deep inside the compiled program.
+    """
+    n = comm._axis_size(axis)
+    if n is None or n <= 1:
+        return
+    d = seq_dim % x.ndim
+    if x.shape[d] % n != 0:
+        raise ValueError(
+            f"{op}: sequence length {x.shape[d]} (dim {seq_dim}) does not "
+            f"divide evenly over mesh axis {axis!r} of size {n}; pad or "
+            f"trim the sequence to a multiple of {n}")
+
+
 # ---------------------------------------------------------------------------
 # copy / reduce (reference: _CopyToModelParallelRegion mappings.py:175,
 # _ReduceFromModelParallelRegion mappings.py:196)
@@ -162,6 +180,12 @@ def _sp_gather_fwd(x, axis, seq_dim, to_model_parallel):
 
 def _sp_gather_bwd(axis, seq_dim, to_model_parallel, _, g):
     if to_model_parallel:
+        # g normally has the gathered length (axis_size * local), but a
+        # consumer that reshaped/truncated the sequence hands back a
+        # cotangent psum_scatter can't re-shard — fail with names attached
+        _check_seq_divisible(
+            g, axis, seq_dim,
+            "gather_from_sequence_parallel_region (backward reduce-scatter)")
         return (comm.reduce_scatter(g, axis, seq_dim),)
     return (comm.split_along_dim(g, axis, seq_dim),)
 
@@ -173,10 +197,15 @@ gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
 def reduce_scatter_to_sequence_parallel_region(x, axis: str = ps.TP_AXIS,
                                                seq_dim: int = 1):
     """Exit a TP block into the SP region (reference ``mappings.py:322``)."""
+    _check_seq_divisible(x, axis, seq_dim,
+                         "reduce_scatter_to_sequence_parallel_region")
     return comm.reduce_scatter(x, axis, seq_dim)
 
 
 def _sp_rs_fwd(x, axis, seq_dim):
+    # the primal body above is skipped when differentiated — validate here too
+    _check_seq_divisible(x, axis, seq_dim,
+                         "reduce_scatter_to_sequence_parallel_region")
     return comm.reduce_scatter(x, axis, seq_dim), None
 
 

@@ -1,0 +1,42 @@
+"""Fixture: tp-overlap-rule violations (never imported, only parsed)."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def sp_entry_blocking(x_shard, kernel):
+    # blocking all-gather, then a matmul on the gathered activations —
+    # the wire idles during the einsum and the MXU during the gather
+    x = lax.all_gather(x_shard, "tp", axis=1, tiled=True)
+    return jnp.einsum("bsh,ho->bso", x, kernel)
+
+
+def plain_tp_exit_blocking(hidden_shard, kernel):
+    # psum'd activations feeding an @ matmul
+    hidden = lax.psum(hidden_shard, "tp")
+    return hidden @ kernel
+
+
+def gathered_then_dot(acts_local, w):
+    acts = lax.all_gather(acts_local, "tp", axis=1, tiled=True)
+    return jnp.dot(acts, w)
+
+
+def reassignment_clears(x_shard, kernel):
+    # the gathered value is replaced before the matmul: must NOT fire
+    x = lax.all_gather(x_shard, "tp", axis=1, tiled=True)
+    x = jnp.tanh(x_shard)
+    return jnp.dot(x, kernel)
+
+
+def gather_without_matmul_is_fine(x_shard):
+    # a gather whose result is only reduced: nothing to overlap with
+    x = lax.all_gather(x_shard, "tp", axis=1, tiled=True)
+    return x.sum()
+
+
+def gradient_psum_is_fine(grads, kernel):
+    # gradient collectives are the comm-compression rule's business —
+    # the activation-name gate must keep this rule quiet here
+    grads = lax.psum(grads, "dp")
+    return jnp.dot(grads, kernel)

@@ -87,6 +87,34 @@ def test_comm_compression_exempts_parallel_package():
     assert [f.rule for f in flagged] == ["comm-compression"]
 
 
+def test_tp_overlap_fires_on_fixture():
+    # the gradient-psum case belongs to comm-compression, so select just
+    # this rule; 3 blocking collective→matmul pairs fire, the reassigned /
+    # matmul-free / gradient-named cases stay quiet
+    fs = _lint("bad_tp_overlap.py", select=["tp-overlap"])
+    assert _rules(fs) == {"tp-overlap"}
+    assert len([f for f in fs if not f.suppressed]) == 3
+    msgs = " | ".join(f.message for f in fs)
+    assert "all_gather_matmul" in msgs
+    assert "matmul_all_reduce" in msgs
+    assert "'x'" in msgs and "'hidden'" in msgs and "'acts'" in msgs
+
+
+def test_tp_overlap_exempts_parallel_and_ops_packages():
+    src = ("from jax import lax\n"
+           "import jax.numpy as jnp\n"
+           "def gather_matmul(x_shard, w):\n"
+           "    x = lax.all_gather(x_shard, 'tp', axis=1, tiled=True)\n"
+           "    return jnp.dot(x, w)\n")
+    # the decomposed primitives and the mappings compose raw collectives
+    # with matmuls by design
+    for exempt in ("neuronx_distributed_tpu/ops/collective_matmul.py",
+                   "neuronx_distributed_tpu/parallel/mappings.py"):
+        assert analyze_source(src, exempt, axes=DEFAULT_AXES) == []
+    flagged = analyze_source(src, "mymodel/blocks.py", axes=DEFAULT_AXES)
+    assert [f.rule for f in flagged] == ["tp-overlap"]
+
+
 def test_recompile_hazard_fires_on_fixture():
     fs = _lint("bad_recompile.py")
     assert _rules(fs) == {"recompile-hazard"}
@@ -212,7 +240,7 @@ def test_cli_nonzero_on_fixture_corpus():
                  for line in r.stdout.splitlines() if "[" in line}
     assert out_rules == {"mesh-axis", "trace-safety", "custom-vjp",
                          "recompile-hazard", "resilience",
-                         "comm-compression"}
+                         "comm-compression", "tp-overlap"}
 
 
 def test_cli_zero_on_clean_file():
