@@ -70,7 +70,7 @@ jax = _init_backend_with_watchdog()
 import jax.numpy as jnp  # noqa: E402
 
 
-def main(chaos_spec=None, serving=False, overlap=False):
+def main(chaos_spec=None, serving=False, overlap=False, router=False):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -215,6 +215,18 @@ def main(chaos_spec=None, serving=False, overlap=False):
 
             traceback.print_exc()
             print(f"bench: serving metric failed: {e!r}", file=sys.stderr)
+
+    # multi-replica failover drill (docs/serving.md): opt-in via --router;
+    # the chaos drill kills a replica mid-decode and reports availability,
+    # failover count, and the TTFT p99 under chaos
+    if router:
+        try:
+            aux.update(router_metric(platform))
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: router metric failed: {e!r}", file=sys.stderr)
 
     # tensor-parallel overlap microbenchmark (docs/tp_overlap.md): opt-in
     # via --overlap; decomposed collective-matmul vs the monolithic
@@ -529,6 +541,65 @@ def serving_metric(platform: str) -> dict:
     }
 
 
+def router_metric(platform: str) -> dict:
+    """Multi-replica failover drill (docs/serving.md): run the router's
+    :func:`chaos_drill` — a fault plan crashes replica ``r1`` mid-decode;
+    its in-flight requests fail over to the survivor and must finish with
+    tokens bit-identical to a fault-free reference run. RETURNS aux
+    entries keyed by metric name — never prints the JSON line itself."""
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference.engine import EngineConfig
+    from neuronx_distributed_tpu.inference.router import chaos_drill
+    from neuronx_distributed_tpu.models import llama
+    from neuronx_distributed_tpu.parallel import mesh as ps
+
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel()
+    if platform == "cpu":
+        cfg = llama.tiny_config(num_layers=2, dtype=jnp.float32,
+                                param_dtype=jnp.float32)
+        n_req, prompt_len, max_new = 6, 6, 4
+        ecfg = EngineConfig(block_size=4, num_blocks=16, max_slots=2,
+                            max_blocks_per_seq=8, token_budget=8,
+                            kv_dtype=jnp.float32)
+    else:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=16, num_heads=8, num_kv_heads=8, max_seq_len=4096)
+        n_req, prompt_len, max_new = 12, 32, 16
+        ecfg = EngineConfig(block_size=16, num_blocks=128, max_slots=4,
+                            max_blocks_per_seq=16, token_budget=64,
+                            kv_dtype=cfg.dtype)
+    params = meta.unbox(llama.LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    drill = chaos_drill(cfg, params, ecfg, n_requests=n_req,
+                        prompt_len=prompt_len, max_new_tokens=max_new)
+    print(f"bench: router drill availability={drill['router_availability']} "
+          f"failovers={drill['router_failovers']} "
+          f"resubmitted_tokens={drill['router_resubmitted_tokens']} "
+          f"greedy_match_ref={drill['router_greedy_match_ref']}",
+          file=sys.stderr)
+    tag = f"{platform}1"
+    return {
+        f"router_availability_{tag}": {
+            "value": round(drill["router_availability"], 4), "unit": "frac",
+            "vs_baseline": 1.0},
+        f"router_failovers_{tag}": {
+            "value": int(drill["router_failovers"]), "unit": "failovers",
+            "vs_baseline": 1.0},
+        f"router_ttft_p99_ms_chaos_{tag}": {
+            "value": round(drill["router_ttft_p99_ms_chaos"], 2),
+            "unit": "ms", "vs_baseline": 1.0},
+        f"router_resubmitted_tokens_{tag}": {
+            "value": int(drill["router_resubmitted_tokens"]),
+            "unit": "tokens", "vs_baseline": 1.0},
+        f"router_greedy_match_ref_{tag}": {
+            "value": round(drill["router_greedy_match_ref"], 4),
+            "unit": "frac", "vs_baseline": 1.0},
+    }
+
+
 def comm_metric(platform: str, n_dev: int) -> dict:
     """Gradient-collective microbenchmark: step time of a gradient-sized
     ``all_reduce`` over the data axes at fp32 vs blockwise int8
@@ -784,10 +855,15 @@ if __name__ == "__main__":
              "engine vs static batched generate under a ragged Poisson "
              "arrival workload; docs/serving.md)")
     _p.add_argument(
+        "--router", action="store_true",
+        help="also run the multi-replica failover drill (chaos plan kills "
+             "a replica mid-decode; reports availability, failovers, and "
+             "chaos TTFT p99; docs/serving.md)")
+    _p.add_argument(
         "--overlap", action="store_true",
         help="also run the tensor-parallel overlap microbenchmark "
              "(decomposed collective-matmul vs monolithic gather+matmul at "
              "llama MLP shapes; docs/tp_overlap.md)")
     _args = _p.parse_args()
     main(chaos_spec=_args.chaos, serving=_args.serving,
-         overlap=_args.overlap)
+         overlap=_args.overlap, router=_args.router)

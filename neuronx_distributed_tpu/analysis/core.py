@@ -92,6 +92,7 @@ def _ensure_rules_loaded() -> None:
                    rules_mesh_axes,  # noqa: F401
                    rules_recompile,  # noqa: F401
                    rules_resilience,  # noqa: F401
+                   rules_serving_resilience,  # noqa: F401
                    rules_tp_overlap,  # noqa: F401
                    rules_trace_safety)  # noqa: F401
 

@@ -1,0 +1,121 @@
+"""Rule ``serving-resilience``: failure-handling hygiene in ``inference/``.
+
+The router/engine contract (``docs/serving.md``) is that serving failures
+are *typed* and *bounded*: a replica failure surfaces as
+``ReplicaCrashed``/``CacheExhaustedError``/``RequestRejected`` and is
+handled by the circuit breaker with bounded, backed-off resubmission.
+Two anti-patterns silently void that contract:
+
+* **Bare ``except``/``except Exception`` swallowing around
+  ``engine.step``/``submit`` call sites** — a handler that catches
+  everything and does not re-raise turns a replica death into a silent
+  no-op: the health monitor never sees the failure, in-flight requests
+  are never resubmitted, and the request is simply lost. Catch the typed
+  serving exceptions instead.
+
+* **Unbounded retry loops without backoff** — a ``while True:`` retry
+  whose handler ``continue``s straight back without sleeping/backing off
+  hammers a sick replica in a hot loop (and, with the point above, can
+  spin forever). Retries must be bounded (attempt counter) or paced
+  (backoff), like the router's ``max_retries`` + exponential backoff.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Iterator, List
+
+from . import astutil
+from .core import Finding, LintContext, register
+
+_ENGINE_CALLS = ("step", "submit")
+_BROAD = ("Exception", "BaseException")
+_PACING = ("sleep", "backoff", "wait", "delay")
+
+
+def _in_inference(path: str) -> bool:
+    return "inference" in pathlib.PurePath(path).parts
+
+
+def _engine_call_in(body) -> ast.Call:
+    """First ``<obj>.step(...)`` / ``<obj>.submit(...)`` call under these
+    statements, or None."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ENGINE_CALLS):
+                return node
+    return None
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:                       # bare `except:`
+        return True
+    types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    return any(astutil.tail_name(t) in _BROAD for t in types)
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    return not any(isinstance(n, ast.Raise)
+                   for stmt in handler.body for n in ast.walk(stmt))
+
+
+def _calls_pacing(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                name = (astutil.tail_name(node.func) or "").lower()
+                if any(p in name for p in _PACING):
+                    return True
+    return False
+
+
+def _is_while_true(loop: ast.While) -> bool:
+    return isinstance(loop.test, ast.Constant) and loop.test.value is True
+
+
+@register(
+    "serving-resilience",
+    "bare except swallowing around engine.step/submit call sites and "
+    "unbounded retry loops without backoff inside inference/ (voids the "
+    "typed-failure + bounded-failover contract)")
+def check(ctx: LintContext) -> Iterator[Finding]:
+    if not _in_inference(ctx.path):
+        return
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Try):
+            call = _engine_call_in(node.body)
+            if call is None:
+                continue
+            for handler in node.handlers:
+                if _is_broad_handler(handler) and _swallows(handler):
+                    findings.append(Finding(
+                        ctx.path, handler.lineno, handler.col_offset,
+                        "serving-resilience",
+                        f"broad except swallows failures around "
+                        f"`.{call.func.attr}(...)` — a replica death "
+                        "becomes a silent no-op and the request is lost; "
+                        "catch the typed serving exceptions "
+                        "(RequestRejected / CacheExhaustedError / "
+                        "ReplicaCrashed) or re-raise"))
+        elif isinstance(node, ast.While) and _is_while_true(node):
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.ExceptHandler):
+                    continue
+                has_continue = any(
+                    isinstance(n, ast.Continue)
+                    for stmt in sub.body for n in ast.walk(stmt))
+                if has_continue and not _calls_pacing(sub):
+                    findings.append(Finding(
+                        ctx.path, sub.lineno, sub.col_offset,
+                        "serving-resilience",
+                        "unbounded retry: `while True` handler continues "
+                        "without backoff or an attempt bound — this "
+                        "hammers a sick replica in a hot loop; bound the "
+                        "retries (max_retries) and pace them "
+                        "(exponential backoff)"))
+    yield from findings

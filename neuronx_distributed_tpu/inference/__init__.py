@@ -8,6 +8,8 @@
 * :mod:`.sampling` — greedy/top-k/top-p (reference ``utils/sampling.py``).
 * :mod:`.paging` — paged KV block pool + host-side block allocator.
 * :mod:`.engine` — continuous-batching serving engine over the paged pool.
+* :mod:`.router` — multi-replica front-end: placement, admission control,
+  health-checked failover, graceful drain.
 """
 
 from . import generation
@@ -18,7 +20,9 @@ from . import paging
 from . import engine
 from . import sampling
 from . import speculative
-from .engine import EngineConfig, EngineStats, RequestResult, ServingEngine
+from . import router
+from .engine import (EngineConfig, EngineStats, RequestRejected,
+                     RequestResult, ServingEngine)
 from .generation import (DECODE_BUCKETS, decode_step, generate, pick_bucket,
                          prefill)
 from .kv_cache import KVCache, init_kv_cache
@@ -28,18 +32,23 @@ from .model_builder import (ModelBuilder, NxDModel, bundle_generate,
 from .paging import (BlockAllocator, CacheExhaustedError, PagedKVCache,
                      QuantizedPagedKVCache, init_paged_kv_cache,
                      init_quantized_paged_kv_cache)
+from .router import (ReplicaRouter, RouterConfig, RouterResult, RouterStats,
+                     ServingPreempted, TenantPolicy)
 from .sampling import SamplingConfig, sample
 from .speculative import make_speculation_round_fn
 
 __all__ = [
     "generation", "kv_cache", "model_builder", "sampling",
-    "benchmark", "speculative", "paging", "engine",
+    "benchmark", "speculative", "paging", "engine", "router",
     "DECODE_BUCKETS", "decode_step", "generate", "pick_bucket", "prefill",
     "KVCache", "init_kv_cache",
     "BlockAllocator", "CacheExhaustedError", "PagedKVCache",
     "QuantizedPagedKVCache", "init_paged_kv_cache",
     "init_quantized_paged_kv_cache",
-    "ServingEngine", "EngineConfig", "EngineStats", "RequestResult",
+    "ServingEngine", "EngineConfig", "EngineStats", "RequestRejected",
+    "RequestResult",
+    "ReplicaRouter", "RouterConfig", "RouterResult", "RouterStats",
+    "ServingPreempted", "TenantPolicy",
     "ModelBuilder", "NxDModel", "generate_buckets", "shard_checkpoint",
     "bundle_generate", "bundle_speculative_generate",
     "make_speculation_round_fn",

@@ -41,6 +41,19 @@ from .paging import (BlockAllocator, CacheExhaustedError,
 from .sampling import SamplingConfig, sample
 
 
+@jax.jit
+def _clear_freed_positions(pos, freed_mask):
+    """Reset freed blocks' stored positions to the pad sentinel.
+
+    A freed block keeps its old per-entry positions; if it is later
+    remapped at a *different* block index of another sequence, those
+    stale small positions pass the ``q_pos >= stored_pos`` causal mask
+    and leak the previous owner's K/V into attention. Fixed shapes
+    (``[num_blocks, block_size]`` pool positions, ``[num_blocks]`` bool
+    mask), so this compiles once alongside the serving step."""
+    return jnp.where(freed_mask[:, None], PAD_POSITION, pos)
+
+
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
     """Serving-side knobs (the model config stays in ``LlamaConfig``).
@@ -59,6 +72,28 @@ class EngineConfig:
     kv_dtype: Any = None            # None -> model dtype (fp pool only)
     eos_id: Optional[int] = None
     sampling: SamplingConfig = SamplingConfig(greedy=True)
+
+
+class RequestRejected(RuntimeError):
+    """Typed admission rejection raised at ``submit`` time.
+
+    ``reason`` is machine-readable so routers/clients can branch on it:
+
+    * ``never_fits`` — the request could not fit the pool / block table /
+      model context even running alone; resubmitting is pointless.
+    * ``over_budget`` — the global token budget is exhausted (router).
+    * ``draining`` — the target is draining and admits nothing new.
+    * ``tenant_throttled`` — the tenant's token bucket is empty (router).
+    """
+
+    REASONS = ("never_fits", "over_budget", "draining", "tenant_throttled")
+
+    def __init__(self, reason: str, detail: str = ""):
+        if reason not in self.REASONS:
+            raise ValueError(f"unknown rejection reason {reason!r}")
+        super().__init__(f"request rejected ({reason})"
+                         + (f": {detail}" if detail else ""))
+        self.reason = reason
 
 
 @dataclasses.dataclass
@@ -109,6 +144,8 @@ class EngineStats:
     completed: int = 0
     rejected: int = 0
     preempted: int = 0
+    resubmitted: int = 0            # evicted for resubmission elsewhere
+    queue_depth: int = 0            # gauge: live requests right now
     tokens_generated: int = 0
     ttft_s: List[float] = dataclasses.field(default_factory=list)
     step_latency_s: List[float] = dataclasses.field(default_factory=list)
@@ -136,6 +173,16 @@ class EngineStats:
             "pool_occupancy_mean": (float(np.mean(self.occupancy))
                                     if self.occupancy else 0.0),
         }
+
+    def to_dict(self) -> Dict[str, float]:
+        """:meth:`report` plus the composable counters the router folds
+        into its own stats (``rejected`` / ``resubmitted`` /
+        ``queue_depth``)."""
+        d = self.report()
+        d["rejected"] = self.rejected
+        d["resubmitted"] = self.resubmitted
+        d["queue_depth"] = self.queue_depth
+        return d
 
 
 class ServingEngine:
@@ -165,6 +212,8 @@ class ServingEngine:
         self._t0 = self._clock()
         self._admit_counter = 0
         self._uid_counter = 0
+        self._draining = False
+        self._freed_dirty: set = set()  # freed blocks with stale positions
         self.cache = self._init_cache()
         self._step_fn = self._build_step()
 
@@ -223,12 +272,24 @@ class ServingEngine:
     def _now(self) -> float:
         return self._clock() - self._t0
 
+    def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Whether a request of this size could ever run on this engine
+        (alone, with the whole pool to itself)."""
+        e = self.ecfg
+        total = int(prompt_len) + int(max_new_tokens)
+        blocks_needed = -(-total // e.block_size)
+        return (prompt_len > 0 and total <= self.model_cfg.max_seq_len
+                and blocks_needed <= e.max_blocks_per_seq
+                and blocks_needed <= e.num_blocks)
+
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                uid: Optional[str] = None,
                arrival_time: Optional[float] = None) -> str:
-        """Enqueue a request. Over-capacity requests (could never fit the
-        pool / block table / model context even alone) are rejected
-        immediately and show up in ``results`` with status "rejected"."""
+        """Enqueue a request. Raises :class:`RequestRejected` — with
+        ``reason="never_fits"`` for over-capacity requests (could never
+        fit the pool / block table / model context even alone) or
+        ``reason="draining"`` after :meth:`drain` — after recording the
+        rejection in ``results``/``stats``."""
         if uid is None:
             uid = f"req{self._uid_counter}"
             self._uid_counter += 1
@@ -237,22 +298,68 @@ class ServingEngine:
             uid=uid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             arrival_time=(self._now() if arrival_time is None
                           else float(arrival_time)))
-        e = self.ecfg
-        total = req.prompt_len + req.max_new_tokens
-        blocks_needed = -(-total // e.block_size)
-        if (not prompt or total > self.model_cfg.max_seq_len
-                or blocks_needed > e.max_blocks_per_seq
-                or blocks_needed > e.num_blocks):
-            self.stats.rejected += 1
-            self.results[uid] = RequestResult(
-                uid=uid, prompt_len=req.prompt_len, tokens=[],
-                status="rejected")
-            return uid
+        if self._draining:
+            self._reject(req, "draining",
+                         f"{uid}: engine is draining, not admitting")
+        if not self.fits(req.prompt_len, req.max_new_tokens):
+            self._reject(
+                req, "never_fits",
+                f"{uid}: prompt_len={req.prompt_len} "
+                f"max_new={req.max_new_tokens} cannot fit this engine")
         self._queue.append(req)
+        self.stats.queue_depth = self.queue_depth()
         return uid
+
+    def _reject(self, req: _RequestState, reason: str, detail: str):
+        self.stats.rejected += 1
+        self.results[req.uid] = RequestResult(
+            uid=req.uid, prompt_len=req.prompt_len, tokens=[],
+            status="rejected")
+        raise RequestRejected(reason, detail)
 
     def has_work(self) -> bool:
         return bool(self._queue) or any(s is not None for s in self._slots)
+
+    # -- router hooks -----------------------------------------------------
+
+    def queue_depth(self) -> int:
+        """Live requests on this engine (queued + running slots) — the
+        router's join-shortest-queue load signal."""
+        return (len(self._queue)
+                + sum(1 for s in self._slots if s is not None))
+
+    def pool_free_blocks(self) -> int:
+        """Unallocated KV blocks in the pool (occupancy = 1 - free/total)."""
+        return self.allocator.num_blocks - self.allocator.num_allocated
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Stop admitting new requests; in-flight work keeps stepping to
+        completion (``submit`` now rejects with ``reason="draining"``)."""
+        self._draining = True
+
+    def evict(self, request_id: str):
+        """Forcibly remove a live request (queued or running), freeing any
+        blocks it holds. Returns ``(prompt, generated_so_far)`` so the
+        caller can resubmit it elsewhere; raises ``KeyError`` if the
+        request is not live here. The request leaves no entry in
+        ``results`` — its fate now belongs to the resubmitter."""
+        for req in self._queue:
+            if req.uid == request_id:
+                self._queue.remove(req)
+                self.stats.resubmitted += 1
+                self.stats.queue_depth = self.queue_depth()
+                return list(req.prompt), list(req.generated)
+        for req in self._slots:
+            if req is not None and req.uid == request_id:
+                self._release(req)
+                self.stats.resubmitted += 1
+                self.stats.queue_depth = self.queue_depth()
+                return list(req.prompt), list(req.generated)
+        raise KeyError(f"request {request_id!r} is not live on this engine")
 
     def run(self) -> Dict[str, RequestResult]:
         """Drive :meth:`step` until queue and slots drain. With the real
@@ -299,6 +406,7 @@ class ServingEngine:
 
     def _release(self, req: _RequestState) -> None:
         slot = req.slot
+        self._freed_dirty.update(self._slot_blocks[slot])
         self.allocator.free(self._slot_blocks[slot])
         self._slot_blocks[slot] = []
         self._tables[slot, :] = -1
@@ -379,6 +487,12 @@ class ServingEngine:
             tokens[0, i] = tok
             positions[0, i] = pos
             slot_ids[i] = req.slot
+        if self._freed_dirty:
+            mask = np.zeros((self.ecfg.num_blocks,), np.bool_)
+            mask[list(self._freed_dirty)] = True
+            self._freed_dirty.clear()
+            self.cache = self.cache.replace(pos=_clear_freed_positions(
+                self.cache.pos, jnp.asarray(mask)))
         self.cache = self.cache.replace(
             block_tables=jnp.asarray(self._tables),
             lengths=jnp.asarray(
@@ -411,6 +525,7 @@ class ServingEngine:
         self.stats.last_step_t = now
         self.stats.occupancy.append(
             self.allocator.num_allocated / self.ecfg.num_blocks)
+        self.stats.queue_depth = self.queue_depth()
         return len(rows)
 
     def _retire(self, req: _RequestState, now: float) -> None:

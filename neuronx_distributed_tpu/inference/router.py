@@ -1,0 +1,654 @@
+"""Fault-tolerant multi-replica serving: the front-end request router.
+
+:class:`ReplicaRouter` fronts N :class:`~.engine.ServingEngine` replicas
+(in-process instances, each with its own block pool — CPU-testable) and
+owns the request lifecycle end to end:
+
+* **placement** — join-shortest-queue over live queue depth + pool
+  occupancy, with optional session affinity (a session's requests stick
+  to the replica that holds their warm KV prefix while it stays healthy);
+* **admission control** — a per-tenant token bucket
+  (:class:`TenantPolicy`) plus a global committed-token budget, with a
+  typed :class:`~.engine.RequestRejected` at submit and an overload
+  ladder that *degrades before it sheds*:
+
+  ========================  =========================================
+  load (committed/budget)   behavior
+  ========================  =========================================
+  < degrade_threshold       admit as-is
+  >= degrade_threshold      admit, cap ``max_new_tokens`` at
+                            ``degrade_max_new``
+  >= shed_threshold         additionally reject lowest-priority
+                            tenants (``over_budget``)
+  > 1.0                     reject everyone (``over_budget``)
+  ========================  =========================================
+
+* **health + failover** — a per-replica :class:`ReplicaMonitor`
+  (step-latency z-score spikes + stall budget, both factored from the
+  training watchdog, plus a :class:`~.paging.CacheExhaustedError` storm
+  counter) trips a circuit breaker: the replica is marked down, its
+  in-flight requests are resubmitted *from their prompts* to survivors
+  (Orca-style recovery: greedy decoding is rng-free, so a restarted
+  request produces bit-identical tokens) with bounded retries and
+  exponential backoff, and the replica is revived with a fresh engine
+  after a probation window of clean steps;
+* **graceful drain** — a :class:`~..resilience.preemption.PreemptionGuard`
+  SIGTERM flips the router to drain mode: no new admissions, in-flight
+  requests finish (failing replicas still hand off), then
+  :class:`ServingPreempted` exits with code 75 so the orchestrator
+  reschedules rather than retries.
+
+Chaos drills inject faults through :meth:`FaultPlan.consult` with
+``op="step"`` and ``path=<replica name>`` — the plan *returns* directives
+(``crash`` / ``exhaust`` / latency seconds) instead of raising/sleeping,
+so injected latency is virtual and drills are deterministic under fake
+clocks. See :func:`chaos_drill` and ``bench.py --router``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..resilience.chaos import FaultPlan
+from ..resilience.preemption import EXIT_PREEMPTED, PreemptionGuard
+from ..resilience.watchdog import SpikeDetector, StallTimer
+from .engine import (EngineConfig, RequestRejected, ServingEngine)
+from .paging import CacheExhaustedError
+
+
+class ServingPreempted(SystemExit):
+    """Raised by :meth:`ReplicaRouter.run` after a graceful drain
+    completes; carries exit code 75 (reschedule-me) and the final
+    results so the caller can flush them before exiting."""
+
+    def __init__(self, results, stats):
+        super().__init__(EXIT_PREEMPTED)
+        self.results = results
+        self.stats = stats
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """Per-tenant admission policy.
+
+    ``rate_tokens_per_s``/``burst_tokens`` parameterize a token bucket
+    over *committed* tokens (prompt + max_new per request); the defaults
+    are unlimited. ``priority`` orders tenants for overload shedding —
+    lower values are shed first once load crosses ``shed_threshold``.
+    """
+
+    rate_tokens_per_s: float = math.inf
+    burst_tokens: float = math.inf
+    priority: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Router-side knobs (engine knobs stay in :class:`EngineConfig`).
+
+    ``global_token_budget`` defaults to the aggregate pool capacity
+    (``num_replicas * num_blocks * block_size``). Health thresholds are
+    deliberately loose by default — CPU test timing is noisy, so drills
+    trigger failures through chaos directives, not wall-clock jitter.
+    """
+
+    num_replicas: int = 2
+    tenants: Dict[str, TenantPolicy] = dataclasses.field(
+        default_factory=dict)
+    default_tenant: str = "default"
+    global_token_budget: Optional[int] = None
+    degrade_threshold: float = 0.75
+    shed_threshold: float = 0.9
+    degrade_max_new: int = 16
+    occupancy_weight: float = 4.0   # JSQ: occupancy vs queue-depth weight
+    affinity: bool = True
+    max_retries: int = 3
+    backoff_base_s: float = 0.01
+    stall_timeout_s: float = 30.0
+    latency_window: int = 32
+    latency_zscore: float = 50.0
+    latency_min_steps: int = 8
+    exhaust_window: int = 8
+    exhaust_threshold: int = 3
+    probation_steps: int = 8        # router steps a tripped replica sits out
+    probation_ok_steps: int = 4     # clean steps to go probation -> up
+
+
+@dataclasses.dataclass
+class RouterResult:
+    uid: str
+    tenant: str
+    status: str                     # "completed" | "rejected" | "failed"
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    reason: Optional[str] = None    # rejection reason / failure cause
+    replica: Optional[str] = None   # replica that completed it
+    resubmits: int = 0              # failovers this request survived
+    ttft_s: Optional[float] = None
+    degraded: bool = False
+
+
+@dataclasses.dataclass
+class RouterStats:
+    submitted: int = 0
+    admitted: int = 0
+    completed: int = 0
+    failed: int = 0
+    degraded: int = 0
+    rejected_by_reason: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
+    tenant_shed: Dict[str, int] = dataclasses.field(default_factory=dict)
+    failovers: int = 0              # circuit-breaker trips
+    resubmits: int = 0              # request resubmissions after a trip
+    resubmitted_tokens: int = 0     # re-done work: re-prefilled + discarded
+    revivals: int = 0
+    steps: int = 0
+    ttft_s: List[float] = dataclasses.field(default_factory=list)
+
+    def availability(self) -> float:
+        """Admitted-request completion rate — the service-level signal
+        (an admitted request that fails after retries is an outage)."""
+        return self.completed / max(1, self.admitted)
+
+    def to_dict(self) -> Dict[str, Any]:
+        ttft = np.asarray(self.ttft_s or [0.0])
+        return {
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "completed": self.completed,
+            "failed": self.failed,
+            "degraded": self.degraded,
+            "availability": self.availability(),
+            "failovers": self.failovers,
+            "resubmits": self.resubmits,
+            "resubmitted_tokens": self.resubmitted_tokens,
+            "revivals": self.revivals,
+            "steps": self.steps,
+            "rejected_by_reason": dict(self.rejected_by_reason),
+            "tenant_shed": dict(self.tenant_shed),
+            "ttft_p50_ms": float(np.percentile(ttft, 50)) * 1e3,
+            "ttft_p99_ms": float(np.percentile(ttft, 99)) * 1e3,
+        }
+
+
+class ReplicaMonitor:
+    """Per-replica health monitor, reusing the training watchdog's
+    factored primitives: a :class:`SpikeDetector` over step latency
+    (training watches loss; serving watches time), a :class:`StallTimer`
+    consulted synchronously via ``observe`` (no background thread — the
+    router is single-threaded and fake-clock friendly), and a sliding
+    window of :class:`CacheExhaustedError` storms."""
+
+    def __init__(self, cfg: RouterConfig):
+        self._cfg = cfg
+        self.latency = SpikeDetector(window=cfg.latency_window,
+                                     zscore=cfg.latency_zscore,
+                                     min_steps=cfg.latency_min_steps)
+        self.stall = StallTimer(cfg.stall_timeout_s)
+        self.exhausts: Deque[int] = deque(maxlen=cfg.exhaust_window)
+
+    def observe_step(self, latency_s: float,
+                     exhausted: bool = False) -> Optional[str]:
+        """Feed one step's (possibly chaos-inflated) latency; returns the
+        tripped verdict or None."""
+        if self.stall.observe(latency_s):
+            return "stall"
+        if self.latency.observe(latency_s) is not None:
+            return "latency_spike"
+        self.exhausts.append(1 if exhausted else 0)
+        if sum(self.exhausts) >= self._cfg.exhaust_threshold:
+            self.exhausts.clear()
+            return "exhaust_storm"
+        return None
+
+
+@dataclasses.dataclass
+class _RouterRequest:
+    uid: str
+    tenant: str
+    prompt: List[int]
+    max_new_tokens: int
+    arrival_time: float
+    session: Optional[str] = None
+    attempts: int = 0               # failovers survived so far
+    next_try: float = 0.0           # backoff: not placeable before this
+    placed_at: Optional[float] = None
+    degraded: bool = False
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.prompt) + self.max_new_tokens
+
+
+@dataclasses.dataclass
+class _Replica:
+    name: str
+    engine: Optional[ServingEngine]
+    monitor: ReplicaMonitor
+    state: str = "up"               # "up" | "probation" | "down"
+    down_steps: int = 0             # steps left before revival
+    ok_steps: int = 0               # clean steps while in probation
+    assigned: Dict[str, _RouterRequest] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def live(self) -> bool:
+        return self.state != "down" and self.engine is not None
+
+
+class ReplicaRouter:
+    """Front-end for N in-process serving replicas; see module docstring.
+
+    Engines can be injected (``engines=``) for tests; by default the
+    router builds ``cfg.num_replicas`` fresh :class:`ServingEngine`
+    instances sharing ``params`` (read-only) on one ``clock``.
+    """
+
+    def __init__(self, model_cfg, params,
+                 engine_cfg: EngineConfig = EngineConfig(),
+                 cfg: RouterConfig = RouterConfig(), *,
+                 engines: Optional[Sequence[ServingEngine]] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 preemption_guard: Optional[PreemptionGuard] = None,
+                 chaos: Optional[FaultPlan] = None):
+        self.model_cfg = model_cfg
+        self.params = params
+        self.ecfg = engine_cfg
+        self.cfg = cfg
+        self.stats = RouterStats()
+        self.results: Dict[str, RouterResult] = {}
+        self._clock = clock or time.monotonic
+        self._t0 = self._clock()
+        self._guard = preemption_guard
+        self._chaos = chaos
+        self._draining = False
+        self._uid_counter = 0
+        self._pending: Deque[_RouterRequest] = deque()
+        self._sessions: Dict[str, str] = {}   # session -> replica name
+        self._buckets: Dict[str, List[float]] = {}  # tenant -> [tokens, t]
+        self._committed = 0                   # admitted tokens in flight
+        if engines is not None:
+            if len(engines) != cfg.num_replicas:
+                raise ValueError(
+                    f"got {len(engines)} engines for "
+                    f"num_replicas={cfg.num_replicas}")
+            engines = list(engines)
+        else:
+            engines = [self._new_engine() for _ in range(cfg.num_replicas)]
+        self.replicas = [
+            _Replica(name=f"r{i}", engine=eng, monitor=ReplicaMonitor(cfg))
+            for i, eng in enumerate(engines)]
+        pool_tokens = engine_cfg.num_blocks * engine_cfg.block_size
+        self._budget = (cfg.global_token_budget
+                        if cfg.global_token_budget is not None
+                        else cfg.num_replicas * pool_tokens)
+
+    def _new_engine(self) -> ServingEngine:
+        return ServingEngine(self.model_cfg, self.params, self.ecfg,
+                             clock=self._clock)
+
+    # -- time / introspection ---------------------------------------------
+
+    def _now(self) -> float:
+        return self._clock() - self._t0
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Stop admitting; in-flight requests keep running to completion
+        (failing replicas still hand off to survivors)."""
+        self._draining = True
+
+    def live_replicas(self) -> List[_Replica]:
+        return [r for r in self.replicas if r.live]
+
+    def has_work(self) -> bool:
+        return bool(self._pending) or any(
+            r.assigned for r in self.replicas)
+
+    def _policy(self, tenant: str) -> TenantPolicy:
+        return self.cfg.tenants.get(tenant, TenantPolicy())
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               tenant: Optional[str] = None, uid: Optional[str] = None,
+               session: Optional[str] = None,
+               arrival_time: Optional[float] = None) -> str:
+        """Admit or reject a request. Raises
+        :class:`~.engine.RequestRejected` with a machine-readable
+        ``reason`` after recording the rejection in ``results``; returns
+        the uid on admission."""
+        if uid is None:
+            uid = f"rr{self._uid_counter}"
+            self._uid_counter += 1
+        tenant = tenant or self.cfg.default_tenant
+        prompt = [int(t) for t in prompt]
+        req = _RouterRequest(
+            uid=uid, tenant=tenant, prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            arrival_time=(self._now() if arrival_time is None
+                          else float(arrival_time)),
+            session=session)
+        self.stats.submitted += 1
+        if self._draining:
+            self._reject(req, "draining", "router is draining")
+        if not self._fits_any(req):
+            self._reject(req, "never_fits",
+                         f"{uid}: cannot fit any replica even alone")
+        load = (self._committed + req.total_tokens) / max(1, self._budget)
+        if load > 1.0:
+            self._reject(req, "over_budget",
+                         f"global budget: load would be {load:.2f}")
+        if load >= self.cfg.shed_threshold and self._is_sheddable(tenant):
+            self.stats.tenant_shed[tenant] = (
+                self.stats.tenant_shed.get(tenant, 0) + 1)
+            self._reject(req, "over_budget",
+                         f"shedding low-priority tenant {tenant!r} at "
+                         f"load {load:.2f}")
+        if load >= self.cfg.degrade_threshold:
+            capped = min(req.max_new_tokens, self.cfg.degrade_max_new)
+            if capped < req.max_new_tokens:
+                req.max_new_tokens = capped
+                req.degraded = True
+                self.stats.degraded += 1
+        if not self._bucket_take(tenant, req.total_tokens):
+            self._reject(req, "tenant_throttled",
+                         f"tenant {tenant!r} token bucket empty")
+        self._committed += req.total_tokens
+        self.stats.admitted += 1
+        self._pending.append(req)
+        return uid
+
+    def _fits_any(self, req: _RouterRequest) -> bool:
+        probe = next((r.engine for r in self.replicas
+                      if r.engine is not None), None)
+        # all replicas share one EngineConfig, so any engine answers
+        return probe is not None and probe.fits(
+            len(req.prompt), req.max_new_tokens)
+
+    def _is_sheddable(self, tenant: str) -> bool:
+        """Shed tenants strictly below the highest configured priority;
+        with no priority spread nobody is singled out (the hard budget
+        still backstops)."""
+        policies = list(self.cfg.tenants.values())
+        if not policies:
+            return False
+        top = max(p.priority for p in policies)
+        return self._policy(tenant).priority < top
+
+    def _bucket_take(self, tenant: str, cost: int) -> bool:
+        pol = self._policy(tenant)
+        if math.isinf(pol.rate_tokens_per_s) and math.isinf(
+                pol.burst_tokens):
+            return True
+        now = self._now()
+        tokens, last = self._buckets.get(tenant, [pol.burst_tokens, now])
+        tokens = min(pol.burst_tokens,
+                     tokens + pol.rate_tokens_per_s * max(0.0, now - last))
+        if tokens < cost:
+            self._buckets[tenant] = [tokens, now]
+            return False
+        self._buckets[tenant] = [tokens - cost, now]
+        return True
+
+    def _reject(self, req: _RouterRequest, reason: str, detail: str):
+        self.stats.rejected_by_reason[reason] = (
+            self.stats.rejected_by_reason.get(reason, 0) + 1)
+        self.results[req.uid] = RouterResult(
+            uid=req.uid, tenant=req.tenant, status="rejected",
+            reason=reason)
+        raise RequestRejected(reason, detail)
+
+    # -- placement ---------------------------------------------------------
+
+    def _score(self, rep: _Replica) -> float:
+        eng = rep.engine
+        occupancy = 1.0 - eng.pool_free_blocks() / max(1, eng.allocator
+                                                       .num_blocks)
+        return eng.queue_depth() + self.cfg.occupancy_weight * occupancy
+
+    def _choose_replica(self, req: _RouterRequest) -> Optional[_Replica]:
+        live = self.live_replicas()
+        if not live:
+            return None
+        if self.cfg.affinity and req.session:
+            name = self._sessions.get(req.session)
+            hit = next((r for r in live if r.name == name), None)
+            if hit is not None:
+                return hit
+        return min(live, key=lambda r: (self._score(r), r.name))
+
+    def _place_pending(self) -> int:
+        placed = 0
+        now = self._now()
+        for req in list(self._pending):
+            if req.arrival_time > now or req.next_try > now:
+                continue
+            rep = self._choose_replica(req)
+            if rep is None:
+                continue  # all replicas down; retried after revival
+            try:
+                # engine-frame arrival so the engine admits it now and
+                # its ttft_s measures time-from-placement
+                rep.engine.submit(req.prompt, req.max_new_tokens,
+                                  uid=req.uid,
+                                  arrival_time=rep.engine._now())
+            except RequestRejected:
+                # a replica-local refusal (e.g. drained externally) is a
+                # failover event for this request, not a router rejection
+                rep.engine.results.pop(req.uid, None)
+                self._pending.remove(req)
+                self._requeue(req, rep, lost_generated=0)
+                continue
+            self._pending.remove(req)
+            req.placed_at = now
+            rep.assigned[req.uid] = req
+            if req.session:
+                self._sessions[req.session] = rep.name
+            placed += 1
+        return placed
+
+    # -- health + failover -------------------------------------------------
+
+    def _requeue(self, req: _RouterRequest, rep: Optional[_Replica],
+                 lost_generated: int) -> None:
+        """Route a request back through pending after its replica failed
+        it; bounded retries with exponential backoff."""
+        req.attempts += 1
+        # re-done work: the prompt is re-prefilled and any generated
+        # tokens are discarded (greedy regenerates them bit-identically)
+        self.stats.resubmitted_tokens += len(req.prompt) + lost_generated
+        if req.attempts > self.cfg.max_retries:
+            self._committed -= req.total_tokens
+            self.stats.failed += 1
+            self.results[req.uid] = RouterResult(
+                uid=req.uid, tenant=req.tenant, status="failed",
+                reason="max_retries", resubmits=req.attempts - 1)
+            return
+        req.next_try = self._now() + (
+            self.cfg.backoff_base_s * 2 ** (req.attempts - 1))
+        req.placed_at = None
+        self.stats.resubmits += 1
+        if rep is not None and req.uid in rep.assigned:
+            del rep.assigned[req.uid]
+        self._pending.append(req)
+
+    def _fail_replica(self, rep: _Replica, why: str,
+                      engine_alive: bool) -> None:
+        """Trip the circuit breaker: evict/salvage in-flight requests to
+        pending, mark the replica down for a probation window."""
+        self.stats.failovers += 1
+        for uid, req in list(rep.assigned.items()):
+            lost = 0
+            if engine_alive and rep.engine is not None:
+                try:
+                    _, generated = rep.engine.evict(uid)
+                    lost = len(generated)
+                except KeyError:
+                    pass  # completed this very step; collected below
+            self._requeue(req, None, lost_generated=lost)
+        rep.assigned.clear()
+        if req_sessions := [s for s, n in self._sessions.items()
+                            if n == rep.name]:
+            for s in req_sessions:
+                del self._sessions[s]
+        rep.state = "down"
+        rep.down_steps = self.cfg.probation_steps
+        rep.ok_steps = 0
+        if not engine_alive:
+            rep.engine = None  # crashed: the instance is gone
+        rep.monitor = ReplicaMonitor(self.cfg)
+
+    def _tick_revivals(self) -> None:
+        for rep in self.replicas:
+            if rep.state != "down":
+                continue
+            rep.down_steps -= 1
+            if rep.down_steps > 0:
+                continue
+            if rep.engine is None:
+                rep.engine = self._new_engine()
+            rep.state = "probation"
+            rep.ok_steps = 0
+            self.stats.revivals += 1
+
+    # -- stepping ----------------------------------------------------------
+
+    def _collect(self, rep: _Replica) -> None:
+        eng = rep.engine
+        for uid in [u for u in rep.assigned if u in eng.results]:
+            req = rep.assigned.pop(uid)
+            res = eng.results.pop(uid)
+            self._committed -= req.total_tokens
+            self.stats.completed += 1
+            ttft = None
+            if res.ttft_s is not None and req.placed_at is not None:
+                ttft = (req.placed_at - req.arrival_time) + res.ttft_s
+                self.stats.ttft_s.append(ttft)
+            self.results[uid] = RouterResult(
+                uid=uid, tenant=req.tenant, status="completed",
+                tokens=list(res.tokens), replica=rep.name,
+                resubmits=req.attempts, ttft_s=ttft,
+                degraded=req.degraded)
+
+    def step(self) -> int:
+        """One router step: check the preemption guard, tick revivals,
+        place pending requests, then step every live replica under chaos
+        consultation and health monitoring. Returns placed + stepped
+        activity (0 = nothing was runnable now)."""
+        if self._guard is not None and self._guard.requested:
+            self._draining = True
+        self._tick_revivals()
+        activity = self._place_pending()
+        for rep in self.replicas:
+            if not rep.live or not rep.assigned:
+                continue
+            directive, extra_latency = (
+                self._chaos.consult("step", rep.name)
+                if self._chaos is not None else (None, 0.0))
+            if directive == "crash":
+                self._fail_replica(rep, "crash", engine_alive=False)
+                continue
+            exhausted = directive == "exhaust"
+            rows = 0
+            try:
+                rows = rep.engine.step()
+            except CacheExhaustedError:
+                # nothing left to preempt: a real storm, count it
+                exhausted = True
+            activity += rows
+            latency = (rep.engine.stats.step_latency_s[-1]
+                       if rows and rep.engine.stats.step_latency_s
+                       else 0.0) + extra_latency
+            self._collect(rep)   # completions survive a same-step trip
+            verdict = rep.monitor.observe_step(latency,
+                                               exhausted=exhausted)
+            if verdict is not None:
+                self._fail_replica(rep, verdict, engine_alive=True)
+                continue
+            if rep.state == "probation":
+                rep.ok_steps += 1
+                if rep.ok_steps >= self.cfg.probation_ok_steps:
+                    rep.state = "up"
+        self.stats.steps += 1
+        return activity
+
+    def run(self) -> Dict[str, RouterResult]:
+        """Drive :meth:`step` until every admitted request resolves.
+        With a fake clock, waits (future arrivals, backoff) fast-forward;
+        with the real clock they sleep. Raises :class:`ServingPreempted`
+        (exit 75) if a drain was requested and has completed."""
+        while self.has_work():
+            if self.step() == 0 and self.has_work():
+                gaps = [max(r.arrival_time, r.next_try) - self._now()
+                        for r in self._pending]
+                gap = min(gaps) if gaps else 0.0
+                if gap > 0:
+                    if self._clock is not time.monotonic:
+                        self._t0 -= gap  # fake clock: fast-forward
+                    else:
+                        time.sleep(min(gap, 0.05))
+        if self._draining and self._guard is not None:
+            raise ServingPreempted(self.results, self.stats)
+        return self.results
+
+
+def chaos_drill(model_cfg, params, engine_cfg: EngineConfig,
+                *, n_requests: int = 6, prompt_len: int = 6,
+                max_new_tokens: int = 4,
+                plan_spec: str = "step|r1 : crash, after=3, times=1",
+                num_replicas: int = 2,
+                clock: Optional[Callable[[], float]] = None,
+                seed: int = 0) -> Dict[str, Any]:
+    """Deterministic failover drill for tests and ``bench.py --router``.
+
+    Runs the same request set twice — fault-free on one replica, then on
+    ``num_replicas`` replicas under ``plan_spec`` — and reports
+    availability, failover counts, resubmitted-token cost, chaos TTFT,
+    and whether every completed output is bit-identical to the fault-free
+    run (greedy decoding makes failover invisible in the tokens).
+    """
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, model_cfg.vocab_size,
+                           (prompt_len,)).tolist()
+               for _ in range(n_requests)]
+
+    def _run(n_rep: int, chaos: Optional[FaultPlan]):
+        router = ReplicaRouter(
+            model_cfg, params, engine_cfg,
+            RouterConfig(num_replicas=n_rep),
+            clock=clock, chaos=chaos)
+        for i, p in enumerate(prompts):
+            router.submit(p, max_new_tokens, uid=f"req{i}")
+        return router.run(), router.stats
+
+    ref_results, _ = _run(1, None)
+    chaos_results, stats = _run(num_replicas,
+                                FaultPlan.parse(plan_spec))
+    completed = [r for r in chaos_results.values()
+                 if r.status == "completed"]
+    matches = all(
+        chaos_results[uid].tokens == ref_results[uid].tokens
+        for uid in ref_results
+        if chaos_results.get(uid) is not None
+        and chaos_results[uid].status == "completed")
+    d = stats.to_dict()
+    return {
+        "router_availability": d["availability"],
+        "router_failovers": d["failovers"],
+        "router_resubmits": d["resubmits"],
+        "router_resubmitted_tokens": d["resubmitted_tokens"],
+        "router_revivals": d["revivals"],
+        "router_completed": len(completed),
+        "router_admitted": d["admitted"],
+        "router_ttft_p99_ms_chaos": d["ttft_p99_ms"],
+        "router_greedy_match_ref": float(matches),
+    }

@@ -24,22 +24,26 @@ commit protocol with done-markers, tenacity-style storage retries,
 See ``docs/resilience.md``.
 """
 
-from .chaos import ChaosCheckpointStorage, FaultPlan, FaultRule, InjectedFault
+from .chaos import (ChaosCheckpointStorage, FaultPlan, FaultRule,
+                    InjectedFault, ReplicaCrashed)
 from .manifest import (MANIFEST_FILE, build_manifest, verify_manifest)
 from .preemption import (EXIT_PREEMPTED, PreemptionGuard, TrainingPreempted)
-from .watchdog import Watchdog, WatchdogHalt
+from .watchdog import SpikeDetector, StallTimer, Watchdog, WatchdogHalt
 
 __all__ = [
     "ChaosCheckpointStorage",
     "FaultPlan",
     "FaultRule",
     "InjectedFault",
+    "ReplicaCrashed",
     "MANIFEST_FILE",
     "build_manifest",
     "verify_manifest",
     "EXIT_PREEMPTED",
     "PreemptionGuard",
     "TrainingPreempted",
+    "SpikeDetector",
+    "StallTimer",
     "Watchdog",
     "WatchdogHalt",
 ]

@@ -1,4 +1,4 @@
-"""Deterministic fault injection for checkpoint storage.
+"""Deterministic fault injection for checkpoint storage and serving.
 
 :class:`ChaosCheckpointStorage` wraps any ``BaseCheckpointStorage`` and
 injects faults according to a :class:`FaultPlan` — a small, seed-driven DSL
@@ -6,7 +6,7 @@ of :class:`FaultRule` entries. Faults are *deterministic* for a given
 (seed, op sequence): the same plan replayed over the same operations injects
 the same faults, so chaos tests are reproducible bit-for-bit.
 
-Three fault kinds:
+Storage fault kinds:
 
 * ``transient`` — raises :class:`InjectedFault` (a ``ConnectionError``
   subclass carrying a throttle marker) that ``_is_transient`` classifies as
@@ -16,10 +16,25 @@ Three fault kinds:
 * ``latency`` — sleeps ``latency_s`` before the op (host-side only; never
   inside traced code).
 
+Serving fault kinds (the router drills of ``inference/router.py``, where
+``op`` is the lifecycle point — ``step`` — and ``path`` is the replica
+name):
+
+* ``crash`` — raises :class:`ReplicaCrashed`: the replica process/host is
+  gone and every in-flight request on it must fail over.
+* ``exhaust`` — a KV block-pool exhaustion storm signal (raised as
+  ``CacheExhaustedError`` through :meth:`FaultPlan.apply`).
+
+The router consults the plan through :meth:`FaultPlan.consult`, which
+*returns* the directive instead of raising/sleeping, so injected latency is
+virtual (deterministic under fake clocks) and the caller decides how a
+crash or an exhaustion storm manifests.
+
 The plan is buildable programmatically or parsed from a compact spec string
-usable from the CLI (``bench.py --chaos``)::
+usable from the CLI (``bench.py --chaos`` / ``--router``)::
 
     seed=7; save_text|*/checkpoint : transient, p=0.5, times=2; * : latency=0.01
+    step|r1 : crash, after=6, times=1        # kill replica r1 at its 7th step
 
 Each ``;``-separated clause is ``op[|pathglob] : kind-and-options`` where
 options are ``p=<prob>``, ``after=<n calls>``, ``times=<max fires>``,
@@ -34,7 +49,7 @@ import fnmatch
 import random
 import threading
 import time
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Tuple
 
 from ..trainer.checkpoint_storage import (BaseCheckpointStorage,
                                           retry_with_backoff)
@@ -44,6 +59,11 @@ class InjectedFault(ConnectionError):
     """A chaos-injected transient fault. The message carries a throttle
     marker so ``_is_transient`` classifies it exactly like a real S3
     503 slow-down."""
+
+
+class ReplicaCrashed(RuntimeError):
+    """A chaos-injected (or observed) serving-replica death: the engine
+    behind it is gone and its in-flight requests must be resubmitted."""
 
 
 @dataclasses.dataclass
@@ -58,16 +78,18 @@ class FaultRule:
 
     op: str = "*"
     path: str = "*"
-    kind: str = "transient"  # transient | permanent | latency
+    kind: str = "transient"  # transient|permanent|latency|crash|exhaust
     prob: float = 1.0
     after: int = 0
     times: int = -1
     latency_s: float = 0.0
 
+    _KINDS = ("transient", "permanent", "latency", "crash", "exhaust")
+
     def __post_init__(self) -> None:
-        if self.kind not in ("transient", "permanent", "latency"):
+        if self.kind not in self._KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r} "
-                             "(transient | permanent | latency)")
+                             f"({' | '.join(self._KINDS)})")
         if not 0.0 <= self.prob <= 1.0:
             raise ValueError(f"prob must be in [0, 1], got {self.prob}")
 
@@ -138,14 +160,13 @@ class FaultPlan:
         with self._lock:
             return sum(self._fired)
 
-    def apply(self, op: str, path: str) -> None:
-        """Consult every rule for this (op, path); raise/sleep as directed.
-
-        The first raising rule wins; latency rules sleep and keep going so a
-        latency+transient combination behaves like a slow failing store.
-        """
-        to_raise: Optional[BaseException] = None
-        sleep_s = 0.0
+    def _fire(self, op: str, path: str) -> Tuple[Optional[str], float]:
+        """Match + fire every rule for (op, path) under the lock; returns
+        ``(first_raising_kind_or_None, max_latency_s)``. Fire bookkeeping
+        (``after``/``times``/``prob`` draws, the audit log) happens here so
+        :meth:`apply` and :meth:`consult` share one deterministic stream."""
+        kind: Optional[str] = None
+        latency_s = 0.0
         with self._lock:
             for i, rule in enumerate(self.rules):
                 if not rule.matches(op, path):
@@ -160,20 +181,47 @@ class FaultPlan:
                 self._fired[i] += 1
                 self.injected.append(f"{rule.kind} {op} {path}")
                 if rule.kind == "latency":
-                    sleep_s = max(sleep_s, rule.latency_s)
-                elif to_raise is None and rule.kind == "transient":
-                    to_raise = InjectedFault(
-                        f"chaos: injected transient fault on {op}({path!r}) "
-                        "— 503 slow down")
-                elif to_raise is None:
-                    to_raise = OSError(
-                        errno.ENOSPC,
-                        f"chaos: injected permanent fault on {op}({path!r})"
-                        " — no space left on device")
+                    latency_s = max(latency_s, rule.latency_s)
+                elif kind is None:
+                    kind = rule.kind
+        return kind, latency_s
+
+    def consult(self, op: str, path: str) -> Tuple[Optional[str], float]:
+        """Like :meth:`apply` but *returns* the directive instead of
+        raising/sleeping: ``(kind | None, latency_s)``. Serving chaos goes
+        through here — the router interprets ``crash``/``exhaust`` itself
+        and treats latency as virtual time, so drills stay deterministic
+        under fake clocks."""
+        return self._fire(op, path)
+
+    def apply(self, op: str, path: str) -> None:
+        """Consult every rule for this (op, path); raise/sleep as directed.
+
+        The first raising rule wins; latency rules sleep and keep going so a
+        latency+transient combination behaves like a slow failing store.
+        """
+        kind, sleep_s = self._fire(op, path)
         if sleep_s > 0:
             time.sleep(sleep_s)
-        if to_raise is not None:
-            raise to_raise
+        if kind == "transient":
+            raise InjectedFault(
+                f"chaos: injected transient fault on {op}({path!r}) "
+                "— 503 slow down")
+        if kind == "permanent":
+            raise OSError(
+                errno.ENOSPC,
+                f"chaos: injected permanent fault on {op}({path!r})"
+                " — no space left on device")
+        if kind == "crash":
+            raise ReplicaCrashed(
+                f"chaos: injected replica crash on {op}({path!r})")
+        if kind == "exhaust":
+            # lazy import: resilience must not depend on inference at
+            # module load (the router imports this package)
+            from ..inference.paging import CacheExhaustedError
+
+            raise CacheExhaustedError(
+                f"chaos: injected pool-exhaustion storm on {op}({path!r})")
 
 
 class ChaosCheckpointStorage(BaseCheckpointStorage):

@@ -28,6 +28,11 @@ The watchdog reads ``float(metrics[...])`` and is therefore *the* host sync
 point of the loop — by design: anomaly detection needs the value, and a
 single fetch per step is the price of catching divergence the step it
 happens.
+
+The two detection primitives are factored out as :class:`SpikeDetector`
+(rolling z-score) and :class:`StallTimer` (heartbeat staleness) so the
+serving-side replica health monitor (``inference/router.py``) reuses the
+exact same statistics over *step latency* that training runs over *loss*.
 """
 
 from __future__ import annotations
@@ -36,7 +41,7 @@ import collections
 import math
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from ..utils.logger import get_logger, log_event
 
@@ -48,6 +53,133 @@ _POLICIES = ("halt", "skip_step", "rewind")
 class WatchdogHalt(RuntimeError):
     """Training halted by the watchdog (non-finite metrics with policy
     ``halt``, or a recovery policy that ran out of budget)."""
+
+
+class SpikeDetector:
+    """Rolling z-score spike detector over a bounded window of finite
+    observations.
+
+    Factored out of the training watchdog so serving health monitors can
+    reuse the exact same statistic: training feeds *loss*, the replica
+    router (``inference/router.py``) feeds *step latency*. An observation
+    is compared against the window **before** being appended, so a spike
+    does not poison the baseline it is judged against — but it does enter
+    the window afterwards, matching the original watchdog semantics
+    (a sustained level shift stops spiking once the window absorbs it).
+    """
+
+    def __init__(self, window: int = 32, zscore: float = 8.0,
+                 min_steps: int = 8):
+        self.window = window
+        self.zscore = zscore
+        self.min_steps = max(min_steps, 2)
+        self.values: collections.deque = collections.deque(maxlen=window)
+        self.spikes = 0
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def clear(self) -> None:
+        self.values.clear()
+
+    def observe(self, value: float) -> Optional[Tuple[float, float]]:
+        """Feed one finite observation. Returns ``(z, rolling_mean)`` when
+        it spikes past the threshold (and counts it), else None. No spike
+        is ever reported before ``min_steps`` observations exist."""
+        spike = None
+        if len(self.values) >= self.min_steps:
+            mean = sum(self.values) / len(self.values)
+            var = sum((x - mean) ** 2
+                      for x in self.values) / len(self.values)
+            z = (value - mean) / max(math.sqrt(var), 1e-8)
+            if z > self.zscore:
+                self.spikes += 1
+                spike = (z, mean)
+        self.values.append(value)
+        return spike
+
+
+class StallTimer:
+    """Heartbeat-staleness detector, factored from the watchdog's stall
+    thread so the serving router reuses it instead of duplicating.
+
+    Three usage shapes share one fire-once-per-heartbeat state machine:
+
+    * **threaded** (``start()``/``stop()``): a daemon thread polls and
+      calls ``on_stall(stalled_for_s)`` when a heartbeat goes stale — the
+      training-watchdog mode (a hung collective never returns control, so
+      only another thread can notice);
+    * **passive** (``beat()`` + ``check()``): the owner polls on its own
+      schedule against an injectable ``clock`` — deterministic under the
+      fake clocks serving tests drive;
+    * **post-hoc** (``observe(elapsed_s)``): the owner measured a step's
+      duration itself (possibly including chaos-injected virtual latency)
+      and asks whether it blew the budget.
+    """
+
+    def __init__(self, timeout_s: float,
+                 on_stall: Optional[Callable[[float], None]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 name: str = "nxd-stall-timer"):
+        self.timeout_s = float(timeout_s)
+        self._on_stall = on_stall
+        self._clock = clock
+        self._name = name
+        self.stalls = 0
+        self._heartbeat = clock()
+        self._fired_for: Optional[float] = None
+        self._stop = threading.Event()
+        self.thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        self._heartbeat = self._clock()
+
+    def stalled_for(self) -> float:
+        return self._clock() - self._heartbeat
+
+    def check(self) -> bool:
+        """True exactly once per stale heartbeat (re-arms on ``beat()``)."""
+        hb = self._heartbeat
+        if self._clock() - hb > self.timeout_s and self._fired_for != hb:
+            self._fired_for = hb
+            self.stalls += 1
+            return True
+        return False
+
+    def observe(self, elapsed_s: float) -> bool:
+        """Record a step that took ``elapsed_s``; True when it exceeds the
+        budget. Counts every over-budget step (each is its own stall)."""
+        self.beat()
+        if elapsed_s > self.timeout_s:
+            self.stalls += 1
+            return True
+        return False
+
+    # ---- threaded mode ---------------------------------------------------
+
+    def _loop(self) -> None:
+        poll = min(1.0, self.timeout_s / 4.0)
+        while not self._stop.wait(poll):
+            if self.check() and self._on_stall is not None:
+                try:
+                    self._on_stall(self.stalled_for())
+                except Exception:
+                    logger.exception("stall timer: on_stall callback failed")
+
+    def start(self) -> "StallTimer":
+        if self.thread is None:
+            self._stop.clear()
+            self.beat()
+            self.thread = threading.Thread(target=self._loop, daemon=True,
+                                           name=self._name)
+            self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.thread is not None:
+            self.thread.join(timeout=5.0)
+            self.thread = None
 
 
 def _state_step(state) -> Optional[int]:
@@ -91,18 +223,29 @@ class Watchdog:
         self.spike_is_anomaly = spike_is_anomaly
         self.stall_timeout_s = stall_timeout_s
         self._on_stall = on_stall or self._default_on_stall
-        self._losses: collections.deque = collections.deque(
-            maxlen=spike_window)
+        self._detector = SpikeDetector(window=spike_window,
+                                       zscore=spike_zscore,
+                                       min_steps=spike_min_steps)
         self._consecutive_skips = 0
         self._rewinds = 0
         self.anomalies = 0
-        self.spikes = 0
-        self.stalls = 0
-        self._heartbeat = time.monotonic()
-        self._stop = threading.Event()
-        self._stall_thread: Optional[threading.Thread] = None
+        self._timer: Optional[StallTimer] = None
+        self._stalls_base = 0  # stalls from timers already stopped
 
     # ------------------------------------------------------------- stalls
+
+    @property
+    def spikes(self) -> int:
+        return self._detector.spikes
+
+    @property
+    def stalls(self) -> int:
+        live = self._timer.stalls if self._timer is not None else 0
+        return self._stalls_base + live
+
+    @property
+    def _stall_thread(self) -> Optional[threading.Thread]:
+        return self._timer.thread if self._timer is not None else None
 
     def _default_on_stall(self, trainer) -> None:
         logger.critical(
@@ -113,39 +256,23 @@ class Watchdog:
 
         _thread.interrupt_main()
 
-    def _stall_loop(self) -> None:
-        assert self.stall_timeout_s is not None
-        poll = min(1.0, self.stall_timeout_s / 4.0)
-        fired_for = None
-        while not self._stop.wait(poll):
-            hb = self._heartbeat
-            if time.monotonic() - hb > self.stall_timeout_s:
-                if fired_for == hb:
-                    continue  # one shot per stalled step
-                fired_for = hb
-                self.stalls += 1
-                log_event(logger, "watchdog_stall",
-                          budget_s=self.stall_timeout_s,
-                          stalled_for_s=round(time.monotonic() - hb, 3))
-                try:
-                    self._on_stall(self._trainer)
-                except Exception:
-                    logger.exception("watchdog: on_stall callback failed")
+    def _handle_stall(self, stalled_for_s: float) -> None:
+        log_event(logger, "watchdog_stall", budget_s=self.stall_timeout_s,
+                  stalled_for_s=round(stalled_for_s, 3))
+        self._on_stall(self._trainer)
 
     # ---------------------------------------------------- Callback hooks
 
     def on_train_start(self, trainer) -> None:
         self._trainer = trainer
-        self._heartbeat = time.monotonic()
-        if self.stall_timeout_s is not None and self._stall_thread is None:
-            self._stop.clear()
-            self._stall_thread = threading.Thread(
-                target=self._stall_loop, daemon=True,
-                name="nxd-watchdog-stall")
-            self._stall_thread.start()
+        if self.stall_timeout_s is not None and self._timer is None:
+            self._timer = StallTimer(self.stall_timeout_s,
+                                     on_stall=self._handle_stall,
+                                     name="nxd-watchdog-stall").start()
 
     def on_step_end(self, trainer, metrics: Dict) -> None:
-        self._heartbeat = time.monotonic()
+        if self._timer is not None:
+            self._timer.beat()
         loss = float(metrics.get("loss", float("nan")))
         grad_norm = float(metrics.get("grad_norm", 0.0))
         if not (math.isfinite(loss) and math.isfinite(grad_norm)):
@@ -155,27 +282,21 @@ class Watchdog:
             return
         self._consecutive_skips = 0
         self._check_spike(trainer, loss)
-        self._losses.append(loss)
 
     def on_eval_end(self, trainer, metrics: Dict) -> None: ...
 
     def on_train_end(self, trainer) -> None:
-        self._stop.set()
-        if self._stall_thread is not None:
-            self._stall_thread.join(timeout=5.0)
-            self._stall_thread = None
+        if self._timer is not None:
+            self._timer.stop()
+            self._stalls_base += self._timer.stalls
+            self._timer = None
 
     # ----------------------------------------------------------- spikes
 
     def _check_spike(self, trainer, loss: float) -> None:
-        if len(self._losses) < self.spike_min_steps:
-            return
-        mean = sum(self._losses) / len(self._losses)
-        var = sum((x - mean) ** 2 for x in self._losses) / len(self._losses)
-        std = math.sqrt(var)
-        z = (loss - mean) / max(std, 1e-8)
-        if z > self.spike_zscore:
-            self.spikes += 1
+        spike = self._detector.observe(loss)
+        if spike is not None:
+            z, mean = spike
             log_event(logger, "watchdog_loss_spike",
                       step=trainer.host_step, loss=round(loss, 6),
                       rolling_mean=round(mean, 6), zscore=round(z, 2))
@@ -232,7 +353,7 @@ class Watchdog:
         step = _state_step(trainer.state)
         if step is not None:
             trainer.host_step = step
-        self._losses.clear()
+        self._detector.clear()
         logger.warning("watchdog: rewound to checkpoint step %s "
                        "(rewind %d/%d)", step, self._rewinds,
                        self.max_rewinds)

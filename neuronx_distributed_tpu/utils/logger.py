@@ -17,6 +17,35 @@ from typing import Optional
 _LOGGERS = {}
 
 
+class _Rank0Filter(logging.Filter):
+    """Drop sub-WARNING records on non-zero processes.
+
+    The process-index check runs lazily at emit time: ``get_logger`` is
+    called at module import all over the package, and ``jax.process_index``
+    initializes the XLA backend — which would freeze device flags (e.g.
+    ``--xla_force_host_platform_device_count``) before callers get a chance
+    to set them. An uninitialized backend means we can't know the rank yet,
+    so the record passes through rather than forcing initialization.
+    """
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if record.levelno >= logging.WARNING:
+            return True
+        try:
+            from jax._src import xla_bridge
+
+            if not xla_bridge._backends:
+                return True
+            import jax
+
+            return jax.process_index() == 0
+        except Exception:
+            return True
+
+
+_RANK0_FILTER = _Rank0Filter()
+
+
 def get_log_level() -> int:
     level = os.environ.get("NXD_LOG_LEVEL", "INFO").upper()
     return getattr(logging, level, logging.INFO)
@@ -37,13 +66,8 @@ def get_logger(name: str = "neuronx_distributed_tpu",
             "%(asctime)s [%(levelname)s] %(name)s: %(message)s"))
         logger.addHandler(h)
         logger.propagate = False
-    try:
-        import jax
-
-        if rank0_only and jax.process_index() != 0:
-            logger.setLevel(logging.WARNING)
-    except Exception:
-        pass
+    if rank0_only and _RANK0_FILTER not in logger.filters:
+        logger.addFilter(_RANK0_FILTER)
     _LOGGERS[key] = logger
     return logger
 

@@ -1,0 +1,38 @@
+"""Fixture: serving failure handling that voids the failover contract.
+
+Broad excepts swallow replica deaths around ``engine.step``/``submit``
+call sites, and a ``while True`` retry loop hammers the engine with no
+backoff and no attempt bound — the anti-patterns the router's typed
+exceptions + bounded exponential-backoff resubmission exist to prevent.
+"""
+
+
+def serve_forever(engine, requests):
+    for prompt in requests:
+        try:
+            engine.submit(prompt, 16)
+        except Exception:                 # swallows RequestRejected et al.
+            pass
+    while engine.has_work():
+        try:
+            engine.step()
+        except:                           # bare: replica death vanishes
+            continue
+
+
+def hot_retry(engine, prompt):
+    while True:
+        try:
+            return engine.submit(prompt, 16)
+        except Exception:
+            continue                      # no backoff, no bound
+
+
+def fine_typed_and_bounded(engine, prompt, errors):
+    # typed handling with a bounded, paced retry does NOT fire
+    for attempt in range(3):
+        try:
+            return engine.submit(prompt, 16)
+        except errors.RequestRejected:
+            errors.backoff_sleep(0.01 * 2 ** attempt)
+    raise RuntimeError("gave up after 3 attempts")

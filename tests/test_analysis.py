@@ -149,9 +149,37 @@ def test_per_request_rule_scoped_to_inference_paths():
     assert [f.rule for f in flagged] == ["recompile-hazard"]
 
 
+def test_serving_resilience_fires_on_fixture():
+    fs = _lint(os.path.join("inference", "bad_serving_resilience.py"))
+    assert _rules(fs) == {"serving-resilience"}
+    msgs = " | ".join(f.message for f in fs if not f.suppressed)
+    assert ".submit(...)" in msgs and ".step(...)" in msgs
+    assert "unbounded retry" in msgs
+    # the typed + bounded + backed-off form stays quiet
+    assert not any(f.line > 30 for f in fs if not f.suppressed)
+
+
+def test_serving_resilience_scoped_to_inference_paths():
+    src = ("def pump(engine):\n"
+           "    try:\n"
+           "        engine.step()\n"
+           "    except Exception:\n"
+           "        pass\n")
+    # outside inference/ other packages' broad excepts are not this
+    # rule's business...
+    assert analyze_source(src, "mymodel/train.py",
+                          axes=DEFAULT_AXES) == []
+    # ...inside it fires
+    flagged = analyze_source(src, "mymodel/inference/serve.py",
+                             axes=DEFAULT_AXES)
+    assert [f.rule for f in flagged] == ["serving-resilience"]
+
+
 def test_inference_package_self_gate():
     # the serving engine must pass the rule it motivated: every step
-    # array is packed to the fixed token budget, never len(requests)
+    # array is packed to the fixed token budget, never len(requests) —
+    # and the router must pass serving-resilience (typed excepts only,
+    # bounded backed-off retries)
     pkg = os.path.join(REPO, "neuronx_distributed_tpu", "inference")
     assert analyze_paths([pkg]) == []
 
@@ -240,7 +268,8 @@ def test_cli_nonzero_on_fixture_corpus():
                  for line in r.stdout.splitlines() if "[" in line}
     assert out_rules == {"mesh-axis", "trace-safety", "custom-vjp",
                          "recompile-hazard", "resilience",
-                         "comm-compression", "tp-overlap"}
+                         "comm-compression", "tp-overlap",
+                         "serving-resilience"}
 
 
 def test_cli_zero_on_clean_file():

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from flax.core import meta
 
 from neuronx_distributed_tpu.inference.engine import (EngineConfig,
+                                                      RequestRejected,
                                                       ServingEngine)
 from neuronx_distributed_tpu.inference.generation import generate
 from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
@@ -110,12 +111,16 @@ def test_oversize_request_rejected_at_submit(tiny_model):
     cfg, params = tiny_model
     eng = _engine(tiny_model)
     # needs more blocks than max_blocks_per_seq can ever map
-    uid = eng.submit(_prompt(9, 30, cfg.vocab_size), 10)
-    assert eng.results[uid].status == "rejected"
+    with pytest.raises(RequestRejected) as exc:
+        eng.submit(_prompt(9, 30, cfg.vocab_size), 10, uid="big")
+    assert exc.value.reason == "never_fits"
+    assert eng.results["big"].status == "rejected"
     assert eng.stats.rejected == 1
     assert not eng.has_work()
-    empty = eng.submit([], 4)
-    assert eng.results[empty].status == "rejected"
+    with pytest.raises(RequestRejected) as exc:
+        eng.submit([], 4, uid="empty")
+    assert exc.value.reason == "never_fits"
+    assert eng.results["empty"].status == "rejected"
 
 
 def test_preemption_restarts_and_completes(tiny_model):
@@ -220,3 +225,58 @@ def test_decode_buckets_share_one_compile(tiny_model):
     assert _jit_decode_scan(cfg, 16)._cache_size() == 1
     # the shorter run is a prefix of the longer (greedy, same prompt)
     assert np.asarray(a)[0].tolist() == np.asarray(b)[0, :5].tolist()
+
+
+def test_router_hooks_gauges_and_stats_to_dict(tiny_model):
+    cfg, params = tiny_model
+    eng = _engine(tiny_model)
+    assert eng.queue_depth() == 0
+    assert eng.pool_free_blocks() == eng.allocator.num_blocks
+    eng.submit(_prompt(16, 6, cfg.vocab_size), 4, uid="a")
+    assert eng.queue_depth() == 1
+    eng.step()
+    assert eng.pool_free_blocks() < eng.allocator.num_blocks
+    eng.run()
+    assert eng.queue_depth() == 0
+    d = eng.stats.to_dict()
+    for key in ("rejected", "resubmitted", "queue_depth", "completed",
+                "ttft_p99_ms"):
+        assert key in d
+    assert d["queue_depth"] == 0 and d["resubmitted"] == 0
+
+
+def test_drain_mode_rejects_but_keeps_stepping(tiny_model):
+    cfg, params = tiny_model
+    eng = _engine(tiny_model)
+    eng.submit(_prompt(17, 6, cfg.vocab_size), 4, uid="a")
+    eng.step()
+    eng.drain()
+    assert eng.draining
+    with pytest.raises(RequestRejected) as exc:
+        eng.submit(_prompt(18, 4, cfg.vocab_size), 4, uid="late")
+    assert exc.value.reason == "draining"
+    res = eng.run()  # in-flight work still finishes
+    assert res["a"].status == "completed" and len(res["a"].tokens) == 4
+
+
+def test_evict_returns_progress_and_frees_blocks(tiny_model):
+    cfg, params = tiny_model
+    prompt = _prompt(19, 6, cfg.vocab_size)
+    eng = _engine(tiny_model)
+    eng.submit(prompt, max_new_tokens=6, uid="a")
+    for _ in range(3):
+        eng.step()
+    assert eng.allocator.num_allocated > 0
+    got_prompt, got_gen = eng.evict("a")
+    assert got_prompt == prompt and len(got_gen) >= 1
+    assert eng.allocator.num_allocated == 0
+    assert eng.stats.resubmitted == 1
+    assert not eng.has_work() and "a" not in eng.results
+    with pytest.raises(KeyError):
+        eng.evict("a")
+    # a queued (never-admitted) request evicts with no generated tokens
+    eng2 = _engine(tiny_model)
+    eng2.submit(prompt, max_new_tokens=2, uid="q",
+                arrival_time=1e9)  # far future: stays queued
+    qp, qg = eng2.evict("q")
+    assert qp == prompt and qg == []

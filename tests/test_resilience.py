@@ -565,6 +565,39 @@ def test_watchdog_stall_timer():
     assert wd._stall_thread is None
 
 
+def test_spike_detector_unit():
+    """The factored z-score detector (shared by the training watchdog and
+    the serving router's replica monitor) fires on an outlier only after
+    min_steps of history, and clear() resets the window."""
+    from neuronx_distributed_tpu.resilience.watchdog import SpikeDetector
+
+    det = SpikeDetector(window=16, zscore=8.0, min_steps=4)
+    assert det.observe(100.0) is None  # huge, but no history yet
+    det.clear()
+    for _ in range(6):
+        assert det.observe(1.0) is None
+    hit = det.observe(100.0)
+    assert hit is not None
+    z, mean = hit
+    assert z > 8.0 and mean == pytest.approx(1.0)
+    assert det.spikes == 1
+    det.clear()
+    assert len(det) == 0 and det.observe(100.0) is None
+
+
+def test_stall_timer_observe_unit():
+    """StallTimer.observe (synchronous form used by the router) counts
+    overruns without any background thread."""
+    from neuronx_distributed_tpu.resilience.watchdog import StallTimer
+
+    timer = StallTimer(timeout_s=0.5)
+    assert not timer.observe(0.1)
+    assert timer.observe(0.9)
+    assert not timer.observe(0.2)
+    assert timer.stalls == 1
+    assert timer.thread is None or not timer.thread.is_alive()
+
+
 def test_loader_stall_raises(tmp_path, monkeypatch):
     """A wedged producer surfaces as DataLoaderStallError instead of a
     silent hang (resilience stall contract for data/native_loader)."""

@@ -1,0 +1,262 @@
+"""ReplicaRouter tests: deterministic chaos failover (bit-identical
+greedy recovery, zero lost requests), tenant fairness under throttling,
+the overload degradation ladder, and graceful drain on SIGTERM."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+
+from neuronx_distributed_tpu.inference.engine import (EngineConfig,
+                                                      RequestRejected,
+                                                      ServingEngine)
+from neuronx_distributed_tpu.inference.router import (ReplicaRouter,
+                                                      RouterConfig,
+                                                      ServingPreempted,
+                                                      TenantPolicy,
+                                                      chaos_drill)
+from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                  tiny_config)
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.resilience.chaos import FaultPlan
+from neuronx_distributed_tpu.resilience.preemption import (EXIT_PREEMPTED,
+                                                           PreemptionGuard)
+
+
+@pytest.fixture
+def tiny_model():
+    ps.initialize_model_parallel()
+    cfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                      num_layers=2)
+    params = meta.unbox(LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    return cfg, params
+
+
+def _ecfg(**kw):
+    base = dict(block_size=4, num_blocks=16, max_slots=2,
+                max_blocks_per_seq=8, token_budget=8,
+                kv_dtype=jnp.float32)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _router(tiny_model, rcfg=None, **kw):
+    cfg, params = tiny_model
+    return ReplicaRouter(cfg, params, _ecfg(),
+                         rcfg or RouterConfig(num_replicas=2), **kw)
+
+
+def _prompts(cfg, n, length=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size, (length,)).tolist()
+            for _ in range(n)]
+
+
+def test_failover_drill_bit_identical(tiny_model):
+    """Acceptance: FaultPlan kills replica r1 mid-decode; every admitted
+    request completes with greedy tokens bit-identical to the fault-free
+    single-replica run, zero requests lost, and the resubmitted-token
+    cost is reported."""
+    cfg, params = tiny_model
+    m = chaos_drill(cfg, params, _ecfg(),
+                    plan_spec="step|r1 : crash, after=3, times=1")
+    assert m["router_availability"] == 1.0
+    assert m["router_completed"] == m["router_admitted"]
+    assert m["router_failovers"] >= 1
+    assert m["router_greedy_match_ref"] == 1.0
+    assert m["router_resubmitted_tokens"] > 0
+    assert m["router_resubmits"] >= 1
+
+
+def test_failover_survivor_compiles_once(tiny_model):
+    cfg, params = tiny_model
+    router = _router(tiny_model,
+                     chaos=FaultPlan.parse(
+                         "step|r1 : crash, after=2, times=1"))
+    for i, p in enumerate(_prompts(cfg, 5)):
+        router.submit(p, 4, uid=f"req{i}")
+    res = router.run()
+    assert all(r.status == "completed" for r in res.values())
+    r0 = router.replicas[0]
+    assert r0.state == "up" and r0.engine.compile_count() == 1
+    assert router.stats.failovers == 1
+    assert router.stats.revivals == 1  # r1 came back after probation
+
+
+def test_latency_spike_trips_breaker_virtually(tiny_model):
+    """Chaos-injected virtual latency (no real sleeping) trips the
+    z-score spike detector and the requests fail over."""
+    cfg, params = tiny_model
+    rcfg = RouterConfig(num_replicas=2, latency_zscore=8.0,
+                        latency_min_steps=4, probation_steps=4)
+    plan = FaultPlan.parse("step|r0 : latency=30.0, after=6, times=1")
+    router = _router(tiny_model, rcfg, chaos=plan)
+    for i, p in enumerate(_prompts(cfg, 6, seed=1)):
+        router.submit(p, 6, uid=f"req{i}")
+    res = router.run()
+    assert all(r.status == "completed" for r in res.values())
+    assert router.stats.failovers >= 1
+    assert plan.fire_count() == 1
+
+
+def test_exhaust_storm_trips_breaker(tiny_model):
+    cfg, params = tiny_model
+    rcfg = RouterConfig(num_replicas=2, exhaust_threshold=2,
+                        exhaust_window=4, probation_steps=4)
+    plan = FaultPlan.parse("step|r0 : exhaust, after=2, times=3")
+    router = _router(tiny_model, rcfg, chaos=plan)
+    for i, p in enumerate(_prompts(cfg, 4, seed=2)):
+        router.submit(p, 4, uid=f"req{i}")
+    res = router.run()
+    assert all(r.status == "completed" for r in res.values())
+    assert router.stats.failovers >= 1
+
+
+def test_tenant_throttling_never_starves_others(tiny_model):
+    """A tenant with an empty token bucket is rejected with
+    tenant_throttled while other tenants' requests all complete."""
+    cfg, params = tiny_model
+    rcfg = RouterConfig(
+        num_replicas=2,
+        tenants={"noisy": TenantPolicy(rate_tokens_per_s=0.0,
+                                       burst_tokens=12.0, priority=1),
+                 "good": TenantPolicy(priority=1)})
+    router = _router(tiny_model, rcfg)
+    prompts = _prompts(cfg, 8, seed=3)
+    throttled = 0
+    for i, p in enumerate(prompts):
+        tenant = "noisy" if i % 2 == 0 else "good"
+        try:
+            router.submit(p, 4, tenant=tenant, uid=f"req{i}")
+        except RequestRejected as exc:
+            assert exc.reason == "tenant_throttled"
+            assert tenant == "noisy"
+            throttled += 1
+    # burst of 12 admits exactly one 10-token noisy request
+    assert throttled == 3
+    res = router.run()
+    good = [r for r in res.values()
+            if r.tenant == "good" and r.status == "completed"]
+    assert len(good) == 4  # the throttled tenant never starved the rest
+    assert router.stats.rejected_by_reason["tenant_throttled"] == 3
+
+
+def test_overload_ladder_degrades_then_sheds(tiny_model):
+    cfg, params = tiny_model
+    rcfg = RouterConfig(
+        num_replicas=2, global_token_budget=40,
+        degrade_threshold=0.55, shed_threshold=0.8, degrade_max_new=2,
+        tenants={"vip": TenantPolicy(priority=2),
+                 "cheap": TenantPolicy(priority=1)})
+    router = _router(tiny_model, rcfg)
+    prompts = _prompts(cfg, 6, seed=4)
+    # load 10/40 then 20/40: admitted as-is (0.5 < degrade 0.55)
+    router.submit(prompts[0], 4, tenant="vip", uid="a")
+    router.submit(prompts[1], 4, tenant="cheap", uid="b")
+    # load would be 30/40 = 0.75 >= degrade: max_new capped at 2
+    router.submit(prompts[2], 4, tenant="vip", uid="c")
+    # >= shed 0.8: lowest-priority tenant is shed first...
+    with pytest.raises(RequestRejected) as exc:
+        router.submit(prompts[3], 4, tenant="cheap", uid="d")
+    assert exc.value.reason == "over_budget"
+    assert router.stats.tenant_shed["cheap"] == 1
+    # ...while the vip tenant still degrades through
+    router.submit(prompts[4], 4, tenant="vip", uid="e")
+    # hard budget: even vip rejects once load would exceed 1.0
+    # (6 + 26 = 32 tokens fits a replica alone, but not the budget)
+    with pytest.raises(RequestRejected) as exc:
+        router.submit(prompts[5], 26, tenant="vip", uid="f")
+    assert exc.value.reason == "over_budget"
+    res = router.run()
+    assert res["c"].degraded and len(res["c"].tokens) == 2
+    assert res["e"].degraded and len(res["e"].tokens) == 2
+    assert res["a"].status == "completed" and len(res["a"].tokens) == 4
+    assert router.stats.degraded == 2
+
+
+def test_never_fits_rejected_at_router(tiny_model):
+    router = _router(tiny_model)
+    with pytest.raises(RequestRejected) as exc:
+        router.submit([1] * 40, 40, uid="huge")
+    assert exc.value.reason == "never_fits"
+    assert router.results["huge"].status == "rejected"
+    assert not router.has_work()
+
+
+def test_session_affinity_sticks_while_healthy(tiny_model):
+    cfg, params = tiny_model
+    router = _router(tiny_model)
+    prompts = _prompts(cfg, 4, seed=5)
+    for i, p in enumerate(prompts):
+        router.submit(p, 4, uid=f"req{i}", session="sess-1")
+    res = router.run()
+    replicas = {r.replica for r in res.values()}
+    assert len(replicas) == 1  # all on the session's replica
+
+
+def test_drain_on_sigterm_finishes_in_flight(tiny_model):
+    """SIGTERM flips the router to drain: new submits reject with
+    reason=draining, in-flight requests complete, and run() exits 75
+    via ServingPreempted carrying the results."""
+    cfg, params = tiny_model
+    guard = PreemptionGuard(grace_s=60.0).install()
+    try:
+        router = _router(tiny_model, preemption_guard=guard)
+        prompts = _prompts(cfg, 3, seed=6)
+        for i, p in enumerate(prompts[:2]):
+            router.submit(p, 4, uid=f"req{i}")
+        router.step()
+        os.kill(os.getpid(), signal.SIGTERM)
+        router.step()  # observes the guard, begins draining
+        assert router.draining
+        with pytest.raises(RequestRejected) as exc:
+            router.submit(prompts[2], 4, uid="late")
+        assert exc.value.reason == "draining"
+        with pytest.raises(ServingPreempted) as exits:
+            router.run()
+        assert exits.value.code == EXIT_PREEMPTED
+        results = exits.value.results
+        assert results["req0"].status == "completed"
+        assert results["req1"].status == "completed"
+        assert len(results["req0"].tokens) == 4
+    finally:
+        guard.uninstall()
+
+
+def test_bounded_retries_fail_request(tiny_model):
+    """A request whose replica keeps dying exhausts max_retries and is
+    reported failed, not retried forever."""
+    cfg, params = tiny_model
+    rcfg = RouterConfig(num_replicas=1, max_retries=2, probation_steps=1,
+                        probation_ok_steps=1, backoff_base_s=0.0)
+    plan = FaultPlan.parse("step|r0 : crash")  # every step, forever
+    router = _router(tiny_model, rcfg, chaos=plan)
+    router.submit(_prompts(cfg, 1, seed=7)[0], 4, uid="doomed")
+    res = router.run()
+    assert res["doomed"].status == "failed"
+    assert res["doomed"].reason == "max_retries"
+    assert res["doomed"].resubmits == rcfg.max_retries
+    assert router.stats.failed == 1 and router.stats.availability() == 0.0
+
+
+def test_router_stats_to_dict(tiny_model):
+    cfg, params = tiny_model
+    router = _router(tiny_model)
+    for i, p in enumerate(_prompts(cfg, 2, seed=8)):
+        router.submit(p, 4, uid=f"req{i}")
+    router.run()
+    d = router.stats.to_dict()
+    for key in ("availability", "failovers", "resubmits",
+                "resubmitted_tokens", "tenant_shed", "ttft_p99_ms",
+                "rejected_by_reason"):
+        assert key in d
+    assert d["availability"] == 1.0 and d["completed"] == 2
+    # engine stats compose with router stats
+    eng = router.replicas[0].engine
+    assert "queue_depth" in eng.stats.to_dict()
