@@ -1,0 +1,97 @@
+"""Rule ``paging-refcount``: block-pool bookkeeping stays in ``paging.py``.
+
+With copy-on-write prefix sharing, correctness of the paged pool rests on
+two invariants that only hold while every mutation goes through
+``BlockAllocator`` / the engine's table plumbing (``docs/serving.md``):
+
+* the allocator's free list and refcounts (``_free`` / ``_allocated`` /
+  ``_refs``) agree with each other — a block is either on the free list
+  or refcounted, never both. Code that appends to ``alloc._free`` or pokes
+  ``alloc._refs[b]`` directly can double-free a block that another
+  sequence still shares, silently cross-contaminating KV.
+* ``block_tables`` rows are remapped only by the engine's admit / COW /
+  release paths, which keep host mirrors, freed-position hygiene and the
+  prefix trie in sync. A stray ``cache.block_tables.at[i].set(...)`` (or
+  ``tables[i] = ...`` on the attribute) bypasses all three.
+
+Everything outside ``inference/paging.py`` must use the public API:
+``alloc()`` / ``ref()`` / ``free()`` and ``PagedKVCache.replace(...)``
+fed from the engine's host tables.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Iterator, List
+
+from .core import Finding, LintContext, register
+
+_ALLOC_PRIVATE = ("_free", "_allocated", "_refs")
+_MUTATORS = ("append", "pop", "remove", "extend", "insert", "clear",
+             "update", "discard", "add", "setdefault", "popitem")
+_AT_WRITES = ("set", "add", "multiply", "mul", "divide", "div", "power",
+              "min", "max", "apply")
+
+
+def _is_paging_module(path: str) -> bool:
+    parts = pathlib.PurePath(path).parts
+    return parts[-2:] == ("inference", "paging.py")
+
+
+def _attr_named(node, names) -> bool:
+    return isinstance(node, ast.Attribute) and node.attr in names
+
+
+def _block_tables_at_chain(call: ast.Call) -> bool:
+    """``<x>.block_tables.at[...].set(...)`` (or any ``.at`` write op)."""
+    f = call.func
+    return (_attr_named(f, _AT_WRITES)
+            and isinstance(f.value, ast.Subscript)
+            and _attr_named(f.value.value, ("at",))
+            and _attr_named(f.value.value.value, ("block_tables",)))
+
+
+def _targets(node) -> List[ast.expr]:
+    if isinstance(node, ast.Assign):
+        return list(node.targets)
+    return [node.target]                                 # AugAssign
+
+
+@register(
+    "paging-refcount",
+    "direct free-list/refcount (_free/_allocated/_refs) or block_tables "
+    "mutation outside inference/paging.py (bypasses the refcounted "
+    "allocator + COW invariants and can cross-contaminate shared KV)")
+def check(ctx: LintContext) -> Iterator[Finding]:
+    if _is_paging_module(ctx.path):
+        return
+    findings: List[Finding] = []
+
+    def flag(node, what: str) -> None:
+        findings.append(Finding(
+            ctx.path, node.lineno, node.col_offset, "paging-refcount",
+            f"{what} — block-pool bookkeeping belongs to "
+            "inference/paging.py; go through BlockAllocator "
+            "(alloc/ref/free) or the engine's table plumbing"))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            for tgt in _targets(node):
+                if (isinstance(tgt, ast.Subscript)
+                        and _attr_named(tgt.value,
+                                        _ALLOC_PRIVATE + ("block_tables",))):
+                    flag(node, f"direct item assignment into "
+                         f"`.{tgt.value.attr}`")
+                elif _attr_named(tgt, _ALLOC_PRIVATE + ("block_tables",)):
+                    flag(node, f"direct rebind of `.{tgt.attr}`")
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if _block_tables_at_chain(node):
+                flag(node, "in-place `.at[...]` write on `.block_tables`")
+            elif (_attr_named(f, _MUTATORS)
+                    and _attr_named(f.value, _ALLOC_PRIVATE)):
+                flag(node, f"mutating call "
+                     f"`.{f.value.attr}.{f.attr}(...)` on allocator "
+                     "internals")
+    yield from findings

@@ -28,10 +28,11 @@ from .generation import (DECODE_BUCKETS, decode_step, generate, pick_bucket,
 from .kv_cache import KVCache, init_kv_cache
 from .model_builder import (ModelBuilder, NxDModel, bundle_generate,
                             bundle_speculative_generate, generate_buckets,
+                            register_serving_workers, serving_state_spec,
                             shard_checkpoint)
 from .paging import (BlockAllocator, CacheExhaustedError, PagedKVCache,
-                     QuantizedPagedKVCache, init_paged_kv_cache,
-                     init_quantized_paged_kv_cache)
+                     PrefixCache, QuantizedPagedKVCache, cow_copy_blocks,
+                     init_paged_kv_cache, init_quantized_paged_kv_cache)
 from .router import (ReplicaRouter, RouterConfig, RouterResult, RouterStats,
                      ServingPreempted, TenantPolicy)
 from .sampling import SamplingConfig, sample
@@ -43,13 +44,14 @@ __all__ = [
     "DECODE_BUCKETS", "decode_step", "generate", "pick_bucket", "prefill",
     "KVCache", "init_kv_cache",
     "BlockAllocator", "CacheExhaustedError", "PagedKVCache",
-    "QuantizedPagedKVCache", "init_paged_kv_cache",
-    "init_quantized_paged_kv_cache",
+    "PrefixCache", "QuantizedPagedKVCache", "cow_copy_blocks",
+    "init_paged_kv_cache", "init_quantized_paged_kv_cache",
     "ServingEngine", "EngineConfig", "EngineStats", "RequestRejected",
     "RequestResult",
     "ReplicaRouter", "RouterConfig", "RouterResult", "RouterStats",
     "ServingPreempted", "TenantPolicy",
     "ModelBuilder", "NxDModel", "generate_buckets", "shard_checkpoint",
+    "register_serving_workers", "serving_state_spec",
     "bundle_generate", "bundle_speculative_generate",
     "make_speculation_round_fn",
     "SamplingConfig", "sample",

@@ -28,7 +28,8 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
+from typing import (Any, Callable, Deque, Dict, List, Optional, Sequence,
+                    Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -36,8 +37,9 @@ import numpy as np
 
 from ..models.llama import LlamaConfig, llama_forward_with_cache
 from .kv_cache import PAD_POSITION
-from .paging import (BlockAllocator, CacheExhaustedError,
-                     init_paged_kv_cache, init_quantized_paged_kv_cache)
+from .paging import (BlockAllocator, CacheExhaustedError, PrefixCache,
+                     cow_copy_blocks, init_paged_kv_cache,
+                     init_quantized_paged_kv_cache)
 from .sampling import SamplingConfig, sample
 
 
@@ -72,6 +74,16 @@ class EngineConfig:
     kv_dtype: Any = None            # None -> model dtype (fp pool only)
     eos_id: Optional[int] = None
     sampling: SamplingConfig = SamplingConfig(greedy=True)
+    # prefix sharing: full prompt blocks are published to a trie so later
+    # requests map them (refcounted, copy-on-write) instead of
+    # re-prefilling. Off by default: the trie deliberately keeps blocks
+    # allocated past request retirement.
+    prefix_sharing: bool = False
+    # disaggregation: prefill and decode run as two separately compiled
+    # workers (decode width = max_slots, prefill width = prefill_budget
+    # or token_budget) handing KV off through the shared pool.
+    disaggregated: bool = False
+    prefill_budget: Optional[int] = None
 
 
 class RequestRejected(RuntimeError):
@@ -107,6 +119,10 @@ class _RequestState:
     n_cached: int = 0               # tokens whose K/V are in the pool
     first_token_time: Optional[float] = None
     admit_seq: int = -1             # admission order, for preemption choice
+    shared_tokens: int = 0          # prompt tokens mapped from the trie
+    chain: Optional[int] = None     # trie chain hash for continued insert
+    trie_blocks: int = 0            # prompt blocks walked/inserted so far
+    trie_dead: bool = False         # stop inserting (collision/eviction)
 
     @property
     def prompt_len(self) -> int:
@@ -126,6 +142,10 @@ class _RequestState:
         self.slot = None
         self.n_cached = 0
         self.first_token_time = None
+        self.shared_tokens = 0
+        self.chain = None
+        self.trie_blocks = 0
+        self.trie_dead = False
 
 
 @dataclasses.dataclass
@@ -147,9 +167,13 @@ class EngineStats:
     resubmitted: int = 0            # evicted for resubmission elsewhere
     queue_depth: int = 0            # gauge: live requests right now
     tokens_generated: int = 0
+    cow_copies: int = 0             # shared blocks cloned before a write
+    prefix_hit_tokens: int = 0      # prompt tokens mapped from the trie
+    prefill_tokens: int = 0         # prompt tokens actually computed
     ttft_s: List[float] = dataclasses.field(default_factory=list)
     step_latency_s: List[float] = dataclasses.field(default_factory=list)
     occupancy: List[float] = dataclasses.field(default_factory=list)
+    shared_fraction: List[float] = dataclasses.field(default_factory=list)
     first_step_t: Optional[float] = None
     last_step_t: Optional[float] = None
 
@@ -172,6 +196,12 @@ class EngineStats:
             "step_latency_p99_ms": float(np.percentile(lat, 99)) * 1e3,
             "pool_occupancy_mean": (float(np.mean(self.occupancy))
                                     if self.occupancy else 0.0),
+            "prefix_hit_rate": (
+                self.prefix_hit_tokens
+                / max(1, self.prefix_hit_tokens + self.prefill_tokens)),
+            "shared_block_fraction": (float(np.mean(self.shared_fraction))
+                                      if self.shared_fraction else 0.0),
+            "cow_copies": self.cow_copies,
         }
 
     def to_dict(self) -> Dict[str, float]:
@@ -214,8 +244,20 @@ class ServingEngine:
         self._uid_counter = 0
         self._draining = False
         self._freed_dirty: set = set()  # freed blocks with stale positions
+        self._pending_cow: List[Tuple[int, int, int]] = []  # (src, dst, keep)
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.allocator, engine_cfg.block_size)
+            if engine_cfg.prefix_sharing else None)
         self.cache = self._init_cache()
-        self._step_fn = self._build_step()
+        if engine_cfg.disaggregated:
+            # two workers, two jit instances: each sees exactly one input
+            # shape, so each compiles exactly once
+            self._step_fn = None
+            self._prefill_fn = self._build_step()
+            self._decode_fn = self._build_step()
+        else:
+            self._step_fn = self._build_step()
+            self._prefill_fn = self._decode_fn = None
 
     # -- construction -----------------------------------------------------
 
@@ -242,6 +284,7 @@ class ServingEngine:
                 ps.get_mesh(), jax.sharding.PartitionSpec())
         else:
             sharding = jax.devices()[0]
+        self._sharding = sharding
         return jax.device_put(cache, sharding)
 
     def _build_step(self):
@@ -259,13 +302,24 @@ class ServingEngine:
         donate = (1,) if jax.default_backend() in ("tpu", "axon") else ()
         return jax.jit(step_fn, donate_argnums=donate)
 
+    def worker_compile_counts(self) -> Dict[str, int]:
+        """Per-worker compile counts: ``{"packed": n}`` or, when
+        disaggregated, ``{"prefill": n, "decode": n}``."""
+        def size(fn):
+            try:
+                return int(fn._cache_size())
+            except Exception:  # pragma: no cover - jit internals moved
+                return -1
+        if self.ecfg.disaggregated:
+            return {"prefill": size(self._prefill_fn),
+                    "decode": size(self._decode_fn)}
+        return {"packed": size(self._step_fn)}
+
     def compile_count(self) -> int:
         """Number of distinct compilations of the serving step (the
-        no-recompile invariant: stays 1 as the live-request mix varies)."""
-        try:
-            return int(self._step_fn._cache_size())
-        except Exception:  # pragma: no cover - jit internals moved
-            return -1
+        no-recompile invariant: stays 1 per worker as the live-request
+        mix — and the prefix-hit rate — varies)."""
+        return max(self.worker_compile_counts().values())
 
     # -- public API -------------------------------------------------------
 
@@ -332,6 +386,24 @@ class ServingEngine:
         """Unallocated KV blocks in the pool (occupancy = 1 - free/total)."""
         return self.allocator.num_blocks - self.allocator.num_allocated
 
+    def prefix_lookup(self, prompt: Sequence[int]) -> int:
+        """How many tokens of ``prompt`` this engine's prefix cache
+        already holds (0 without ``prefix_sharing``) — the router's
+        prefix-locality placement and admission-credit signal. Capped at
+        ``len(prompt) - 1``: the last prompt row always runs so the
+        request produces logits."""
+        if self.prefix_cache is None or len(prompt) <= 1:
+            return 0
+        return self.prefix_cache.lookup([int(t) for t in prompt],
+                                        len(prompt) - 1)
+
+    def release_prefix_cache(self) -> None:
+        """Drop the trie's own block references (blocks that live slots
+        still map stay allocated); blocks that actually free get the
+        usual stale-position hygiene on the next step."""
+        if self.prefix_cache is not None:
+            self._freed_dirty.update(self.prefix_cache.clear())
+
     @property
     def draining(self) -> bool:
         return self._draining
@@ -393,21 +465,84 @@ class ServingEngine:
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
             self._slots[slot] = req
+            self._apply_prefix(req)
+
+    def _apply_prefix(self, req: _RequestState) -> None:
+        """Map the longest cached prefix of the prompt into the slot's
+        table — one allocator ref per mapped block, no prefill work —
+        capped at ``prompt_len - 1`` so at least one prompt row runs and
+        produces logits. A partial-tail match maps a donor block whose
+        first ``m`` tokens we share; our first divergent write into it
+        triggers copy-on-write (:meth:`_ensure_block`)."""
+        req.chain = None
+        req.trie_blocks = 0
+        req.trie_dead = False
+        if self.prefix_cache is None or req.n_cached:
+            return
+        full, matched, partial, chain = self.prefix_cache.match(
+            req.prompt, req.prompt_len - 1)
+        for i, blk in enumerate(full):
+            self.allocator.ref(blk)
+            self._tables[req.slot, i] = blk
+            self._slot_blocks[req.slot].append(blk)
+        req.chain = chain
+        req.trie_blocks = len(full)
+        req.n_cached = matched
+        if partial is not None:
+            blk, m = partial
+            self.allocator.ref(blk)
+            self._tables[req.slot, len(full)] = blk
+            self._slot_blocks[req.slot].append(blk)
+            req.n_cached += m
+        req.shared_tokens = req.n_cached
+        self.stats.prefix_hit_tokens += req.n_cached
+
+    def _alloc_blocks(self, n: int) -> List[int]:
+        """Pool allocation with prefix-cache relief: before giving up,
+        evict least-recently-matched cached prefixes until enough blocks
+        actually free (the caller's preemption path handles the rest)."""
+        try:
+            return self.allocator.alloc(n)
+        except CacheExhaustedError:
+            if self.prefix_cache is None or self.prefix_cache.size == 0:
+                raise
+            self._freed_dirty.update(
+                self.prefix_cache.evict(n - self.allocator.num_free))
+            return self.allocator.alloc(n)
 
     def _ensure_block(self, req: _RequestState, position: int) -> None:
         """Map the block covering ``position`` into the slot's table,
-        allocating from the pool (raises CacheExhaustedError dry)."""
+        allocating from the pool (raises CacheExhaustedError dry). A
+        write landing in a block other owners also reference clones it
+        first (copy-on-write): the clone replaces the shared block in
+        this slot's table and the copy itself runs as a fixed-shape
+        jitted pass at the next step boundary."""
         blk_i = position // self.ecfg.block_size
-        if self._tables[req.slot, blk_i] >= 0:
+        cur = int(self._tables[req.slot, blk_i])
+        if cur >= 0:
+            if self.allocator.refcount(cur) <= 1:
+                return
+            dst = self._alloc_blocks(1)[0]
+            self._pending_cow.append((cur, dst, position))
+            # dst's stale positions are fully overwritten by the copy;
+            # exempt it from the freed-position wipe that runs after
+            self._freed_dirty.discard(dst)
+            self._tables[req.slot, blk_i] = dst
+            sb = self._slot_blocks[req.slot]
+            sb[sb.index(cur)] = dst
+            self._freed_dirty.update(self.allocator.free([cur]))
+            self.stats.cow_copies += 1
             return
-        blk = self.allocator.alloc(1)[0]
+        blk = self._alloc_blocks(1)[0]
         self._tables[req.slot, blk_i] = blk
         self._slot_blocks[req.slot].append(blk)
 
     def _release(self, req: _RequestState) -> None:
         slot = req.slot
-        self._freed_dirty.update(self._slot_blocks[slot])
-        self.allocator.free(self._slot_blocks[slot])
+        # only blocks whose last reference dropped get their positions
+        # wiped — clearing a still-shared block would blind its sharers
+        self._freed_dirty.update(
+            self.allocator.free(self._slot_blocks[slot]))
         self._slot_blocks[slot] = []
         self._tables[slot, :] = -1
         self._slots[slot] = None
@@ -431,29 +566,41 @@ class ServingEngine:
 
     def _build_schedule(self):
         """Pack this step's rows: (req, token, position, produce) — one
-        decode row per decoding slot, then prefill chunks into the
-        remaining budget. Preempts (youngest first) when a decode row
-        can't get its next block; prefill chunks merely truncate."""
-        budget = self.ecfg.token_budget
+        decode row per decoding slot, then prefill chunks. Preempts
+        (youngest first) when a decode row can't get its next block;
+        prefill chunks merely truncate. Returns ``(decode_rows,
+        prefill_rows)``: packed mode shares one ``token_budget`` across
+        both lists; disaggregated mode gives each worker its own width
+        (decode = ``max_slots``, prefill = ``prefill_budget``)."""
+        e = self.ecfg
+        if e.disaggregated:
+            decode_budget = e.max_slots
+            prefill_budget = e.prefill_budget or e.token_budget
+            shared_budget = False
+        else:
+            decode_budget = prefill_budget = e.token_budget
+            shared_budget = True
         while True:
             try:
-                rows = []
+                decode_rows = []
                 for req in sorted(
                         (s for s in self._slots
                          if s is not None and s.decoding),
                         key=lambda r: r.admit_seq):
-                    if len(rows) >= budget:
+                    if len(decode_rows) >= decode_budget:
                         break
                     pos = req.n_cached
                     self._ensure_block(req, pos)
-                    rows.append((req, req.tokens[pos], pos, True))
+                    decode_rows.append((req, req.tokens[pos], pos, True))
                 break
             except CacheExhaustedError:
                 self._preempt_youngest(req)
+        prefill_rows = []
+        used = len(decode_rows) if shared_budget else 0
         for req in sorted((s for s in self._slots
                            if s is not None and not s.decoding),
                           key=lambda r: r.admit_seq):
-            room = budget - len(rows)
+            room = prefill_budget - used - len(prefill_rows)
             if room <= 0:
                 break
             chunk = min(room, req.prompt_len - req.n_cached)
@@ -465,44 +612,116 @@ class ServingEngine:
                     chunk = i  # defer the rest of this prompt
                     break
                 produce = (pos == req.prompt_len - 1)
-                rows.append((req, req.prompt[pos], pos, produce))
+                prefill_rows.append((req, req.prompt[pos], pos, produce))
             req.n_cached += chunk
-        return rows
+            self.stats.prefill_tokens += chunk
+        return decode_rows, prefill_rows
+
+    def _apply_pending_cow(self) -> None:
+        """Run the copy-on-write clones registered during scheduling as
+        fixed-shape ``[max_slots]`` batches (pad entries: dst ==
+        num_blocks, dropped). Must run *before* the freed-position wipe:
+        a COW source freed in this same scheduling pass still needs its
+        positions readable for the clone."""
+        if not self._pending_cow:
+            return
+        m = self.ecfg.max_slots
+        for start in range(0, len(self._pending_cow), m):
+            chunk = self._pending_cow[start:start + m]
+            src = np.zeros((m,), np.int32)
+            dst = np.full((m,), self.ecfg.num_blocks, np.int32)
+            keep = np.zeros((m,), np.int32)
+            for i, (s, d, k) in enumerate(chunk):
+                src[i], dst[i], keep[i] = s, d, k
+            self.cache = cow_copy_blocks(
+                self.cache, jnp.asarray(src), jnp.asarray(dst),
+                jnp.asarray(keep))
+        self._pending_cow.clear()
+
+    def _run_worker(self, fn, rows, width: int, rng):
+        """Pack ``rows`` into a fixed ``width`` batch and run one jitted
+        worker; returns per-row sampled tokens (aligned with ``rows``)."""
+        tokens = np.zeros((1, width), np.int32)
+        positions = np.full((1, width), PAD_POSITION, np.int32)
+        slot_ids = np.full((width,), self.ecfg.max_slots, np.int32)
+        for i, (req, tok, pos, _) in enumerate(rows):
+            tokens[0, i] = tok
+            positions[0, i] = pos
+            slot_ids[i] = req.slot
+        sampled, self.cache = fn(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(slot_ids), rng)
+        return np.asarray(sampled)
+
+    def _maybe_insert_prefix(self, req: _RequestState) -> None:
+        """Publish this request's fully-written prompt blocks into the
+        trie (post-step: the pool rows exist now). Stops for good on a
+        hash collision or an evicted parent chain."""
+        if self.prefix_cache is None or req.trie_dead:
+            return
+        bs = self.ecfg.block_size
+        target = min(req.n_cached, req.prompt_len) // bs
+        while req.trie_blocks < target:
+            i = req.trie_blocks
+            chain, _ = self.prefix_cache.insert(
+                req.chain, req.prompt[i * bs:(i + 1) * bs],
+                int(self._tables[req.slot, i]))
+            if chain is None:
+                req.trie_dead = True
+                return
+            req.chain = chain
+            req.trie_blocks += 1
 
     def step(self) -> int:
-        """One fixed-shape serving step. Returns the number of live rows
-        packed (0 = nothing was runnable)."""
+        """One serving step. Returns the number of live rows packed
+        (0 = nothing was runnable). Packed mode runs one fixed-shape
+        worker; disaggregated mode runs the prefill worker then the
+        decode worker — the KV handoff between them is the shared block
+        pool itself (table-row surgery, no tensor copies)."""
         self._admit()
-        rows = self._build_schedule()
+        decode_rows, prefill_rows = self._build_schedule()
+        rows = decode_rows + prefill_rows
         if not rows:
             return 0
         t_start = self._now()
         if self.stats.first_step_t is None:
             self.stats.first_step_t = t_start
-        budget = self.ecfg.token_budget
-        tokens = np.zeros((1, budget), np.int32)
-        positions = np.full((1, budget), PAD_POSITION, np.int32)
-        slot_ids = np.full((budget,), self.ecfg.max_slots, np.int32)
-        for i, (req, tok, pos, _) in enumerate(rows):
-            tokens[0, i] = tok
-            positions[0, i] = pos
-            slot_ids[i] = req.slot
+        self._apply_pending_cow()
         if self._freed_dirty:
             mask = np.zeros((self.ecfg.num_blocks,), np.bool_)
             mask[list(self._freed_dirty)] = True
             self._freed_dirty.clear()
             self.cache = self.cache.replace(pos=_clear_freed_positions(
                 self.cache.pos, jnp.asarray(mask)))
+        # committed to the cache's sharding: the disaggregated decode
+        # worker otherwise sees two sharding keys for its cache operand
+        # (prefill's committed output vs a fresh uncommitted replace)
+        # and compiles twice
         self.cache = self.cache.replace(
-            block_tables=jnp.asarray(self._tables),
-            lengths=jnp.asarray(
+            block_tables=jax.device_put(jnp.asarray(self._tables),
+                                        self._sharding),
+            lengths=jax.device_put(jnp.asarray(
                 np.asarray([0 if s is None else s.n_cached
-                            for s in self._slots], np.int32)))
+                            for s in self._slots], np.int32)),
+                self._sharding))
         self._rng, sub = jax.random.split(self._rng)
-        sampled, self.cache = self._step_fn(
-            self.params, self.cache, jnp.asarray(tokens),
-            jnp.asarray(positions), jnp.asarray(slot_ids), sub)
-        sampled = np.asarray(sampled)
+        if self.ecfg.disaggregated:
+            sampled = np.zeros((len(rows),), np.int32)
+            if prefill_rows:          # prefill first: TTFT, and new KV
+                sampled[len(decode_rows):] = self._run_worker(
+                    self._prefill_fn, prefill_rows,
+                    self.ecfg.prefill_budget or self.ecfg.token_budget,
+                    sub)[:len(prefill_rows)]
+            if decode_rows:           # ... lands before decode reads
+                sampled[:len(decode_rows)] = self._run_worker(
+                    self._decode_fn, decode_rows, self.ecfg.max_slots,
+                    sub)[:len(decode_rows)]
+        else:
+            sampled = self._run_worker(
+                self._step_fn, rows, self.ecfg.token_budget, sub)
+        if self.prefix_cache is not None and prefill_rows:
+            for req in {id(r[0]): r[0] for r in prefill_rows}.values():
+                self._maybe_insert_prefix(req)
 
         now = self._now()
         for i, (req, _, pos, produce) in enumerate(rows):
@@ -525,6 +744,9 @@ class ServingEngine:
         self.stats.last_step_t = now
         self.stats.occupancy.append(
             self.allocator.num_allocated / self.ecfg.num_blocks)
+        self.stats.shared_fraction.append(
+            self.allocator.num_shared
+            / max(1, self.allocator.num_allocated))
         self.stats.queue_depth = self.queue_depth()
         return len(rows)
 

@@ -655,6 +655,74 @@ def bundle_speculative_generate(model: "NxDModel", input_ids, prompt_len,
     return out[:, :max_new_tokens]
 
 
+def serving_state_spec(model_cfg, engine_cfg) -> Dict[str, Any]:
+    """The ``state_spec`` describing a :class:`~.engine.ServingEngine`'s
+    paged block pool, for ``NxDModel.save(state_spec=...)`` — one source
+    of truth so a bundle's :meth:`NxDModel.init_state` rebuilds exactly
+    the pool the engine served from (``kind: "paged"``, optionally
+    ``quantized``)."""
+    spec: Dict[str, Any] = {
+        "kind": "paged",
+        "num_layers": model_cfg.num_layers,
+        "num_blocks": engine_cfg.num_blocks,
+        "block_size": engine_cfg.block_size,
+        "num_kv_heads": model_cfg.num_kv_heads,
+        "head_dim": model_cfg.head_dim_,
+        "max_slots": engine_cfg.max_slots,
+        "max_blocks_per_seq": engine_cfg.max_blocks_per_seq,
+    }
+    if engine_cfg.quantized:
+        spec["quantized"] = True
+    else:
+        spec["dtype"] = str(
+            jnp.dtype(engine_cfg.kv_dtype or model_cfg.dtype))
+    return spec
+
+
+def register_serving_workers(builder: ModelBuilder, model_cfg, engine_cfg,
+                             params) -> ModelBuilder:
+    """Register the disaggregated serving workers as AOT keys.
+
+    ``"chunked_prefill"`` (width = ``prefill_budget`` or ``token_budget``,
+    the priority model — it gates TTFT) and ``"token_decode"`` (width =
+    ``max_slots``) over the shared paged pool: the same two fixed-shape
+    programs a disaggregated :class:`~.engine.ServingEngine` jits, but
+    exported/compiled ahead of time so a serving process cold-starts
+    without tracing. Both workers take and return the whole pool — the
+    prefill→decode handoff is block-table surgery on the host, so no
+    extra transfer program is needed."""
+    from ..models.llama import llama_forward_with_cache
+    from .paging import init_paged_kv_cache, init_quantized_paged_kv_cache
+
+    e, m = engine_cfg, model_cfg
+    if e.quantized:
+        cache = init_quantized_paged_kv_cache(
+            m.num_layers, e.num_blocks, e.block_size, m.num_kv_heads,
+            m.head_dim_, e.max_slots, e.max_blocks_per_seq)
+    else:
+        cache = init_paged_kv_cache(
+            m.num_layers, e.num_blocks, e.block_size, m.num_kv_heads,
+            m.head_dim_, e.max_slots, e.max_blocks_per_seq,
+            dtype=e.kv_dtype or m.dtype)
+
+    def _worker(params, cache, tokens, positions, slot_ids):
+        return llama_forward_with_cache(
+            model_cfg, params, tokens, positions, cache,
+            slot_ids=slot_ids)
+
+    def _args(width: int):
+        return (params, cache,
+                jax.ShapeDtypeStruct((1, width), jnp.int32),
+                jax.ShapeDtypeStruct((1, width), jnp.int32),
+                jax.ShapeDtypeStruct((width,), jnp.int32))
+
+    prefill_width = e.prefill_budget or e.token_budget
+    builder.add("chunked_prefill", _worker, [_args(prefill_width)],
+                priority_model=True)
+    builder.add("token_decode", _worker, [_args(e.max_slots)])
+    return builder
+
+
 def shard_checkpoint(params: Any, param_specs: Any) -> Any:
     """Place a host/replicated param tree onto the mesh per its specs
     (reference ``shard_checkpoint:817`` produced per-rank weight dicts; with

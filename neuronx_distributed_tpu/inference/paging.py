@@ -20,7 +20,7 @@ entries are never attended, so no separate attention mask is plumbed.
 from __future__ import annotations
 
 import json
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -170,7 +170,15 @@ def init_quantized_paged_kv_cache(num_layers: int, num_blocks: int,
 # ---------------------------------------------------------------------------
 
 class BlockAllocator:
-    """Free-list over the shared pool's ``num_blocks`` block ids."""
+    """Refcounted free-list over the shared pool's ``num_blocks`` block
+    ids. ``alloc`` hands out blocks with refcount 1; :meth:`ref` lets a
+    second owner (another slot sharing a prefix, or the
+    :class:`PrefixCache` itself) pin the same block; :meth:`free` is an
+    *unref* — a block returns to the free list only when its last
+    reference drops, and :meth:`free` reports exactly which blocks did
+    (the engine's freed-position hygiene must clear those, and only
+    those: wiping a still-shared block's positions would blind every
+    surviving reader)."""
 
     def __init__(self, num_blocks: int):
         if num_blocks <= 0:
@@ -186,8 +194,16 @@ class BlockAllocator:
     def num_allocated(self) -> int:
         return self.num_blocks - len(self._free)
 
+    @property
+    def num_shared(self) -> int:
+        """Blocks currently held by more than one reference."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    def refcount(self, block: int) -> int:
+        return self._refs.get(block, 0)
+
     def alloc(self, n: int = 1) -> List[int]:
-        """Take ``n`` blocks off the free list; raises
+        """Take ``n`` blocks off the free list (refcount 1 each); raises
         :class:`CacheExhaustedError` (allocating nothing) when fewer than
         ``n`` are free — the caller decides whether to preempt, defer, or
         reject."""
@@ -199,20 +215,37 @@ class BlockAllocator:
                 f"{self.num_blocks} are free")
         out = [self._free.pop() for _ in range(n)]
         self._allocated.update(out)
+        for b in out:
+            self._refs[b] = 1
         return out
 
-    def free(self, blocks: Sequence[int]) -> None:
+    def ref(self, block: int) -> None:
+        """Add a reference to an already-allocated block."""
+        if block not in self._allocated:
+            raise ValueError(f"cannot ref unallocated block {block}")
+        self._refs[block] += 1
+
+    def free(self, blocks: Sequence[int]) -> List[int]:
+        """Drop one reference per listed block; returns the blocks whose
+        refcount hit zero and were actually returned to the free list."""
+        freed: List[int] = []
         for b in blocks:
             if b not in self._allocated:
                 raise ValueError(
                     f"block {b} is not allocated (double free?)")
-            self._allocated.discard(b)
-            self._free.append(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._allocated.discard(b)
+                self._free.append(b)
+                freed.append(b)
+        return freed
 
     def reset(self) -> None:
         # lowest block ids pop first — keeps tests/debug dumps readable
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._allocated: set = set()
+        self._refs: dict = {}
 
 
 # ---------------------------------------------------------------------------
@@ -256,3 +289,223 @@ def write_pool_positions(pos: jax.Array, positions: jax.Array,
     flat = pos.reshape(nb * bs).at[flat_idx].set(
         positions.astype(pos.dtype), mode="drop")
     return flat.reshape(nb, bs)
+
+
+# ---------------------------------------------------------------------------
+# Prefix sharing: a host-side trie over full prompt blocks. KV for a token
+# depends only on (token, position, params), so two prompts with a common
+# prefix produce bit-identical pool rows for it — the trie lets later
+# requests map those rows instead of re-prefilling them.
+# ---------------------------------------------------------------------------
+
+class _PrefixNode:
+    """One cached full block: ``tokens`` (a ``block_size`` tuple starting
+    at position ``depth * block_size``), the pool block holding its KV,
+    and the chain hash addressing it (hash of the whole token path from
+    the root, so equal block content at different depths never collides
+    semantically)."""
+
+    __slots__ = ("chain", "parent", "tokens", "block", "tick")
+
+    def __init__(self, chain: int, parent: Optional[int],
+                 tokens: Tuple[int, ...], block: int, tick: int):
+        self.chain = chain
+        self.parent = parent
+        self.tokens = tokens
+        self.block = block
+        self.tick = tick
+
+
+class PrefixCache:
+    """Trie of full prompt blocks → pool block ids.
+
+    The cache holds one allocator reference per inserted block, so a
+    cached block outlives the request that wrote it; a later request's
+    :meth:`match` maps the longest cached prefix into its own table (the
+    caller takes its own refs). Cached blocks are never written — a
+    request that diverges *mid-block* copies first (see
+    :func:`cow_copy_blocks`) — so sharing can't leak KV between tenants.
+    Under pool pressure :meth:`evict` drops least-recently-matched leaf
+    nodes until enough blocks actually return to the free list.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self.allocator = allocator
+        self.block_size = block_size
+        self._nodes: Dict[int, _PrefixNode] = {}
+        self._children: Dict[Optional[int], Set[int]] = {None: set()}
+        self._tick = 0
+
+    @property
+    def size(self) -> int:
+        return len(self._nodes)
+
+    @staticmethod
+    def _hash(parent: Optional[int], tokens: Tuple[int, ...]) -> int:
+        return hash((parent, tokens))
+
+    def _touch(self, node: _PrefixNode) -> None:
+        self._tick += 1
+        node.tick = self._tick
+
+    def match(self, prompt: Sequence[int], max_tokens: int
+              ) -> Tuple[List[int], int, Optional[Tuple[int, int]],
+                         Optional[int]]:
+        """Longest cached prefix of ``prompt``, capped at ``max_tokens``.
+
+        Returns ``(full_blocks, matched, partial, chain)``: pool ids of
+        fully-matched blocks, the token count they cover, an optional
+        ``(block, m)`` partial-tail match (a cached block whose first
+        ``m < block_size`` tokens extend the prefix — the one case that
+        later forces a copy-on-write, since the mapper will write its own
+        divergent rows mid-block), and the chain hash of the last full
+        node (``None`` at the root) for continued insertion."""
+        bs = self.block_size
+        full: List[int] = []
+        chain: Optional[int] = None
+        matched = 0
+        while matched + bs <= max_tokens:
+            tokens = tuple(prompt[matched:matched + bs])
+            child = self._hash(chain, tokens)
+            node = self._nodes.get(child)
+            if node is None or node.tokens != tokens:
+                break
+            self._touch(node)
+            full.append(node.block)
+            chain = child
+            matched += bs
+        partial: Optional[Tuple[int, int]] = None
+        tail = tuple(prompt[matched:max_tokens])
+        if tail:
+            best, best_node = 0, None
+            for child in self._children.get(chain, ()):
+                node = self._nodes[child]
+                m = 0
+                for a, b in zip(node.tokens, tail):
+                    if a != b:
+                        break
+                    m += 1
+                if m > best:
+                    best, best_node = m, node
+            if best_node is not None:
+                self._touch(best_node)
+                partial = (best_node.block, best)
+        return full, matched, partial, chain
+
+    def lookup(self, prompt: Sequence[int], max_tokens: int) -> int:
+        """Peek: how many tokens of ``prompt`` the cache covers right now
+        (full blocks + partial tail), without touching recency."""
+        bs = self.block_size
+        chain: Optional[int] = None
+        matched = 0
+        while matched + bs <= max_tokens:
+            tokens = tuple(prompt[matched:matched + bs])
+            child = self._hash(chain, tokens)
+            node = self._nodes.get(child)
+            if node is None or node.tokens != tokens:
+                break
+            chain = child
+            matched += bs
+        best = 0
+        tail = tuple(prompt[matched:max_tokens])
+        if tail:
+            for child in self._children.get(chain, ()):
+                m = 0
+                for a, b in zip(self._nodes[child].tokens, tail):
+                    if a != b:
+                        break
+                    m += 1
+                best = max(best, m)
+        return matched + best
+
+    def insert(self, parent: Optional[int], tokens: Sequence[int],
+               block: int) -> Tuple[Optional[int], bool]:
+        """Register ``block`` as holding the full block ``tokens`` under
+        ``parent`` (a chain hash from :meth:`match`/a prior insert).
+
+        Returns ``(chain, inserted)``. Idempotent: an existing node with
+        the same tokens just advances the chain (``inserted`` False, the
+        caller keeps its own block). ``(None, False)`` means the chain is
+        unusable — hash collision, or the parent node was evicted — and
+        the caller should stop inserting for this request."""
+        tokens = tuple(tokens)
+        if len(tokens) != self.block_size:
+            raise ValueError(
+                f"prefix nodes cache full blocks only: got {len(tokens)} "
+                f"tokens for block_size {self.block_size}")
+        if parent is not None and parent not in self._nodes:
+            return None, False
+        chain = self._hash(parent, tokens)
+        node = self._nodes.get(chain)
+        if node is not None:
+            if node.tokens != tokens:     # hash collision: leave the trie
+                return None, False        # alone, stop this chain
+            self._touch(node)
+            return chain, False
+        self.allocator.ref(block)
+        node = _PrefixNode(chain, parent, tokens, block, 0)
+        self._touch(node)
+        self._nodes[chain] = node
+        self._children.setdefault(parent, set()).add(chain)
+        self._children.setdefault(chain, set())
+        return chain, True
+
+    def evict(self, want_free: int) -> List[int]:
+        """Drop least-recently-matched *leaf* nodes until ``want_free``
+        blocks have actually returned to the pool (a dropped node whose
+        block other slots still reference frees nothing — keep going).
+        Returns the block ids that did free, so the engine can schedule
+        its freed-position hygiene for them."""
+        freed: List[int] = []
+        while len(freed) < want_free:
+            leaves = [n for n in self._nodes.values()
+                      if not self._children.get(n.chain)]
+            if not leaves:
+                break
+            victim = min(leaves, key=lambda n: n.tick)
+            freed.extend(self._remove(victim))
+        return freed
+
+    def clear(self) -> List[int]:
+        """Drop every node (e.g. engine teardown); returns the blocks
+        that actually returned to the free list."""
+        freed: List[int] = []
+        for node in list(self._nodes.values()):
+            if node.chain in self._nodes:
+                freed.extend(self._remove(node))
+        return freed
+
+    def _remove(self, node: _PrefixNode) -> List[int]:
+        del self._nodes[node.chain]
+        self._children.pop(node.chain, None)
+        self._children.get(node.parent, set()).discard(node.chain)
+        return self.allocator.free([node.block])
+
+
+# ---------------------------------------------------------------------------
+# Copy-on-write. Fixed-shape and jitted: the engine batches this step's
+# pending copies into [M] src/dst/keep arrays (pad entries carry dst ==
+# num_blocks, dropped by the OOB scatters) so the clone pass compiles once.
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def cow_copy_blocks(cache: Any, src: jax.Array, dst: jax.Array,
+                    keep_upto: jax.Array) -> Any:
+    """Clone pool blocks ``src[i] → dst[i]`` before a writer lands in a
+    shared block. Rows with stored position ``>= keep_upto[i]`` (the
+    writer's first divergent position) become padding in the clone — the
+    writer owns them from here on. Pad entries: ``src == 0, dst ==
+    num_blocks`` (``mode="drop"`` discards them)."""
+
+    def cp(pool):
+        return pool.at[:, dst].set(jnp.take(pool, src, axis=1),
+                                   mode="drop")
+
+    rows_pos = jnp.take(cache.pos, src, axis=0)
+    rows_pos = jnp.where(rows_pos < keep_upto[:, None], rows_pos,
+                         PAD_POSITION)
+    updates = dict(k=cp(cache.k), v=cp(cache.v),
+                   pos=cache.pos.at[dst].set(rows_pos, mode="drop"))
+    if isinstance(cache, QuantizedPagedKVCache):
+        updates.update(k_scale=cp(cache.k_scale), v_scale=cp(cache.v_scale))
+    return cache.replace(**updates)

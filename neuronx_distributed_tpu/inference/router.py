@@ -7,6 +7,9 @@ owns the request lifecycle end to end:
 * **placement** — join-shortest-queue over live queue depth + pool
   occupancy, with optional session affinity (a session's requests stick
   to the replica that holds their warm KV prefix while it stays healthy);
+  ``placement="prefix"`` upgrades this to prefix-locality routing: the
+  replica whose prefix cache holds the most of the prompt wins, which
+  with disaggregated engines forms the prefill→decode pipeline mode;
 * **admission control** — a per-tenant token bucket
   (:class:`TenantPolicy`) plus a global committed-token budget, with a
   typed :class:`~.engine.RequestRejected` at submit and an overload
@@ -78,9 +81,11 @@ class TenantPolicy:
     """Per-tenant admission policy.
 
     ``rate_tokens_per_s``/``burst_tokens`` parameterize a token bucket
-    over *committed* tokens (prompt + max_new per request); the defaults
-    are unlimited. ``priority`` orders tenants for overload shedding —
-    lower values are shed first once load crosses ``shed_threshold``.
+    over *committed* tokens (prompt + max_new per request, net of any
+    prefix-sharing credit — shared prompt tokens are work the fleet does
+    not redo); the defaults are unlimited. ``priority`` orders tenants
+    for overload shedding — lower values are shed first once load
+    crosses ``shed_threshold``.
     """
 
     rate_tokens_per_s: float = math.inf
@@ -102,6 +107,14 @@ class RouterConfig:
     tenants: Dict[str, TenantPolicy] = dataclasses.field(
         default_factory=dict)
     default_tenant: str = "default"
+    # "jsq" = join-shortest-queue; "prefix" = prefix-locality: route to
+    # the replica whose prefix cache already holds the most of this
+    # prompt (ties fall back to JSQ). Combined with
+    # ``EngineConfig.disaggregated`` this is the prefill→decode pipeline
+    # placement mode: requests land where their prefix KV lives, the
+    # prefill worker computes only the divergent tail, and the decode
+    # worker picks the blocks up from the shared pool.
+    placement: str = "jsq"
     global_token_budget: Optional[int] = None
     degrade_threshold: float = 0.75
     shed_threshold: float = 0.9
@@ -219,6 +232,7 @@ class _RouterRequest:
     next_try: float = 0.0           # backoff: not placeable before this
     placed_at: Optional[float] = None
     degraded: bool = False
+    charged_tokens: int = 0         # budget charge net of prefix credit
 
     @property
     def total_tokens(self) -> int:
@@ -272,6 +286,14 @@ class ReplicaRouter:
         self._sessions: Dict[str, str] = {}   # session -> replica name
         self._buckets: Dict[str, List[float]] = {}  # tenant -> [tokens, t]
         self._committed = 0                   # admitted tokens in flight
+        # engine counters absorbed from crashed (discarded) engines, so
+        # aggregate prefix stats survive failover
+        self._eng_acc = {"prefix_hit_tokens": 0, "prefill_tokens": 0,
+                         "cow_copies": 0}
+        if cfg.placement not in ("jsq", "prefix"):
+            raise ValueError(
+                f"unknown placement {cfg.placement!r}: want 'jsq' or "
+                f"'prefix'")
         if engines is not None:
             if len(engines) != cfg.num_replicas:
                 raise ValueError(
@@ -343,7 +365,9 @@ class ReplicaRouter:
         if not self._fits_any(req):
             self._reject(req, "never_fits",
                          f"{uid}: cannot fit any replica even alone")
-        load = (self._committed + req.total_tokens) / max(1, self._budget)
+        credit = self._prefix_credit(req)
+        load = (self._committed + req.total_tokens - credit) / max(
+            1, self._budget)
         if load > 1.0:
             self._reject(req, "over_budget",
                          f"global budget: load would be {load:.2f}")
@@ -359,10 +383,11 @@ class ReplicaRouter:
                 req.max_new_tokens = capped
                 req.degraded = True
                 self.stats.degraded += 1
-        if not self._bucket_take(tenant, req.total_tokens):
+        req.charged_tokens = max(0, req.total_tokens - credit)
+        if not self._bucket_take(tenant, req.charged_tokens):
             self._reject(req, "tenant_throttled",
                          f"tenant {tenant!r} token bucket empty")
-        self._committed += req.total_tokens
+        self._committed += req.charged_tokens
         self.stats.admitted += 1
         self._pending.append(req)
         return uid
@@ -373,6 +398,18 @@ class ReplicaRouter:
         # all replicas share one EngineConfig, so any engine answers
         return probe is not None and probe.fits(
             len(req.prompt), req.max_new_tokens)
+
+    def _prefix_credit(self, req: _RouterRequest) -> int:
+        """Prompt tokens some live replica's prefix cache already holds
+        — work this request will share instead of redoing, credited
+        against the global budget and the tenant bucket so prefix-heavy
+        traffic is not spuriously ``over_budget``. ``never_fits`` stays
+        *uncredited* on purpose: its pool/table bound is about distinct
+        blocks coexisting in one pool, which sharing does not change."""
+        if not getattr(self.ecfg, "prefix_sharing", False):
+            return 0
+        return max((rep.engine.prefix_lookup(req.prompt)
+                    for rep in self.live_replicas()), default=0)
 
     def _is_sheddable(self, tenant: str) -> bool:
         """Shed tenants strictly below the highest configured priority;
@@ -424,6 +461,12 @@ class ReplicaRouter:
             hit = next((r for r in live if r.name == name), None)
             if hit is not None:
                 return hit
+        if self.cfg.placement == "prefix":
+            # prefix locality: most cached prompt tokens wins, JSQ breaks
+            # ties (covers the cold-start case where nobody holds it)
+            return min(live, key=lambda r: (
+                -r.engine.prefix_lookup(req.prompt), self._score(r),
+                r.name))
         return min(live, key=lambda r: (self._score(r), r.name))
 
     def _place_pending(self) -> int:
@@ -467,7 +510,7 @@ class ReplicaRouter:
         # tokens are discarded (greedy regenerates them bit-identically)
         self.stats.resubmitted_tokens += len(req.prompt) + lost_generated
         if req.attempts > self.cfg.max_retries:
-            self._committed -= req.total_tokens
+            self._committed -= req.charged_tokens
             self.stats.failed += 1
             self.results[req.uid] = RouterResult(
                 uid=req.uid, tenant=req.tenant, status="failed",
@@ -504,6 +547,8 @@ class ReplicaRouter:
         rep.down_steps = self.cfg.probation_steps
         rep.ok_steps = 0
         if not engine_alive:
+            if rep.engine is not None:
+                self._absorb_engine_stats(rep.engine)
             rep.engine = None  # crashed: the instance is gone
         rep.monitor = ReplicaMonitor(self.cfg)
 
@@ -520,6 +565,44 @@ class ReplicaRouter:
             rep.ok_steps = 0
             self.stats.revivals += 1
 
+    # -- stats -------------------------------------------------------------
+
+    def _absorb_engine_stats(self, eng: ServingEngine) -> None:
+        """Fold a to-be-discarded engine's prefix counters into the
+        accumulator so crashes don't erase them from the aggregate."""
+        self._eng_acc["prefix_hit_tokens"] += eng.stats.prefix_hit_tokens
+        self._eng_acc["prefill_tokens"] += eng.stats.prefill_tokens
+        self._eng_acc["cow_copies"] += eng.stats.cow_copies
+
+    def engine_aggregate(self) -> Dict[str, float]:
+        """Prefix-sharing metrics aggregated across replicas (live
+        engines plus counters absorbed from crashed ones)."""
+        hit = self._eng_acc["prefix_hit_tokens"]
+        pre = self._eng_acc["prefill_tokens"]
+        cow = self._eng_acc["cow_copies"]
+        fracs: List[float] = []
+        for rep in self.replicas:
+            if rep.engine is None:
+                continue
+            s = rep.engine.stats
+            hit += s.prefix_hit_tokens
+            pre += s.prefill_tokens
+            cow += s.cow_copies
+            fracs.extend(s.shared_fraction)
+        return {
+            "prefix_hit_rate": hit / max(1, hit + pre),
+            "shared_block_fraction": (float(np.mean(fracs))
+                                      if fracs else 0.0),
+            "cow_copies": cow,
+        }
+
+    def stats_dict(self) -> Dict[str, Any]:
+        """:meth:`RouterStats.to_dict` plus the cross-replica prefix
+        aggregate."""
+        d = self.stats.to_dict()
+        d.update(self.engine_aggregate())
+        return d
+
     # -- stepping ----------------------------------------------------------
 
     def _collect(self, rep: _Replica) -> None:
@@ -527,7 +610,7 @@ class ReplicaRouter:
         for uid in [u for u in rep.assigned if u in eng.results]:
             req = rep.assigned.pop(uid)
             res = eng.results.pop(uid)
-            self._committed -= req.total_tokens
+            self._committed -= req.charged_tokens
             self.stats.completed += 1
             ttft = None
             if res.ttft_s is not None and req.placed_at is not None:

@@ -21,6 +21,14 @@ Two implementations behind one signature, following
 
 Auto-dispatch picks the kernel on TPU when the shapes tile; CPU runs the
 kernel in interpret mode when forced (CI coverage of the mask path).
+
+Both paths are strictly *read-only* over the pool: they gather blocks by
+table entry and never scatter back. That is what makes copy-on-write
+prefix sharing (:class:`..inference.paging.PrefixCache`) safe — two
+tokens' tables may name the same block ids and each still attends to
+identical K/V; writers are diverted to private clones by the engine
+before the step runs (verified by the shared-table invariance test in
+``tests/test_prefix_sharing.py``).
 """
 
 from __future__ import annotations

@@ -1,0 +1,40 @@
+"""Fixture: block-pool bookkeeping mutated outside ``inference/paging.py``.
+
+Hand-rolled "fast paths" that reach into the allocator's free list /
+refcounts and poke ``block_tables`` directly — with copy-on-write prefix
+sharing these can double-free a block another sequence still shares or
+remap a row behind the prefix trie's back, cross-contaminating KV.
+"""
+
+
+def leak_block_back(alloc, block):
+    alloc._free.append(block)             # bypasses refcount decrement
+    alloc._allocated.discard(block)
+
+
+def force_share(alloc, block):
+    alloc._refs[block] = 2                # invents a reference
+
+
+def steal_row(cache, slot, idx, block):
+    cache = cache.replace(
+        block_tables=cache.block_tables.at[slot, idx].set(block))
+    return cache
+
+
+def host_table_poke(tables, slot, block):
+    tables.block_tables[slot] = block     # host mirror out of sync
+
+
+def clobber_free_list(alloc, n):
+    alloc._free = list(range(n))
+
+
+def fine_public_api(alloc, engine, cache, host_tables):
+    # the sanctioned paths do NOT fire: allocator methods and a full-row
+    # replace fed from the engine's host tables
+    blocks = alloc.alloc(2)
+    alloc.ref(blocks[0])
+    freed = alloc.free(blocks)
+    cache = cache.replace(block_tables=host_tables)
+    return cache, freed

@@ -175,6 +175,33 @@ def test_serving_resilience_scoped_to_inference_paths():
     assert [f.rule for f in flagged] == ["serving-resilience"]
 
 
+def test_paging_refcount_fires_on_fixture():
+    fs = _lint(os.path.join("inference", "bad_refcount_bypass.py"))
+    assert _rules(fs) == {"paging-refcount"}
+    msgs = " | ".join(f.message for f in fs if not f.suppressed)
+    assert "._free.append(...)" in msgs
+    assert "`._refs`" in msgs
+    assert ".at[...]" in msgs and "block_tables" in msgs
+    # the public-API form (alloc/ref/free + full-row replace) stays quiet
+    assert not any(f.line > 32 for f in fs if not f.suppressed)
+
+
+def test_paging_refcount_exempts_paging_module():
+    src = ("class BlockAllocator:\n"
+           "    def free(self, blocks):\n"
+           "        for b in blocks:\n"
+           "            self._refs[b] -= 1\n"
+           "            self._free.append(b)\n")
+    # inside the owner module the bookkeeping is the implementation...
+    assert analyze_source(src, "mymodel/inference/paging.py",
+                          axes=DEFAULT_AXES) == []
+    # ...anywhere else it is a bypass
+    flagged = analyze_source(src, "mymodel/inference/engine.py",
+                             axes=DEFAULT_AXES)
+    assert {f.rule for f in flagged} == {"paging-refcount"}
+    assert len(flagged) == 2
+
+
 def test_inference_package_self_gate():
     # the serving engine must pass the rule it motivated: every step
     # array is packed to the fixed token budget, never len(requests) —
@@ -269,7 +296,7 @@ def test_cli_nonzero_on_fixture_corpus():
     assert out_rules == {"mesh-axis", "trace-safety", "custom-vjp",
                          "recompile-hazard", "resilience",
                          "comm-compression", "tp-overlap",
-                         "serving-resilience"}
+                         "serving-resilience", "paging-refcount"}
 
 
 def test_cli_zero_on_clean_file():
