@@ -71,7 +71,7 @@ import jax.numpy as jnp  # noqa: E402
 
 
 def main(chaos_spec=None, serving=False, overlap=False, router=False,
-         prefix_heavy=False):
+         prefix_heavy=False, plan_mode=False):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -252,6 +252,18 @@ def main(chaos_spec=None, serving=False, overlap=False, router=False,
 
             traceback.print_exc()
             print(f"bench: tp-overlap metric failed: {e!r}", file=sys.stderr)
+
+    # placement-planner drill (docs/planner.md): opt-in via --plan; the
+    # analytic search at this host's device count vs the hand-picked
+    # layout above, with a seeded measured refinement of the top-k
+    if plan_mode:
+        try:
+            aux.update(plan_metric(platform, n_dev))
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: plan metric failed: {e!r}", file=sys.stderr)
 
     # gradient-collective microbenchmark (docs/comm_compression.md): time a
     # gradient-sized all-reduce at fp32 vs blockwise int8 and report the
@@ -792,6 +804,70 @@ def comm_metric(platform: str, n_dev: int) -> dict:
     }
 
 
+def plan_metric(platform: str, n_dev: int) -> dict:
+    """Placement-planner drill (docs/planner.md): run the analytic search
+    at this host's device count over the bench model shape and compare the
+    winner's modeled step cost against the hand-picked layout main() hard
+    codes. RETURNS aux entries keyed by metric name — never prints a JSON
+    line.
+
+    ``plan_advantage_ratio`` >= 1.0 means the planner's plan models at
+    least as fast as the hand-picked one (the planner enumerates the
+    hand-picked point, so < 1.0 would be a search bug). Costs are the
+    analytic model's — deterministic by construction; the measured
+    refinement pass re-ranks with a fixed seed and stable tie-breaks, so
+    the reported best plan is identical across runs on the same host.
+    """
+    from neuronx_distributed_tpu import plan as planner
+    from neuronx_distributed_tpu.models import llama
+
+    if platform == "cpu":
+        mcfg = llama.LlamaConfig(
+            vocab_size=1024, hidden_size=256, intermediate_size=704,
+            num_layers=4, num_heads=8, num_kv_heads=8, max_seq_len=512)
+        batch, seq = 4, 512
+    elif n_dev >= 8:
+        mcfg, batch, seq = llama.LLAMA2_7B, 4, 2048
+    else:
+        mcfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=16, num_heads=8, num_kv_heads=8, max_seq_len=2048)
+        batch, seq = 8, 2048
+    spec = planner.ModelSpec.from_model_config(
+        mcfg, seq=seq, global_batch=max(batch, n_dev), name="bench")
+    hw = planner.default_hardware(platform)
+
+    t0 = time.perf_counter()
+    result = planner.search(spec, hw, n_dev)
+    refined = planner.refine(result.ranked, spec, hw, seed=0)
+    search_ms = (time.perf_counter() - t0) * 1e3
+
+    best = result.best
+    hand = planner.handpicked_plan(n_dev, platform=platform)
+    hand_cost = planner.step_cost(hand, spec, hw)
+    ratio = (hand_cost.total_s / best.total_s) if best else 0.0
+    print(f"bench: plan search {result.n_enumerated} candidates in "
+          f"{search_ms:.1f}ms: best={best.plan.describe() if best else None} "
+          f"({best.total_s * 1e3:.2f}ms modeled) vs handpicked "
+          f"{hand.describe()} ({hand_cost.total_s * 1e3:.2f}ms); "
+          f"refined winner={refined[0].plan.describe() if refined else None}",
+          file=sys.stderr)
+    return {
+        f"plan_best_cost_{platform}{n_dev}": {
+            "value": round(best.total_s * 1e3, 3) if best else -1.0,
+            "unit": "modeled_ms_per_step", "vs_baseline": 1.0},
+        f"plan_handpicked_cost_{platform}{n_dev}": {
+            "value": round(hand_cost.total_s * 1e3, 3),
+            "unit": "modeled_ms_per_step", "vs_baseline": 1.0},
+        f"plan_advantage_ratio_{platform}{n_dev}": {
+            "value": round(ratio, 4), "unit": "x_vs_handpicked",
+            "vs_baseline": 1.0},
+        f"plan_search_ms_{platform}{n_dev}": {
+            "value": round(search_ms, 1), "unit": "ms",
+            "vs_baseline": 1.0},
+    }
+
+
 def tp_overlap_metric(platform: str, n_dev: int) -> dict:
     """Decomposed collective-matmul microbenchmark (docs/tp_overlap.md):
     time the sequence-parallel llama MLP pair — all-gather→matmul entry and
@@ -1003,7 +1079,13 @@ if __name__ == "__main__":
         help="also run the tensor-parallel overlap microbenchmark "
              "(decomposed collective-matmul vs monolithic gather+matmul at "
              "llama MLP shapes; docs/tp_overlap.md)")
+    _p.add_argument(
+        "--plan", action="store_true",
+        help="also run the placement-planner drill (analytic search at "
+             "this device count vs the hand-picked bench layout; reports "
+             "plan_best_cost / plan_handpicked_cost / "
+             "plan_advantage_ratio / plan_search_ms; docs/planner.md)")
     _args = _p.parse_args()
     main(chaos_spec=_args.chaos, serving=_args.serving,
          overlap=_args.overlap, router=_args.router,
-         prefix_heavy=_args.prefix_heavy)
+         prefix_heavy=_args.prefix_heavy, plan_mode=_args.plan)

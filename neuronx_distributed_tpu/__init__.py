@@ -23,6 +23,7 @@ from . import lora
 from . import quantization
 from . import utils
 from . import data
+from . import plan
 from . import scripts
 
 __version__ = "0.1.0"
@@ -43,5 +44,6 @@ __all__ = [
     "quantization",
     "utils",
     "data",
+    "plan",
     "scripts",
 ]

@@ -91,6 +91,7 @@ def _ensure_rules_loaded() -> None:
                    rules_custom_vjp,  # noqa: F401
                    rules_mesh_axes,  # noqa: F401
                    rules_paging,  # noqa: F401
+                   rules_plan,  # noqa: F401
                    rules_recompile,  # noqa: F401
                    rules_resilience,  # noqa: F401
                    rules_serving_resilience,  # noqa: F401

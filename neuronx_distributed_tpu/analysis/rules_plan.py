@@ -1,0 +1,177 @@
+"""Rule ``plan``: hand-rolled parallelism layouts the placement planner
+strictly dominates.
+
+A ``neuronx_distributed_config(...)`` call site with literal parallelism
+kwargs pins a placement forever. This rule rebuilds the implied
+:class:`..plan.cost.Plan`, runs the placement search
+(:func:`..plan.search.search`) at the same device count over a fixed
+reference model, and fires when the search's best plan models a step
+time at least ``_MARGIN`` cheaper — i.e. the committed layout is
+*dominated*: same hardware, strictly lower modeled cost, usually because
+it leaves a known knob on the table (bubble-heavy pp with too few
+microbatches, flat fp32 gradient rings across DCN, disabled overlap).
+
+Only fully literal call sites are judged: any ``**kwargs``, any
+non-constant relevant kwarg, or nested config objects with computed
+arguments make the layout data-driven, and data-driven call sites are
+someone's planner already. ``plan/`` itself is exempt (the emitter is
+the planner's own output path), as are default-only calls (nothing to
+dominate).
+
+Unlike its sibling rules this one is not purely syntactic — it imports
+the planner's cost model. It still never imports the code under
+analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterator, Optional
+
+from . import astutil
+from .core import Finding, LintContext, register
+
+#: flag only when the planner's best plan is at least this much faster
+_MARGIN = 1.05
+
+# kwargs that define the layout; any of these non-literal -> skip the call
+_PARALLEL_KWARGS = ("tensor_parallel_size", "pipeline_parallel_size",
+                    "context_parallel_size", "expert_parallel_size",
+                    "dcn_data_parallel_size", "tp_overlap_comm",
+                    "sequence_parallel")
+_NESTED = {"optimizer_config": "OptimizerConfig",
+           "pipeline_config": "PipelineConfig",
+           "activation_checkpoint_config": "ActivationCheckpointConfig"}
+
+
+def _exempt(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return "/plan/" in norm or norm.startswith("plan/")
+
+
+def _literal(node: ast.AST) -> Optional[Any]:
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _nested_kwargs(node: ast.AST, clsname: str) -> Optional[Dict[str, Any]]:
+    """Literal kwargs of a nested ``OptimizerConfig(...)``-style call, or
+    None when it isn't one / isn't fully literal."""
+    if not (isinstance(node, ast.Call)
+            and astutil.tail_name(node.func) == clsname
+            and not node.args):
+        return None
+    out: Dict[str, Any] = {}
+    for kw in node.keywords:
+        if kw.arg is None:
+            return None
+        if not isinstance(kw.value, ast.Constant):
+            return None
+        out[kw.arg] = kw.value.value
+    return out
+
+
+def _extract(call: ast.Call) -> Optional[Dict[str, Any]]:
+    """The layout-relevant literal kwargs of one call site, or None when
+    the site is not judgeable (``**kwargs`` / non-literal values)."""
+    info: Dict[str, Any] = {}
+    for kw in call.keywords:
+        if kw.arg is None:          # **kwargs: layout is data-driven
+            return None
+        if kw.arg in _PARALLEL_KWARGS:
+            val = _literal(kw.value)
+            if val is None and not (isinstance(kw.value, ast.Constant)
+                                    and kw.value.value is None):
+                return None
+            info[kw.arg] = val
+        elif kw.arg in _NESTED:
+            nested = _nested_kwargs(kw.value, _NESTED[kw.arg])
+            if nested is None:
+                return None
+            info[kw.arg] = nested
+    return info
+
+
+def _reference_spec(world: int):
+    """Fixed model the domination check is scored against: a ~1.7B llama
+    shape (heads/layers divide every power-of-two degree up to 32, and
+    feasible layouts exist from 2 devices up — a 7B-class reference would
+    OOM every candidate at small worlds and mute the rule) with a global
+    batch divisible by any dp that divides ``world``."""
+    from ..plan.cost import ModelSpec
+
+    return ModelSpec(name="lint-reference", vocab=32000, hidden=2048,
+                     intermediate=5504, layers=32, heads=32, kv_heads=32,
+                     seq=4096, global_batch=max(32, 2 * world))
+
+
+def _implied_plan(info: Dict[str, Any], world: int, dcn: int):
+    from ..plan.cost import Plan
+
+    opt = info.get("optimizer_config", {})
+    pipe = info.get("pipeline_config", {})
+    ckpt = info.get("activation_checkpoint_config", {})
+    tp = info.get("tensor_parallel_size") or 1
+    pp = info.get("pipeline_parallel_size") or 1
+    cp = info.get("context_parallel_size") or 1
+    return Plan(
+        devices=world, tp=tp, pp=pp, cp=cp,
+        dp=world // (tp * pp * cp), dcn_dp=dcn,
+        zero1=bool(opt.get("zero_one_enabled", False)),
+        grad_comm_dtype=opt.get("grad_comm_dtype", "fp32"),
+        grad_comm_hierarchical=bool(opt.get("grad_comm_hierarchical",
+                                            False)),
+        tp_overlap=bool(info.get("tp_overlap_comm")),
+        sequence_parallel=bool(info.get("sequence_parallel", False)),
+        remat=ckpt.get("mode", "none") != "none",
+        num_microbatches=pipe.get("num_microbatches", 1))
+
+
+@register(
+    "plan",
+    "hand-rolled neuronx_distributed_config(...) layout that the "
+    "placement planner strictly dominates at the same device count — "
+    "run python -m neuronx_distributed_tpu.plan")
+def check(ctx: LintContext) -> Iterator[Finding]:
+    if _exempt(ctx.path):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and astutil.tail_name(node.func)
+                == "neuronx_distributed_config"):
+            continue
+        info = _extract(node)
+        if info is None:
+            continue
+        tp = info.get("tensor_parallel_size") or 1
+        pp = info.get("pipeline_parallel_size") or 1
+        cp = info.get("context_parallel_size") or 1
+        dcn = info.get("dcn_data_parallel_size") or 1
+        world = tp * pp * cp * dcn
+        if world <= 1:
+            continue    # defaults: nothing committed, nothing to judge
+        from ..plan.cost import default_hardware, step_cost
+        from ..plan.search import search
+
+        spec = _reference_spec(world)
+        hand = _implied_plan(info, world, dcn)
+        try:
+            hand_cost = step_cost(hand, spec, default_hardware())
+        except (ValueError, ZeroDivisionError):
+            continue    # layout incompatible with the reference shapes
+        result = search(spec, default_hardware(), world, dcn_dp=dcn)
+        best = result.best
+        if best is None or best.total_s * _MARGIN >= hand_cost.total_s:
+            continue
+        yield Finding(
+            ctx.path, node.lineno, node.col_offset, "plan",
+            f"hand-rolled layout ({hand.describe()}) models "
+            f"{hand_cost.total_s * 1e3:.1f} ms/step on the reference "
+            f"model; the planner's best at the same {world} device(s) "
+            f"({best.plan.describe()}) models "
+            f"{best.total_s * 1e3:.1f} ms — "
+            "run python -m neuronx_distributed_tpu.plan "
+            "(docs/planner.md) or suppress if the layout is "
+            "hardware-constrained")

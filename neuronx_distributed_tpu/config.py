@@ -48,6 +48,47 @@ def configure_model(cfg: "NxDConfig", model_cfg: Any) -> Any:
     return model_cfg
 
 
+def mesh_factorization(
+    world: int,
+    tensor_parallel_size: int = 1,
+    pipeline_parallel_size: int = 1,
+    context_parallel_size: int = 1,
+    expert_parallel_size: int = 1,
+    data_parallel_size: Optional[int] = None,
+    dcn_data_parallel_size: Optional[int] = None,
+) -> dict:
+    """Validate a mesh factorization of ``world`` devices and return the
+    resolved axis sizes ``{pp, dp, cp, tp, ep, dp_exp, dcn_dp, world}``.
+
+    The single source of truth for the divisibility rules shared by
+    ``parallel.mesh.initialize_model_parallel`` (which builds the device
+    array from these sizes) and the placement planner's search
+    (``plan.search``, which turns each violation into a machine-readable
+    prune reason). Raises ``ValueError`` with the same messages the mesh
+    initializer always raised.
+    """
+    tp, pp, cp, ep = (tensor_parallel_size, pipeline_parallel_size,
+                      context_parallel_size, expert_parallel_size)
+    denom = tp * pp * cp
+    if world % denom != 0:
+        raise ValueError(
+            f"world size {world} not divisible by tp*pp*cp = {denom}")
+    dp = world // denom
+    if data_parallel_size is not None and data_parallel_size != dp:
+        raise ValueError(
+            f"explicit data_parallel_size {data_parallel_size} inconsistent "
+            f"with world {world} / (tp*pp*cp) = {dp}")
+    if (dp * cp) % ep != 0:
+        raise ValueError(
+            f"dp*cp = {dp * cp} not divisible by expert parallel size {ep}")
+    dcn_dp = dcn_data_parallel_size or 1
+    if dcn_dp > 1 and dp % dcn_dp != 0:
+        raise ValueError(
+            f"dp {dp} not divisible by dcn_data_parallel_size {dcn_dp}")
+    return dict(pp=pp, dp=dp, cp=cp, tp=tp, ep=ep, dp_exp=dp * cp // ep,
+                dcn_dp=dcn_dp, world=world)
+
+
 @dataclass(frozen=True)
 class ParallelConfig:
     """Parallel dimensions of the device mesh.
@@ -199,6 +240,28 @@ class NxDConfig:
 
     def replace(self, **kw: Any) -> "NxDConfig":
         return dataclasses.replace(self, **kw)
+
+    def to_config_kwargs(self) -> dict:
+        """The ``neuronx_distributed_config(...)`` kwargs that rebuild this
+        config: ``neuronx_distributed_config(**cfg.to_config_kwargs(),
+        init_mesh=False) == cfg``. The inverse of the factory — the YAML
+        converter's config→YAML direction and the planner's emitted-config
+        round-trip check both go through it."""
+        return dict(
+            tensor_parallel_size=self.parallel.tensor_parallel_size,
+            pipeline_parallel_size=self.parallel.pipeline_parallel_size,
+            context_parallel_size=self.parallel.context_parallel_size,
+            expert_parallel_size=self.parallel.expert_parallel_size,
+            dcn_data_parallel_size=self.parallel.dcn_data_parallel_size,
+            tp_overlap_comm=self.parallel.tp_overlap_comm,
+            optimizer_config=self.optimizer,
+            mixed_precision_config=self.mixed_precision,
+            activation_checkpoint_config=self.activation_checkpoint,
+            pipeline_config=self.pipeline,
+            checkpoint_config=self.checkpoint,
+            sequence_parallel=self.sequence_parallel,
+            seed=self.seed,
+        )
 
 
 def neuronx_distributed_config(

@@ -147,6 +147,25 @@ def init_paged_kv_cache(num_layers: int, num_blocks: int, block_size: int,
         block_size=block_size)
 
 
+def pool_accounting(num_layers: int, num_blocks: int, block_size: int,
+                    num_kv_heads: int, head_dim: int, *,
+                    kv_bytes: int = 2, quantized: bool = False,
+                    tp_size: int = 1) -> float:
+    """Bytes per device for the K+V pool arrays the two init functions
+    above allocate (K and V of shape ``[L, num_blocks, block_size, KV,
+    D]``; the quantized variant stores int8 plus one fp32 scale per pool
+    vector, i.e. per ``shape[:-1]`` entry). The KV-head dimension shards
+    over ``tp_size``. The placement planner's memory model
+    (``plan.cost``) charges serving plans through this function so its
+    numbers track the engine's real allocations."""
+    elems = num_layers * num_blocks * block_size * num_kv_heads * head_dim
+    if quantized:
+        per_pool = elems * 1 + (elems // max(1, head_dim)) * 4
+    else:
+        per_pool = elems * kv_bytes
+    return 2.0 * per_pool / max(1, tp_size)
+
+
 def init_quantized_paged_kv_cache(num_layers: int, num_blocks: int,
                                   block_size: int, num_kv_heads: int,
                                   head_dim: int, max_slots: int,

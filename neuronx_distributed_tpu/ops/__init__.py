@@ -12,7 +12,9 @@ from . import operators
 from . import ring_attention
 from . import ulysses
 from .collective_matmul import (all_gather_matmul, copy_matmul,
-                                matmul_all_reduce, matmul_reduce_scatter)
+                                matmul_all_reduce, matmul_reduce_scatter,
+                                overlap_engaged, shapes_tile,
+                                will_decompose)
 from .flash_attention import flash_attention as flash_attention_fn
 from .flash_decoding import flash_decode_attention
 from .ring_attention import ring_attention as ring_attention_fn
@@ -22,6 +24,7 @@ from .ulysses import ulysses_attention
 __all__ = ["collective_matmul", "flash_attention", "flash_decoding",
            "operators", "ring_attention", "ulysses", "all_gather_matmul",
            "copy_matmul", "matmul_all_reduce", "matmul_reduce_scatter",
+           "overlap_engaged", "shapes_tile", "will_decompose",
            "flash_attention_fn", "flash_decode_attention",
            "ring_attention_fn", "ring_attention_pallas",
            "ulysses_attention"]

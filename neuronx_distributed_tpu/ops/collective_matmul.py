@@ -98,6 +98,29 @@ def _dim_ok(shape: Tuple[int, ...], dim: int) -> bool:
     return _norm_dim(dim, len(shape)) < len(shape) - 1
 
 
+def shapes_tile(x_shape: Tuple[int, ...], dim: int,
+                axis_size: Optional[int], *,
+                needs_divisible: bool) -> bool:
+    """Pure shape-tiling predicate behind :func:`will_decompose` /
+    :func:`overlap_engaged`.
+
+    True when a ring of ``axis_size`` steps can stream ``x`` along ``dim``:
+    the dim must exist and precede the (last) contraction dim, and — for the
+    scatter/delivery forms (``needs_divisible=True``) — tile evenly over the
+    axis. Takes the axis SIZE, not an axis name, so callers that have no
+    bound mesh axis (the placement planner, the ``plan`` lint rule) share
+    this exact rule instead of duplicating it. ``axis_size`` of None (axis
+    unbound) or ≤ 1 never tiles.
+    """
+    if axis_size is None or axis_size <= 1:
+        return False
+    if not _dim_ok(tuple(x_shape), dim):
+        return False
+    if needs_divisible and x_shape[_norm_dim(dim, len(x_shape))] % axis_size:
+        return False
+    return True
+
+
 def will_decompose(impl: str, axis, x_shape: Tuple[int, ...], dim: int,
                    *, needs_divisible: bool) -> bool:
     """Whether the decomposed ring will actually run for this call.
@@ -109,14 +132,8 @@ def will_decompose(impl: str, axis, x_shape: Tuple[int, ...], dim: int,
         raise ValueError(f"impl must be one of {_IMPLS}, got {impl!r}")
     if impl == "monolithic":
         return False
-    n = comm._axis_size(axis)
-    if n is None or n <= 1:
-        return False
-    if not _dim_ok(tuple(x_shape), dim):
-        return False
-    if needs_divisible and x_shape[_norm_dim(dim, len(x_shape))] % n != 0:
-        return False
-    return True
+    return shapes_tile(x_shape, dim, comm._axis_size(axis),
+                       needs_divisible=needs_divisible)
 
 
 def _resolve_bidi(bidirectional: Optional[bool], n: int) -> bool:

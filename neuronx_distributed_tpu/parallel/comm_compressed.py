@@ -96,14 +96,25 @@ class CompressionConfig:
     def wire_bytes_per_element(self) -> float:
         """Payload bytes per gradient element including the per-block
         scales (1 fp32 scale per ``block_size`` elements)."""
-        if not self.quantized:
-            return 4.0
-        return 1.0 + 4.0 / self.block_size
+        return wire_bytes_per_element(self.dtype, self.block_size)
 
     @property
     def ratio(self) -> float:
         """Wire-compression ratio vs fp32 (same collective shape)."""
         return 4.0 / self.wire_bytes_per_element
+
+
+def wire_bytes_per_element(dtype: str, block_size: int = 256) -> float:
+    """Static wire accounting for one gradient element at ``dtype``:
+    1 quantized byte + one fp32 scale per block, 4 bytes unquantized.
+    Module-level and pure so the placement planner's cost model
+    (``plan/cost.py``) charges compressed collectives with the exact
+    arithmetic these collectives implement instead of duplicating it."""
+    if dtype not in ("fp32", "int8", "fp8"):
+        raise ValueError(f"unknown wire dtype {dtype!r}")
+    if dtype == "fp32":
+        return 4.0
+    return 1.0 + 4.0 / block_size
 
 
 def from_config(cfg: Any) -> Optional[CompressionConfig]:

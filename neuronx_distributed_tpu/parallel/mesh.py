@@ -170,21 +170,20 @@ def initialize_model_parallel(
     if devices is None:
         devices = jax.devices()
     world = len(devices)
-    tp, pp, cp, ep = (tensor_model_parallel_size, pipeline_model_parallel_size,
-                      context_parallel_size, expert_model_parallel_size)
-    denom = tp * pp * cp
-    if world % denom != 0:
-        raise ValueError(
-            f"world size {world} not divisible by tp*pp*cp = {denom}")
-    dp = world // denom
-    if data_parallel_size is not None and data_parallel_size != dp:
-        raise ValueError(
-            f"explicit data_parallel_size {data_parallel_size} inconsistent "
-            f"with world {world} / (tp*pp*cp) = {dp}")
-    if (dp * cp) % ep != 0:
-        raise ValueError(
-            f"dp*cp = {dp * cp} not divisible by expert parallel size {ep}")
-    dp_exp = dp * cp // ep
+    # shared divisibility rules — the placement planner prunes layouts by
+    # the same function, so a plan it emits always initializes here
+    from ..config import mesh_factorization
+
+    sizes = mesh_factorization(
+        world,
+        tensor_parallel_size=tensor_model_parallel_size,
+        pipeline_parallel_size=pipeline_model_parallel_size,
+        context_parallel_size=context_parallel_size,
+        expert_parallel_size=expert_model_parallel_size,
+        data_parallel_size=data_parallel_size,
+        dcn_data_parallel_size=dcn_data_parallel_size)
+    tp, pp, cp, ep = sizes["tp"], sizes["pp"], sizes["cp"], sizes["ep"]
+    dp, dp_exp = sizes["dp"], sizes["dp_exp"]
 
     if dcn_data_parallel_size and dcn_data_parallel_size > 1:
         arr = _hybrid_device_order(devices, (pp, dp, cp, tp),

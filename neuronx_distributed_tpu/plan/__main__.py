@@ -1,0 +1,170 @@
+"""CLI: rank parallelism placements and emit the winning config.
+
+::
+
+    python -m neuronx_distributed_tpu.plan --model llama2-7b --devices 32
+    python -m neuronx_distributed_tpu.plan --model bench-cpu --devices 8 \
+        --refine --yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from . import (ModelSpec, ServingSpec, default_hardware, handpicked_plan,
+               refine, render_kwargs, search, step_cost)
+from .emit import plan_to_config, plan_to_yaml_dict
+
+
+def _model_spec(name: str, *, seq: Optional[int], batch: int) -> ModelSpec:
+    from ..models import llama
+
+    key = name.lower().replace("_", "-")
+    presets = {
+        "llama2-7b": llama.LLAMA2_7B,
+        "llama2-70b": llama.LLAMA2_70B,
+        "llama3-8b": llama.LLAMA3_8B,
+        "tiny": llama.tiny_config(),
+        # the layout bench.py runs on CPU hosts — the acceptance target
+        "bench-cpu": llama.LlamaConfig(
+            vocab_size=1024, hidden_size=256, intermediate_size=704,
+            num_layers=4, num_heads=8, num_kv_heads=8, max_seq_len=512),
+    }
+    if key not in presets:
+        raise SystemExit(
+            f"unknown --model {name!r}; choose from {sorted(presets)}")
+    return ModelSpec.from_model_config(presets[key], seq=seq,
+                                       global_batch=batch, name=key)
+
+
+def _fmt_row(rank, plan, cost) -> str:
+    mem_gib = cost.memory["total"] / 2**30
+    return (f"{rank:>3}  {cost.total_s * 1e3:>10.3f}  "
+            f"{cost.compute_s * 1e3:>8.3f}  {cost.bubble_s * 1e3:>7.3f}  "
+            f"{cost.tp_comm_s * 1e3:>8.3f}  {cost.pp_comm_s * 1e3:>8.3f}  "
+            f"{cost.grad_comm_s * 1e3:>9.3f}  {mem_gib:>7.2f}  "
+            f"{plan.describe()}")
+
+
+_HEADER = (f"{'#':>3}  {'total ms':>10}  {'comp ms':>8}  {'bub ms':>7}  "
+           f"{'tp ms':>8}  {'pp ms':>8}  {'grad ms':>9}  {'GiB':>7}  plan")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m neuronx_distributed_tpu.plan",
+        description="Rank parallelism placements over the hierarchical "
+                    "mesh and emit the best one as a framework config "
+                    "(docs/planner.md)")
+    ap.add_argument("--model", default="bench-cpu",
+                    help="model preset (llama2-7b, llama2-70b, llama3-8b, "
+                         "tiny, bench-cpu)")
+    ap.add_argument("--devices", type=int, required=True,
+                    help="total device count to plan for")
+    ap.add_argument("--dcn", type=int, default=1, metavar="N",
+                    help="cross-slice (DCN) data-parallel degree of the "
+                         "fleet; 1 = single slice")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="global batch (sequences per step)")
+    ap.add_argument("--seq", type=int, default=None,
+                    help="sequence length (default: model's max_seq_len)")
+    ap.add_argument("--platform", default="tpu", choices=["tpu", "cpu"],
+                    help="hardware constants to model")
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="override per-device memory budget, GiB")
+    ap.add_argument("--serving", action="store_true",
+                    help="plan a serving deployment: single-stage layouts "
+                         "only, paged-KV pool charged to memory")
+    ap.add_argument("--refine", action="store_true",
+                    help="re-rank the analytic top-k with measured jitted "
+                         "proxies (uses whatever backend is available)")
+    ap.add_argument("--top-k", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--yaml", action="store_true",
+                    help="print the winning plan as converter-compatible "
+                         "YAML instead of a config call site")
+    ap.add_argument("--show-pruned", type=int, default=0, metavar="N",
+                    help="also list the first N pruned candidates with "
+                         "their machine-readable reasons")
+    args = ap.parse_args(argv)
+
+    spec = _model_spec(args.model, seq=args.seq, batch=args.batch)
+    hw = default_hardware(args.platform)
+    if args.hbm_gb is not None:
+        import dataclasses
+
+        hw = dataclasses.replace(hw, hbm_bytes=args.hbm_gb * 2**30)
+    serving = ServingSpec() if args.serving else None
+
+    result = search(spec, hw, args.devices, dcn_dp=args.dcn,
+                    serving=serving, top_k=args.top_k)
+    print(f"plan: {spec.name} on {args.devices} device(s) "
+          f"[{args.platform}], dcn={args.dcn}, batch={args.batch}, "
+          f"seq={spec.seq}: {result.n_enumerated} candidates, "
+          f"{len(result.ranked)} ranked, "
+          f"{len(result.rejected_with('indivisible'))} indivisible, "
+          f"{len(result.rejected_with('oom'))} oom, "
+          f"{len(result.rejected_with('dominated'))} dominated")
+    if not result.ranked:
+        print("plan: no feasible layout — every candidate was pruned "
+              "(raise --hbm-gb or change --devices)")
+        for p in result.rejected[:10]:
+            print(f"  pruned[{p.code}] {p.plan.describe()}: {p.detail}")
+        return 1
+
+    print(_HEADER)
+    for i, r in enumerate(result.ranked, 1):
+        print(_fmt_row(i, r.plan, r.cost))
+
+    best = result.best.plan
+    if args.refine:
+        refined = refine(result.ranked, spec, hw, seed=args.seed)
+        print("refined (measured proxy, min of 3):")
+        for i, r in enumerate(refined, 1):
+            print(f"{i:>3}  measured {r.measured_s * 1e3:10.3f} ms  "
+                  f"modeled {r.modeled_s * 1e3:10.3f} ms  "
+                  f"{r.plan.describe()}")
+        best = refined[0].plan
+
+    hand = handpicked_plan(args.devices, platform=args.platform,
+                           dcn_dp=args.dcn)
+    hand_cost = step_cost(hand, spec, hw, serving)
+    best_cost = step_cost(best, spec, hw, serving)
+    ratio = hand_cost.total_s / best_cost.total_s if best_cost.total_s else 1.0
+    print(f"handpicked baseline ({hand.describe()}): "
+          f"{hand_cost.total_s * 1e3:.3f} ms/step; best plan "
+          f"{best_cost.total_s * 1e3:.3f} ms/step "
+          f"({ratio:.2f}x advantage)")
+
+    if args.show_pruned:
+        for p in result.rejected[:args.show_pruned]:
+            by = f" (by {p.by.describe()})" if p.by else ""
+            print(f"  pruned[{p.code}] {p.plan.describe()}: {p.detail}{by}")
+
+    cfg = plan_to_config(best, init_mesh=False)   # validates
+    if args.yaml:
+        import json
+
+        print("emitted YAML config:")
+        print(json.dumps(plan_to_yaml_dict(best), indent=2))
+    else:
+        print("emitted config:")
+        print(render_kwargs(best))
+
+    # prove the emitted config really initializes when the runtime matches
+    import jax
+
+    if args.devices == len(jax.devices()):
+        plan_to_config(best, init_mesh=True)
+        from ..parallel import mesh as _mesh
+
+        print(f"mesh initialized: {dict(_mesh.get_mesh().shape)}")
+    else:
+        del cfg
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,480 @@
+"""Analytic cost model for parallelism placement.
+
+The model behind ``python -m neuronx_distributed_tpu.plan`` (PAPERS.md
+"Synthesizing Optimal Parallelism Placement and Reduction Strategies on
+Hierarchical Systems", arXiv:2110.10548): a per-step time and per-device
+memory estimate for one (mesh layout, reduction strategy) candidate, built
+from
+
+* **link tiers** — every mesh axis rides either ICI (within a slice) or
+  DCN (across slices, the ``dcn_data_parallel_size`` portion of the dp
+  axis). A ring collective over *n* ranks moves ``2·B·(n-1)/n`` bytes per
+  rank for an all-reduce (half for reduce-scatter / all-gather) and pays
+  ``n-1`` hop latencies per direction — the α-β model the paper's
+  synthesizer scores reduction strategies with.
+* **matmul shapes** from the model config (hidden/intermediate/heads/
+  vocab/seq): dense-layer FLOPs give the compute term, the Megatron-SP
+  activation footprint ``[tokens, hidden]`` gives the TP collective
+  volume, the parameter count gives the gradient collective volume.
+* **memory** — fp32 master params + grads + Adam moments (moments divided
+  by the ZeRO-1 shard group), activations under remat/SP, and the paged-KV
+  pool for serving plans (``inference.paging.pool_accounting``).
+
+Pure Python/maths on purpose: no jax import at module load, so the ``plan``
+lint rule and unit tests score thousands of candidates in milliseconds.
+The two places the model must agree with runtime behavior exactly — the
+TP-overlap engagement predicate and the compressed-collective wire ratio —
+delegate to ``ops.collective_matmul.shapes_tile`` (lazily) and mirror
+``parallel.comm_compressed.CompressionConfig.wire_bytes_per_element``
+(regression-pinned in tests/test_plan.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Optional
+
+
+# ---------------------------------------------------------------------------
+# Hardware description
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class LinkSpec:
+    """One link tier: sustained per-rank bandwidth and per-hop latency."""
+
+    bandwidth: float      # bytes/s each direction, per rank
+    latency: float        # seconds per ring hop
+
+
+@dataclass(frozen=True)
+class HardwareSpec:
+    """Per-device compute/memory plus the two link tiers.
+
+    Defaults approximate a TPU-v4-class chip. The absolute numbers only
+    set the scale — rankings depend on the *ratios* (ICI:DCN bandwidth,
+    FLOPs:bandwidth), which is what the refinement mode re-measures.
+    """
+
+    name: str = "tpu"
+    flops: float = 275e12          # peak bf16 FLOP/s per device
+    mfu: float = 0.4               # achievable fraction on dense matmuls
+    hbm_bytes: float = 32 * 2**30
+    ici: LinkSpec = LinkSpec(bandwidth=9.0e10, latency=1e-6)
+    dcn: LinkSpec = LinkSpec(bandwidth=3.125e9, latency=25e-6)
+    #: fraction of HBM a plan may budget (runtime/XLA scratch takes the rest)
+    memory_fraction: float = 0.92
+
+    @property
+    def memory_budget(self) -> float:
+        return self.hbm_bytes * self.memory_fraction
+
+
+def default_hardware(platform: str = "tpu") -> HardwareSpec:
+    """Per-platform defaults. The ``cpu`` spec models the 8-way virtual
+    test mesh: tiny compute, memcpy-grade "links" — rankings still
+    exercise every term, which is all the CPU tests need."""
+    if platform == "cpu":
+        return HardwareSpec(name="cpu", flops=5e10, mfu=0.5,
+                            hbm_bytes=4 * 2**30,
+                            ici=LinkSpec(bandwidth=8e9, latency=2e-6),
+                            dcn=LinkSpec(bandwidth=1e9, latency=50e-6))
+    return HardwareSpec()
+
+
+# ---------------------------------------------------------------------------
+# Model description
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """The shapes the cost model needs, decoupled from any framework
+    config class. ``from_model_config`` lifts a ``LlamaConfig``-style
+    dataclass (anything with hidden_size/num_layers/... attributes)."""
+
+    name: str
+    vocab: int
+    hidden: int
+    intermediate: int
+    layers: int
+    heads: int
+    kv_heads: int
+    seq: int
+    #: sequences per optimizer step across the whole job
+    global_batch: int
+    head_dim: Optional[int] = None
+    num_experts: int = 0
+    top_k: int = 0
+    param_bytes: int = 4        # fp32 masters
+    act_bytes: int = 2          # bf16 activations/compute
+
+    def __post_init__(self) -> None:
+        for f in ("vocab", "hidden", "intermediate", "layers", "heads",
+                  "kv_heads", "seq", "global_batch"):
+            v = getattr(self, f)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"ModelSpec.{f} must be a positive int, "
+                                 f"got {v!r}")
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden // self.heads
+
+    @property
+    def tokens_per_step(self) -> int:
+        return self.global_batch * self.seq
+
+    @classmethod
+    def from_model_config(cls, mcfg: Any, *, seq: Optional[int] = None,
+                          global_batch: int = 8,
+                          name: Optional[str] = None) -> "ModelSpec":
+        g = lambda attr, d=None: getattr(mcfg, attr, d)  # noqa: E731
+        return cls(
+            name=name or type(mcfg).__name__,
+            vocab=g("vocab_size"), hidden=g("hidden_size"),
+            intermediate=g("intermediate_size"), layers=g("num_layers"),
+            heads=g("num_heads"), kv_heads=g("num_kv_heads", g("num_heads")),
+            head_dim=g("head_dim"),
+            seq=seq or g("max_seq_len", 2048), global_batch=global_batch,
+            num_experts=g("num_experts", 0) or 0,
+            top_k=g("num_experts_per_tok", 0) or 0)
+
+
+def param_count(m: ModelSpec) -> int:
+    """Dense transformer parameters (embeddings + per-layer matmuls +
+    norms; MoE experts multiply the MLP block)."""
+    d = m.head_dim_
+    attn = m.hidden * (m.heads * d + 2 * m.kv_heads * d) + m.heads * d * m.hidden
+    mlp = 3 * m.hidden * m.intermediate
+    if m.num_experts > 1:
+        mlp *= m.num_experts
+    per_layer = attn + mlp + 2 * m.hidden
+    return m.vocab * m.hidden * 2 + m.layers * per_layer + m.hidden
+
+
+def step_flops(m: ModelSpec, remat: bool) -> float:
+    """Training FLOPs for one optimizer step: ``6·N·T`` for the dense
+    matmuls (fwd 2, bwd 4) plus the quadratic attention term; full remat
+    re-runs the forward once more (≈ ×4/3). MoE only pays for the
+    ``top_k`` routed experts."""
+    n_matmul = param_count(m) - m.vocab * m.hidden  # embed lookup is free
+    if m.num_experts > 1 and m.top_k:
+        active = 3 * m.hidden * m.intermediate * min(m.top_k, m.num_experts)
+        total = 3 * m.hidden * m.intermediate * m.num_experts
+        n_matmul -= m.layers * (total - active)
+    flops = 6.0 * n_matmul * m.tokens_per_step
+    # causal attention: 2 matmuls of [S, D]x[D, S] per head, halved by the
+    # causal mask, fwd+bwd -> 6 * T * S * hidden
+    flops += 6.0 * m.tokens_per_step * m.seq * m.heads * m.head_dim_ * 0.5
+    if remat:
+        flops *= 4.0 / 3.0
+    return flops
+
+
+# ---------------------------------------------------------------------------
+# Candidate plan
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Plan:
+    """One point in the search space: a mesh factorization plus the
+    reduction strategy. ``dp`` is the TOTAL data-parallel degree;
+    ``dcn_dp`` of it crosses DCN (1 = single slice)."""
+
+    devices: int
+    tp: int = 1
+    pp: int = 1
+    dp: int = 1
+    cp: int = 1
+    ep: int = 1
+    dcn_dp: int = 1
+    # reduction / overlap strategy
+    zero1: bool = True
+    grad_comm_dtype: str = "fp32"       # fp32 | int8 | fp8
+    grad_comm_hierarchical: bool = False
+    tp_overlap: bool = False
+    sequence_parallel: bool = False
+    remat: bool = True
+    num_microbatches: int = 1
+
+    def describe(self) -> str:
+        tags = [f"tp={self.tp}", f"pp={self.pp}", f"dp={self.dp}"]
+        if self.ep > 1:
+            tags.append(f"ep={self.ep}")
+        if self.dcn_dp > 1:
+            tags.append(f"dcn={self.dcn_dp}")
+        tags.append("zero1" if self.zero1 else "ddp")
+        tags.append(self.grad_comm_dtype
+                    + ("/hier" if self.grad_comm_hierarchical else "/flat"))
+        if self.tp_overlap:
+            tags.append("overlap")
+        if self.sequence_parallel:
+            tags.append("sp")
+        return " ".join(tags)
+
+
+@dataclass(frozen=True)
+class ServingSpec:
+    """Paged-KV pool sizing for serving plans (memory-only term)."""
+
+    num_blocks: int = 512
+    block_size: int = 16
+    quantized: bool = False
+    kv_bytes: int = 2
+
+
+# ---------------------------------------------------------------------------
+# Collective primitives (α-β ring model)
+# ---------------------------------------------------------------------------
+
+def ring_all_reduce_s(nbytes: float, n: int, link: LinkSpec) -> float:
+    if n <= 1 or nbytes <= 0:
+        return 0.0
+    return 2.0 * nbytes * (n - 1) / n / link.bandwidth \
+        + 2.0 * (n - 1) * link.latency
+
+
+def ring_reduce_scatter_s(nbytes: float, n: int, link: LinkSpec) -> float:
+    if n <= 1 or nbytes <= 0:
+        return 0.0
+    return nbytes * (n - 1) / n / link.bandwidth + (n - 1) * link.latency
+
+
+def ring_all_gather_s(nbytes: float, n: int, link: LinkSpec) -> float:
+    return ring_reduce_scatter_s(nbytes, n, link)
+
+
+def all_to_all_s(nbytes: float, n: int, link: LinkSpec) -> float:
+    if n <= 1 or nbytes <= 0:
+        return 0.0
+    return nbytes * (n - 1) / n / link.bandwidth + (n - 1) * link.latency
+
+
+def wire_bytes_per_element(dtype: str, block_size: int = 256) -> float:
+    """Bytes per fp32 gradient element on the wire for the compressed
+    collectives: 1 quantized byte + one fp32 scale per block. Delegates
+    to the static accounting exported by parallel/comm_compressed.py so
+    the model charges exactly what the collectives ship; the closed-form
+    fallback keeps this module importable without jax (equality is
+    regression-pinned in tests/test_plan.py)."""
+    try:
+        from ..parallel.comm_compressed import (
+            wire_bytes_per_element as _impl,
+        )
+    except ImportError:
+        if dtype == "fp32":
+            return 4.0
+        if dtype in ("int8", "fp8"):
+            return 1.0 + 4.0 / block_size
+        raise ValueError(f"unknown grad_comm_dtype {dtype!r}")
+    return _impl(dtype, block_size)
+
+
+# ---------------------------------------------------------------------------
+# Per-term costs
+# ---------------------------------------------------------------------------
+
+def tp_overlap_engagement(plan: Plan, m: ModelSpec) -> bool:
+    """Would the ``tp_overlap_comm`` auto knob actually decompose at this
+    plan's layer shapes? Shares ``ops.collective_matmul``'s tiling rule —
+    the planner must never recommend overlap the layers would silently
+    fall back from. Evaluated at the SP-MLP exit shape ``[B_mb, S, f/tp]``
+    streamed over dim 1 (the strictest site: delivery needs ``S % tp``)
+    and the ring-size floor the auto knob applies."""
+    if plan.tp <= 1:
+        return False
+    from ..ops.collective_matmul import MIN_AUTO_AXIS_SIZE, shapes_tile
+
+    b_mb = max(1, m.global_batch // max(1, plan.dp * plan.num_microbatches))
+    entry = shapes_tile((b_mb, max(1, m.seq // plan.tp), m.hidden), 1,
+                        plan.tp, needs_divisible=False)
+    exit_ = shapes_tile((b_mb, m.seq, m.intermediate // plan.tp or 1), 1,
+                        plan.tp, needs_divisible=True)
+    return entry and exit_ and plan.tp >= MIN_AUTO_AXIS_SIZE
+
+
+#: fraction of decomposed-ring transfer time hidden behind the per-shard
+#: partial matmuls when overlap engages (bench.py --overlap measures the
+#: realized value; docs/tp_overlap.md)
+TP_OVERLAP_HIDDEN_FRACTION = 0.7
+
+
+def tp_comm_s(plan: Plan, m: ModelSpec, hw: HardwareSpec) -> float:
+    """Activation collectives of the TP layers over one step. Per layer,
+    Megatron-SP moves 2 all-gathers + 2 reduce-scatters of
+    ``[tokens_local, hidden]`` forward and the duals backward."""
+    if plan.tp <= 1:
+        return 0.0
+    tokens_local = m.tokens_per_step / plan.dp   # per TP group
+    nbytes = tokens_local * m.hidden * m.act_bytes
+    per_layer = 4 * (ring_all_gather_s(nbytes, plan.tp, hw.ici)
+                     + ring_reduce_scatter_s(nbytes, plan.tp, hw.ici))
+    total = m.layers * per_layer
+    # vocab-parallel lm_head/embedding collectives: one AG+RS pair fwd+bwd
+    total += 4 * (ring_all_gather_s(nbytes, plan.tp, hw.ici)
+                  + ring_reduce_scatter_s(nbytes, plan.tp, hw.ici))
+    if plan.tp_overlap and tp_overlap_engagement(plan, m):
+        total *= 1.0 - TP_OVERLAP_HIDDEN_FRACTION
+    return total
+
+
+def grad_comm_s(plan: Plan, m: ModelSpec, hw: HardwareSpec) -> float:
+    """Gradient reduction across the data axes. Flat: one ring over the
+    full dp degree — over DCN links as soon as any of it crosses slices.
+    Hierarchical (two-stage, PR 3): reduce-scatter + all-gather over the
+    intra-slice part at ICI speed, and only ``1/n_fast`` of the payload
+    all-reduced across slices. Compression scales the wire bytes; ZeRO-1
+    replaces the all-reduce with an equal-volume RS + AG."""
+    if plan.dp <= 1:
+        return 0.0
+    shard_elems = param_count(m) / (plan.tp * plan.pp)
+    nbytes = shard_elems * wire_bytes_per_element(plan.grad_comm_dtype)
+    n, dcn = plan.dp, plan.dcn_dp
+    if dcn <= 1:
+        return ring_all_reduce_s(nbytes, n, hw.ici)
+    if not plan.grad_comm_hierarchical:
+        # the ring interleaves slices: every step is paced by DCN
+        return ring_all_reduce_s(nbytes, n, hw.dcn)
+    n_fast = n // dcn
+    fast = (ring_reduce_scatter_s(nbytes, n_fast, hw.ici)
+            + ring_all_gather_s(nbytes, n_fast, hw.ici))
+    slow = ring_all_reduce_s(nbytes / max(1, n_fast), dcn, hw.dcn)
+    return fast + slow
+
+
+def pp_comm_s(plan: Plan, m: ModelSpec, hw: HardwareSpec) -> float:
+    """Stage-boundary activation sends: each microbatch crosses ``pp-1``
+    boundaries forward and backward."""
+    if plan.pp <= 1:
+        return 0.0
+    tokens_local = m.tokens_per_step / plan.dp
+    nbytes = tokens_local * m.hidden * m.act_bytes
+    if plan.sequence_parallel and plan.tp > 1:
+        nbytes /= plan.tp
+    return 2.0 * (plan.pp - 1) * (nbytes / hw.ici.bandwidth
+                                  + plan.num_microbatches * hw.ici.latency)
+
+
+def ep_comm_s(plan: Plan, m: ModelSpec, hw: HardwareSpec) -> float:
+    """MoE token dispatch: all-to-all of the routed tokens into the expert
+    groups and back, forward and backward (4 per layer)."""
+    if plan.ep <= 1 or m.num_experts <= 1:
+        return 0.0
+    tokens_local = m.tokens_per_step / plan.dp
+    nbytes = tokens_local * m.hidden * m.act_bytes * max(1, m.top_k)
+    return m.layers * 4.0 * all_to_all_s(nbytes, plan.ep, hw.ici)
+
+
+def compute_s(plan: Plan, m: ModelSpec, hw: HardwareSpec) -> float:
+    return step_flops(m, plan.remat) / (plan.devices * hw.flops * hw.mfu)
+
+
+def bubble_fraction(plan: Plan) -> float:
+    """1F1B pipeline bubble: ``(pp-1)/mb`` extra idle time per step."""
+    if plan.pp <= 1:
+        return 0.0
+    return (plan.pp - 1) / max(1, plan.num_microbatches)
+
+
+# ---------------------------------------------------------------------------
+# Memory model
+# ---------------------------------------------------------------------------
+
+def memory_bytes(plan: Plan, m: ModelSpec, hw: HardwareSpec,
+                 serving: Optional[ServingSpec] = None) -> dict:
+    """Per-device bytes: fp32 masters + bf16 compute copy + fp32 grads +
+    Adam moments (ZeRO-1 shards the moments over the dp group), layer
+    activations under remat/SP, and the paged-KV pool for serving."""
+    shard = param_count(m) / (plan.tp * plan.pp)
+    params = shard * (m.param_bytes + m.act_bytes)   # master + compute copy
+    grads = shard * 4.0
+    opt = shard * 8.0 / (plan.dp if plan.zero1 else 1)
+
+    seqs_replica = max(1, m.global_batch // max(1, plan.dp))
+    tokens_mb = seqs_replica * m.seq / max(1, plan.num_microbatches)
+    layers_here = max(1, m.layers // plan.pp)
+    tp_eff = plan.tp if (plan.sequence_parallel and plan.tp > 1) else 1
+    if plan.remat:
+        per_layer = tokens_mb * m.hidden * m.act_bytes * 2 / tp_eff
+    else:
+        per_layer = tokens_mb * (18 * m.hidden + 4 * m.intermediate) \
+            * m.act_bytes / tp_eff
+    inflight = min(plan.num_microbatches, plan.pp) if plan.pp > 1 else 1
+    acts = layers_here * per_layer * inflight
+
+    kv = 0.0
+    if serving is not None:
+        kv = _kv_pool_bytes(m, serving, plan.tp)
+    total = params + grads + opt + acts + kv
+    return dict(params=params, grads=grads, opt=opt, acts=acts, kv=kv,
+                total=total)
+
+
+def _kv_pool_bytes(m: ModelSpec, s: ServingSpec, tp: int) -> float:
+    """Paged-pool bytes per device; delegates to the pool's own accounting
+    (``inference.paging.pool_accounting``) so planner numbers track the
+    arrays the engine actually allocates. Falls back to the closed form
+    when jax isn't importable (pure-math contexts)."""
+    try:
+        from ..inference.paging import pool_accounting
+
+        return pool_accounting(
+            num_layers=m.layers, num_blocks=s.num_blocks,
+            block_size=s.block_size, num_kv_heads=m.kv_heads,
+            head_dim=m.head_dim_, kv_bytes=s.kv_bytes,
+            quantized=s.quantized, tp_size=tp)
+    except ImportError:  # pragma: no cover - jax-free fallback
+        per_elem = (1 + 4.0 / m.head_dim_) if s.quantized else s.kv_bytes
+        return (2.0 * m.layers * s.num_blocks * s.block_size
+                * m.kv_heads * m.head_dim_ * per_elem) / tp
+
+
+# ---------------------------------------------------------------------------
+# Assembled breakdown
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CostBreakdown:
+    """Per-term step time (seconds) and per-device memory (bytes)."""
+
+    compute_s: float
+    bubble_s: float
+    tp_comm_s: float
+    pp_comm_s: float
+    ep_comm_s: float
+    grad_comm_s: float
+    memory: dict
+
+    @property
+    def total_s(self) -> float:
+        return (self.compute_s + self.bubble_s + self.tp_comm_s
+                + self.pp_comm_s + self.ep_comm_s + self.grad_comm_s)
+
+    def to_dict(self) -> dict:
+        d = {f.name: getattr(self, f.name)
+             for f in dataclasses.fields(self) if f.name != "memory"}
+        d["total_s"] = self.total_s
+        d["memory"] = dict(self.memory)
+        return d
+
+
+def step_cost(plan: Plan, m: ModelSpec, hw: HardwareSpec,
+              serving: Optional[ServingSpec] = None) -> CostBreakdown:
+    """One training step of ``plan`` on ``hw``: per-term times + memory.
+
+    Comm terms are summed, not overlapped (except the modeled TP-overlap
+    discount) — a deliberately pessimistic serialization that preserves
+    ranking monotonicity: more bytes over a slower tier never gets
+    cheaper (asserted in tests/test_plan.py).
+    """
+    comp = compute_s(plan, m, hw)
+    tp = tp_comm_s(plan, m, hw)
+    return CostBreakdown(
+        compute_s=comp,
+        bubble_s=(comp + tp) * bubble_fraction(plan),
+        tp_comm_s=tp,
+        pp_comm_s=pp_comm_s(plan, m, hw),
+        ep_comm_s=ep_comm_s(plan, m, hw),
+        grad_comm_s=grad_comm_s(plan, m, hw),
+        memory=memory_bytes(plan, m, hw, serving))

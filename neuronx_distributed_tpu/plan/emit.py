@@ -1,0 +1,91 @@
+"""Turn a winning :class:`~.cost.Plan` into a framework config.
+
+Three forms, each derived from the previous so they cannot drift:
+
+* :func:`plan_to_config_kwargs` — the kwargs dict for
+  ``neuronx_distributed_config(...)``;
+* :func:`plan_to_config` — the validated :class:`~..config.NxDConfig`
+  (optionally initializing the global mesh when the plan's device count
+  matches the runtime's);
+* :func:`plan_to_yaml_dict` — a YAML-able dict accepted verbatim by
+  ``scripts/yaml_converter.dict_to_config_kwargs`` (and therefore by the
+  YAML training launchers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .cost import Plan
+
+
+def plan_to_config_kwargs(plan: Plan) -> Dict[str, Any]:
+    """``neuronx_distributed_config(...)`` kwargs implementing ``plan``.
+
+    Only non-default knobs are emitted, so the dict doubles as the
+    minimal hand-written call site. ``tp_overlap_comm`` stays ``None``
+    (auto) when the planner chose no overlap — auto would make the same
+    call at runtime — and is pinned ``True`` when the plan costs the
+    overlap discount, so the emitted config cannot silently lose it.
+    """
+    from ..config import OptimizerConfig, PipelineConfig
+
+    kwargs: Dict[str, Any] = {}
+    if plan.tp > 1:
+        kwargs["tensor_parallel_size"] = plan.tp
+    if plan.pp > 1:
+        kwargs["pipeline_parallel_size"] = plan.pp
+    if plan.cp > 1:
+        kwargs["context_parallel_size"] = plan.cp
+    if plan.ep > 1:
+        kwargs["expert_parallel_size"] = plan.ep
+    if plan.dcn_dp > 1:
+        kwargs["dcn_data_parallel_size"] = plan.dcn_dp
+    if plan.tp_overlap:
+        kwargs["tp_overlap_comm"] = True
+    if plan.sequence_parallel:
+        kwargs["sequence_parallel"] = True
+    opt = OptimizerConfig(
+        zero_one_enabled=plan.zero1,
+        grad_comm_dtype=plan.grad_comm_dtype,
+        grad_comm_hierarchical=plan.grad_comm_hierarchical)
+    if opt != OptimizerConfig():
+        kwargs["optimizer_config"] = opt
+    if plan.pp > 1:
+        kwargs["pipeline_config"] = PipelineConfig(
+            num_microbatches=plan.num_microbatches)
+    if plan.remat:
+        from ..config import ActivationCheckpointConfig
+
+        kwargs["activation_checkpoint_config"] = \
+            ActivationCheckpointConfig(mode="full")
+    return kwargs
+
+
+def plan_to_config(plan: Plan, *, init_mesh: bool = False):
+    """Build the validated :class:`~..config.NxDConfig` for ``plan``.
+
+    With ``init_mesh=True`` the global mesh is initialized too — only
+    valid when ``plan.devices`` matches ``jax.device_count()``.
+    """
+    from ..config import neuronx_distributed_config
+
+    return neuronx_distributed_config(init_mesh=init_mesh,
+                                      **plan_to_config_kwargs(plan))
+
+
+def plan_to_yaml_dict(plan: Plan) -> Dict[str, Any]:
+    """YAML document for ``plan``, round-trippable through
+    ``scripts.yaml_converter.dict_to_config_kwargs``."""
+    from ..scripts.yaml_converter import config_to_dict
+
+    return config_to_dict(plan_to_config(plan))
+
+
+def render_kwargs(plan: Plan) -> str:
+    """The emitted config as a copy-pasteable call site string."""
+    parts = []
+    for key, value in plan_to_config_kwargs(plan).items():
+        parts.append(f"    {key}={value!r},")
+    body = "\n".join(parts)
+    return f"neuronx_distributed_config(\n{body}\n)"

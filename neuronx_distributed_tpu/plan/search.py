@@ -1,0 +1,212 @@
+"""Plan search: enumerate mesh factorizations × reduction strategies,
+prune with machine-checkable reasons, rank by modeled step cost.
+
+Every candidate the enumerator produces is accounted for: it either lands
+in ``SearchResult.ranked`` or in ``SearchResult.rejected`` as a
+``Pruned`` record whose ``code`` is one of
+
+* ``"indivisible"`` — the factorization violates a divisibility
+  constraint (mesh: world % tp·pp·cp, dp % dcn_dp — exactly the checks
+  ``config.mesh_factorization`` applies at runtime; model: heads % tp,
+  layers % pp, batch % dp, seq % tp under SP);
+* ``"oom"`` — the memory model exceeds ``HardwareSpec.memory_budget``;
+* ``"dominated"`` — a cheaper plan exists (``by`` names it).
+
+``n_enumerated == len(ranked) + len(rejected)`` always holds (asserted in
+tests/test_plan.py), which is what makes "exhaustive or pruned with a
+reason" a testable property rather than a comment.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field, replace
+from typing import List, Optional
+
+from .cost import (CostBreakdown, HardwareSpec, ModelSpec, Plan,
+                   ServingSpec, step_cost, tp_overlap_engagement)
+
+PRUNE_INDIVISIBLE = "indivisible"
+PRUNE_OOM = "oom"
+PRUNE_DOMINATED = "dominated"
+
+
+@dataclass(frozen=True)
+class Pruned:
+    """A rejected candidate with its machine-readable reason."""
+
+    plan: Plan
+    code: str              # one of the PRUNE_* constants
+    detail: str            # human-readable specifics
+    by: Optional[Plan] = None   # the dominating plan, for "dominated"
+
+
+@dataclass(frozen=True)
+class RankedPlan:
+    plan: Plan
+    cost: CostBreakdown
+
+    @property
+    def total_s(self) -> float:
+        return self.cost.total_s
+
+
+@dataclass
+class SearchResult:
+    ranked: List[RankedPlan] = field(default_factory=list)
+    rejected: List[Pruned] = field(default_factory=list)
+    n_enumerated: int = 0
+
+    @property
+    def best(self) -> Optional[RankedPlan]:
+        return self.ranked[0] if self.ranked else None
+
+    def rejected_with(self, code: str) -> List[Pruned]:
+        return [p for p in self.rejected if p.code == code]
+
+
+# ---------------------------------------------------------------------------
+# Enumeration
+# ---------------------------------------------------------------------------
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def _layout_error(plan: Plan, m: ModelSpec) -> Optional[str]:
+    """Divisibility checks: the mesh's own (via ``mesh_factorization``,
+    the same code ``initialize_model_parallel`` runs) plus the model-shape
+    constraints the sharded layers impose."""
+    from ..config import mesh_factorization
+
+    try:
+        mesh_factorization(plan.devices,
+                           tensor_parallel_size=plan.tp,
+                           pipeline_parallel_size=plan.pp,
+                           context_parallel_size=plan.cp,
+                           expert_parallel_size=plan.ep,
+                           data_parallel_size=plan.dp,
+                           dcn_data_parallel_size=plan.dcn_dp)
+    except ValueError as e:
+        return str(e)
+    if m.heads % plan.tp:
+        return f"num_heads {m.heads} not divisible by tp {plan.tp}"
+    if m.kv_heads % plan.tp and plan.tp % m.kv_heads:
+        return (f"num_kv_heads {m.kv_heads} incompatible with tp {plan.tp}"
+                " (neither divides the other)")
+    if m.layers % plan.pp:
+        return f"num_layers {m.layers} not divisible by pp {plan.pp}"
+    if m.global_batch % plan.dp:
+        return f"global_batch {m.global_batch} not divisible by dp {plan.dp}"
+    if plan.sequence_parallel and m.seq % plan.tp:
+        return f"seq {m.seq} not divisible by tp {plan.tp} (sequence_parallel)"
+    if plan.num_microbatches > 1:
+        per = m.global_batch // plan.dp
+        if per % plan.num_microbatches:
+            return (f"per-replica batch {per} not divisible by "
+                    f"num_microbatches {plan.num_microbatches}")
+    if plan.ep > 1 and m.num_experts % plan.ep:
+        return f"num_experts {m.num_experts} not divisible by ep {plan.ep}"
+    return None
+
+
+def _strategies(plan: Plan, m: ModelSpec) -> List[Plan]:
+    """Reduction/overlap strategy combos for one mesh layout. Overlap is
+    only proposed where it engages (shared predicate with the op), and
+    hierarchical/compressed variants only where a data axis exists."""
+    dtypes = ["fp32"] if plan.dp == 1 else ["fp32", "int8"]
+    hiers = [False] if plan.dcn_dp <= 1 else [False, True]
+    overlaps = [False]
+    sp = plan.tp > 1 and m.seq % plan.tp == 0
+    probe = replace(plan, sequence_parallel=sp, tp_overlap=True)
+    if tp_overlap_engagement(probe, m):
+        overlaps.append(True)
+    out = []
+    for dt, hi, ov, rm in itertools.product(dtypes, hiers, overlaps,
+                                            (False, True)):
+        out.append(replace(plan, grad_comm_dtype=dt,
+                           grad_comm_hierarchical=hi, tp_overlap=ov,
+                           sequence_parallel=sp, remat=rm,
+                           zero1=plan.dp > 1))
+    return out
+
+
+def enumerate_plans(m: ModelSpec, devices: int, *,
+                    dcn_dp: int = 1,
+                    max_tp: Optional[int] = None,
+                    serving: bool = False) -> List[Plan]:
+    """All (tp, pp, dp) divisor triples of ``devices`` × strategy combos.
+    Includes invalid factorizations on purpose — the search prunes them
+    with reasons instead of silently skipping. ``dcn_dp`` is the fixed
+    cross-slice degree of the job (a property of the fleet, not a free
+    search variable): layouts must fold it into their dp."""
+    plans: List[Plan] = []
+    cap = max_tp or devices
+    eps = [1]
+    if m.num_experts > 1:
+        eps += [e for e in _divisors(devices) if 1 < e <= m.num_experts]
+    for tp in _divisors(devices):
+        if tp > cap:
+            continue
+        for pp in _divisors(devices // tp):
+            dp = devices // (tp * pp)
+            if serving and pp > 1:
+                continue    # serving engine is single-stage
+            for ep in eps:
+                mbs = [1] if pp == 1 else sorted(
+                    {pp, 2 * pp, max(1, m.global_batch // max(1, dp))})
+                for mb in mbs:
+                    plans.extend(_strategies(
+                        Plan(devices=devices, tp=tp, pp=pp, dp=dp, ep=ep,
+                             dcn_dp=dcn_dp, num_microbatches=mb), m))
+    return plans
+
+
+# ---------------------------------------------------------------------------
+# Search
+# ---------------------------------------------------------------------------
+
+def search(m: ModelSpec, hw: HardwareSpec, devices: int, *,
+           dcn_dp: int = 1, max_tp: Optional[int] = None,
+           serving: Optional[ServingSpec] = None,
+           top_k: int = 5) -> SearchResult:
+    """Enumerate, prune, rank. Returns every candidate either ranked or
+    rejected-with-reason; ``ranked`` keeps the ``top_k`` cheapest plus is
+    sorted ascending by modeled step time (stable tie-break on the plan
+    tuple so results are deterministic)."""
+    result = SearchResult()
+    candidates = enumerate_plans(m, devices, dcn_dp=dcn_dp, max_tp=max_tp,
+                                 serving=serving is not None)
+    result.n_enumerated = len(candidates)
+
+    scored: List[RankedPlan] = []
+    for plan in candidates:
+        err = _layout_error(plan, m)
+        if err is not None:
+            result.rejected.append(Pruned(plan, PRUNE_INDIVISIBLE, err))
+            continue
+        cost = step_cost(plan, m, hw, serving)
+        mem = cost.memory["total"]
+        if mem > hw.memory_budget:
+            result.rejected.append(Pruned(
+                plan, PRUNE_OOM,
+                f"needs {mem / 2**30:.2f} GiB/device, budget "
+                f"{hw.memory_budget / 2**30:.2f} GiB"))
+            continue
+        scored.append(RankedPlan(plan, cost))
+
+    scored.sort(key=lambda r: (r.total_s, _plan_key(r.plan)))
+    result.ranked = scored[:top_k]
+    best = scored[0] if scored else None
+    for r in scored[top_k:]:
+        result.rejected.append(Pruned(
+            r.plan, PRUNE_DOMINATED,
+            f"modeled {r.total_s * 1e3:.3f} ms/step vs "
+            f"{best.total_s * 1e3:.3f} ms for the best plan",
+            by=best.plan))
+    return result
+
+
+def _plan_key(p: Plan) -> tuple:
+    return (p.tp, p.pp, p.dp, p.ep, p.num_microbatches,
+            p.grad_comm_dtype, p.grad_comm_hierarchical, p.tp_overlap)

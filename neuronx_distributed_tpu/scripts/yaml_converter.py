@@ -59,11 +59,34 @@ def dict_to_config_kwargs(doc: Dict[str, Any]) -> Dict[str, Any]:
             kwargs[name] = cls(**value)
         elif key in ("tensor_parallel_size", "pipeline_parallel_size",
                      "context_parallel_size", "expert_parallel_size",
-                     "dcn_data_parallel_size", "sequence_parallel", "seed"):
+                     "dcn_data_parallel_size", "tp_overlap_comm",
+                     "sequence_parallel", "seed"):
             kwargs[key] = value
         else:
             raise ValueError(f"unknown config key {key!r}")
     return kwargs
+
+
+def config_to_dict(cfg) -> Dict[str, Any]:
+    """The inverse of :func:`dict_to_config_kwargs`: an
+    :class:`..config.NxDConfig` back to a YAML-able dict such that
+    ``dict_to_config_kwargs(config_to_dict(cfg))`` rebuilds ``cfg``
+    exactly. Sections and scalars that still hold their defaults are
+    elided, so emitted YAML stays as terse as hand-written files."""
+    kwargs = cfg.to_config_kwargs()
+    doc: Dict[str, Any] = {}
+    for section, (kwarg, cls) in _SECTIONS.items():
+        value = kwargs.pop(kwarg)
+        if value != cls():
+            doc[section] = dataclasses.asdict(value)
+    for key, value in kwargs.items():
+        default = None if key in ("dcn_data_parallel_size",
+                                  "tp_overlap_comm") else (
+            False if key == "sequence_parallel" else
+            0 if key == "seed" else 1)
+        if value != default:
+            doc[key] = value
+    return doc
 
 
 def load_yaml_config(path: str, init_mesh: bool = False):

@@ -1,0 +1,36 @@
+"""Fixture: plan-rule violations (never imported, only parsed)."""
+
+from neuronx_distributed_tpu import (OptimizerConfig, PipelineConfig,
+                                     neuronx_distributed_config)
+
+
+def bubble_dominated():
+    # pp=8 with a single microbatch: the 1F1B bubble idles 7/8 of the
+    # pipeline every step — the planner's best at 8 devices is far
+    # cheaper (more microbatches, or tp/dp instead)
+    return neuronx_distributed_config(
+        tensor_parallel_size=1,
+        pipeline_parallel_size=8,
+        pipeline_config=PipelineConfig(num_microbatches=1),
+    )
+
+
+def flat_fp32_across_dcn():
+    # 4 slices over DCN but gradients ride a flat fp32 ring paced by the
+    # slow tier; hierarchical two-stage + int8 wire dtype dominates
+    return neuronx_distributed_config(
+        tensor_parallel_size=2,
+        dcn_data_parallel_size=4,
+        optimizer_config=OptimizerConfig(zero_one_enabled=True),
+    )
+
+
+def data_driven_is_fine(kwargs):
+    # non-literal call site: the layout comes from data, not a hand
+    # commitment — the rule must NOT fire here
+    return neuronx_distributed_config(**kwargs)
+
+
+def defaults_are_fine():
+    # single-device defaults: nothing committed, nothing to judge
+    return neuronx_distributed_config()

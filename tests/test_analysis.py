@@ -115,6 +115,34 @@ def test_tp_overlap_exempts_parallel_and_ops_packages():
     assert [f.rule for f in flagged] == ["tp-overlap"]
 
 
+def test_plan_fires_on_fixture():
+    fs = _lint("bad_handrolled_config.py", select=["plan"])
+    assert _rules(fs) == {"plan"}
+    # bubble-dominated pp and flat-fp32-over-DCN fire; the **kwargs and
+    # defaults-only call sites stay quiet
+    assert len([f for f in fs if not f.suppressed]) == 2
+    msgs = " | ".join(f.message for f in fs)
+    assert "pp=8" in msgs and "dcn=4" in msgs
+    assert "python -m neuronx_distributed_tpu.plan" in msgs
+
+
+def test_plan_skips_nonliteral_and_emitted_call_sites():
+    src = ("from neuronx_distributed_tpu import neuronx_distributed_config\n"
+           "def run(tp, kw):\n"
+           "    a = neuronx_distributed_config(tensor_parallel_size=tp,\n"
+           "                                   pipeline_parallel_size=8)\n"
+           "    return neuronx_distributed_config(**kw)\n")
+    assert analyze_source(src, "mytrainer/launch.py",
+                          axes=DEFAULT_AXES) == []
+    # the planner's own emitter is exempt even with literal kwargs
+    bad = ("from neuronx_distributed_tpu import neuronx_distributed_config\n"
+           "cfg = neuronx_distributed_config(pipeline_parallel_size=8)\n")
+    assert analyze_source(bad, "neuronx_distributed_tpu/plan/emit.py",
+                          axes=DEFAULT_AXES) == []
+    flagged = analyze_source(bad, "mytrainer/launch.py", axes=DEFAULT_AXES)
+    assert [f.rule for f in flagged] == ["plan"]
+
+
 def test_recompile_hazard_fires_on_fixture():
     fs = _lint("bad_recompile.py")
     assert _rules(fs) == {"recompile-hazard"}
@@ -296,7 +324,7 @@ def test_cli_nonzero_on_fixture_corpus():
     assert out_rules == {"mesh-axis", "trace-safety", "custom-vjp",
                          "recompile-hazard", "resilience",
                          "comm-compression", "tp-overlap",
-                         "serving-resilience", "paging-refcount"}
+                         "serving-resilience", "paging-refcount", "plan"}
 
 
 def test_cli_zero_on_clean_file():
