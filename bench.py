@@ -252,6 +252,15 @@ def main(chaos_spec=None, serving=False, overlap=False, router=False,
 
             traceback.print_exc()
             print(f"bench: tp-overlap metric failed: {e!r}", file=sys.stderr)
+        # activation-collective compression (docs/comm_compression.md):
+        # quantized-wire MLP vs fp32 rings + an e2e llama loss-delta drill
+        try:
+            aux.update(tp_act_metric(platform, n_dev))
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: tp-act metric failed: {e!r}", file=sys.stderr)
 
     # placement-planner drill (docs/planner.md): opt-in via --plan; the
     # analytic search at this host's device count vs the hand-picked
@@ -947,6 +956,123 @@ def tp_overlap_metric(platform: str, n_dev: int) -> dict:
         f"tp_overlap_engaged_{platform}{n_dev}": {
             "value": bool(is_engaged), "unit": "bool",
             "vs_baseline": 1.0},
+    }
+
+
+def tp_act_metric(platform: str, n_dev: int) -> dict:
+    """Activation-collective compression (docs/comm_compression.md,
+    activations section): the quantized-wire llama MLP pair vs the fp32
+    rings, plus an e2e loss-delta drill — a short tiny-llama training run
+    at int8 activation wires vs fp32 on the explicit shard_map path
+    (tp bound, so the quantized collectives actually engage). RETURNS aux
+    entries keyed by metric name.
+
+    ``tp_act_wire_ratio`` is the hardware-independent number (bytes on the
+    fp32 wire / bytes on the quantized wire at the codec's accounting);
+    on CPU the quantize arithmetic usually outweighs the memcpy "wire",
+    so ``tp_act_quant_speedup`` below 1.0 there is honest, not a bug.
+    """
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_tpu.ops import collective_matmul as cm
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.parallel.wire_codec import CompressionConfig
+
+    wire = cm.wire_config("int8")
+    ratio = 4.0 / CompressionConfig(dtype="int8").wire_bytes_per_element
+
+    ps.destroy_model_parallel()
+    tp = 1
+    while tp * 2 <= min(n_dev, 8) and n_dev % (tp * 2) == 0:
+        tp *= 2
+    ps.initialize_model_parallel(tensor_model_parallel_size=tp)
+    mesh = ps.get_mesh()
+    batch, seq, hidden, inter = 4, 512, 256, 704
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, seq // tp, hidden)
+                    .astype(np.float32) * 0.1)
+    wu = jnp.asarray(rng.randn(hidden, inter // tp)
+                     .astype(np.float32) * 0.1)
+    wd = jnp.asarray(rng.randn(inter // tp, hidden)
+                     .astype(np.float32) * 0.1)
+
+    def make(wirev):
+        def mlp(xv, wuv, wdv):
+            h = jax.nn.silu(cm.all_gather_matmul(
+                xv, wuv, "tp", 1, impl="decomposed", wire=wirev))
+            return cm.matmul_reduce_scatter(h, wdv, "tp", 1,
+                                            impl="decomposed", wire=wirev)
+
+        return jax.jit(ps.shard_map(
+            mlp, mesh,
+            in_specs=(P(None, "tp", None), P(None, "tp"), P("tp", None)),
+            out_specs=P(None, "tp", None)))
+
+    def timed(f):
+        jax.block_until_ready(f(x, wu, wd))  # compile + warm
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(x, wu, wd))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_fp = timed(make(None))
+    t_q = timed(make(wire))
+    speedup = (t_fp / t_q) if tp > 1 else 1.0
+
+    # e2e loss delta: the explicit shard_map gradient path binds tp, so
+    # the int8 run really ships quantized activation collectives
+    def drill(act_dtype, steps=10):
+        import neuronx_distributed_tpu as nxd
+        from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                          tiny_config)
+        from neuronx_distributed_tpu.parallel import comm_compressed as cc
+        from neuronx_distributed_tpu.trainer import (
+            initialize_parallel_model, initialize_parallel_optimizer,
+            make_train_step)
+
+        ps.destroy_model_parallel()
+        cfg = nxd.neuronx_distributed_config(
+            tensor_parallel_size=min(2, n_dev))
+        mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                           activation_comm_dtype=act_dtype)
+        model = LlamaForCausalLM(mcfg)
+        ids = jax.random.randint(jax.random.key(0), (8, 33), 0,
+                                 mcfg.vocab_size)
+        b = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+        pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                               b["input_ids"])
+        tx, state, sh = initialize_parallel_optimizer(pm, params,
+                                                      learning_rate=1e-3)
+        step = make_train_step(pm, tx, sh,
+                               compression=cc.CompressionConfig(dtype="fp32"),
+                               donate=False)
+        loss = float("nan")
+        for _ in range(steps):
+            state, metrics = step(state, b)
+            loss = float(metrics["loss"])
+        return loss
+
+    loss_fp = drill("fp32")
+    loss_q = drill("int8")
+    delta = abs(loss_q - loss_fp) / max(abs(loss_fp), 1e-9)
+    ps.destroy_model_parallel()
+    print(f"bench: tp-act mlp tp={tp}: fp32={t_fp * 1e3:.2f}ms "
+          f"int8={t_q * 1e3:.2f}ms wire_ratio={ratio:.2f}x "
+          f"loss fp32={loss_fp:.4f} int8={loss_q:.4f} "
+          f"delta={delta:.4%}", file=sys.stderr)
+    return {
+        f"tp_act_wire_ratio_{platform}{n_dev}": {
+            "value": round(ratio, 3), "unit": "x_fewer_bytes",
+            "vs_baseline": 1.0},
+        f"tp_act_quant_speedup_{platform}{n_dev}": {
+            "value": round(speedup, 3), "unit": "x_vs_fp32_wire",
+            "vs_baseline": 1.0},
+        f"tp_act_loss_delta_{platform}{n_dev}": {
+            "value": round(delta, 5), "unit": "rel_final_loss_vs_fp32",
+            "vs_baseline": 0.0},
     }
 
 

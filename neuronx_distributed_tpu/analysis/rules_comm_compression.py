@@ -13,6 +13,20 @@ The rule fires on ``lax.pmean``/``lax.psum`` calls whose first argument is
 a gradient-named variable (``grad``/``grads``/``g_``.../``*_grad*``)
 outside ``parallel/`` — inside the package the wrappers themselves (and
 the compressed collectives) legitimately issue raw collectives.
+
+Activation extension (PR 9): when a compression config is in scope —
+the module imports ``wire_codec``/``comm_compressed`` or references
+``CompressionConfig``/``tp_activation_comm_dtype``/
+``activation_comm_dtype`` — raw ``lax.psum``/``lax.pmean``/
+``lax.all_gather`` calls on activation-named variables also fire: the
+module has opted into quantized activation wires, so a full-precision
+collective silently ships 4x the bytes the config promises. Route these
+through the parallel-layer primitives (or
+``ops.collective_matmul.*(..., wire=...)``) instead. Modules with no
+compression config in scope are untouched — plain activation
+collectives remain the model's own business. ``ops/`` is exempt like
+``parallel/``: the decomposed primitives compose raw collectives with
+the codec by design.
 """
 
 from __future__ import annotations
@@ -23,6 +37,7 @@ from typing import Iterator, List
 
 from . import astutil
 from .core import Finding, LintContext, register
+from .rules_tp_overlap import _ACT_NAME
 
 # identifier looks like a gradient: 'grad', 'grads', 'gradients', 'dw',
 # 'g_acc', 'clipped_grads', ... — substring 'grad' or the g/dgrad naming
@@ -36,11 +51,33 @@ def _in_parallel_package(path: str) -> bool:
     return "/parallel/" in norm or norm.startswith("parallel/")
 
 
+def _in_ops_package(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return "/ops/" in norm or norm.startswith("ops/")
+
+
+# a compression config is "in scope" when the module references the codec
+# or one of the activation-wire knobs — only then do full-precision
+# activation collectives contradict the module's own configuration
+_COMPRESSION_IN_SCOPE = re.compile(
+    r"\b(wire_codec|comm_compressed|CompressionConfig|"
+    r"tp_activation_comm_dtype|activation_comm_dtype)\b")
+
+_ACT_COLLECTIVES = ("pmean", "psum", "all_gather")
+
+
 def _gradient_named(node: ast.AST) -> bool:
     name = astutil.tail_name(node)
     if name is None and isinstance(node, ast.Name):
         name = node.id
     return bool(name and _GRAD_NAME.search(name))
+
+
+def _activation_named(node: ast.AST) -> bool:
+    name = astutil.tail_name(node)
+    if name is None and isinstance(node, ast.Name):
+        name = node.id
+    return bool(name and _ACT_NAME.search(name))
 
 
 @register(
@@ -51,19 +88,31 @@ def _gradient_named(node: ast.AST) -> bool:
 def check(ctx: LintContext) -> Iterator[Finding]:
     if _in_parallel_package(ctx.path):
         return
+    act_scope = (not _in_ops_package(ctx.path)
+                 and _COMPRESSION_IN_SCOPE.search(ctx.source) is not None)
     findings: List[Finding] = []
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
         tail = astutil.tail_name(node.func)
-        if tail not in ("pmean", "psum"):
+        if tail in ("pmean", "psum") and node.args \
+                and _gradient_named(node.args[0]):
+            findings.append(Finding(
+                ctx.path, node.lineno, node.col_offset, "comm-compression",
+                f"raw lax.{tail} on a gradient — use "
+                "parallel.grads.allreduce_gradients(..., specs=, "
+                "compression=) so FSDP-spec skipping, quantized wire "
+                "formats and error feedback apply "
+                "(docs/comm_compression.md)"))
             continue
-        if not node.args or not _gradient_named(node.args[0]):
-            continue
-        findings.append(Finding(
-            ctx.path, node.lineno, node.col_offset, "comm-compression",
-            f"raw lax.{tail} on a gradient — use "
-            "parallel.grads.allreduce_gradients(..., specs=, compression=) "
-            "so FSDP-spec skipping, quantized wire formats and error "
-            "feedback apply (docs/comm_compression.md)"))
+        if act_scope and tail in _ACT_COLLECTIVES and node.args \
+                and _activation_named(node.args[0]):
+            findings.append(Finding(
+                ctx.path, node.lineno, node.col_offset, "comm-compression",
+                f"full-precision lax.{tail} on an activation in a module "
+                "with an activation-compression config in scope — the "
+                "collective ships the fp32 wire the config promises to "
+                "quantize; route it through the parallel layers or "
+                "ops.collective_matmul(..., wire=wire_config(...)) "
+                "(docs/comm_compression.md)"))
     yield from findings

@@ -37,6 +37,12 @@ def configure_model(cfg: "NxDConfig", model_cfg: Any) -> Any:
         updates["tp_size"] = cfg.parallel.tensor_parallel_size
     if "overlap_comm" in fields:
         updates["overlap_comm"] = cfg.parallel.tp_overlap_comm
+    if "activation_comm_dtype" in fields:
+        updates["activation_comm_dtype"] = \
+            cfg.parallel.tp_activation_comm_dtype
+    if "activation_sync_fraction" in fields:
+        updates["activation_sync_fraction"] = \
+            cfg.parallel.tp_activation_sync_fraction
     model_cfg = dataclasses.replace(model_cfg, **updates)
     if "num_experts" in fields:
         # incoherent MoE knobs fail here with actionable errors instead of
@@ -114,6 +120,17 @@ class ParallelConfig:
     # None = auto (engage when the tp axis size >= 4 and shapes tile),
     # True = engage wherever shapes allow, False = always monolithic.
     tp_overlap_comm: Optional[bool] = None
+    # Activation-collective compression (docs/comm_compression.md): wire
+    # dtype for TP activation collectives — "fp32" (off), "int8" or "fp8"
+    # (blockwise quantized payloads + per-block fp32 scales). Composes with
+    # tp_overlap_comm: quantizes the decomposed rings when they engage and
+    # the monolithic collectives otherwise.
+    tp_activation_comm_dtype: str = "fp32"
+    # Reduced-sync TP: fraction of decoder layers whose row-parallel exit
+    # all-reduces run; the rest are elided and compensated by a periodic
+    # residual resync (PAPERS.md "Partially Synchronized Activations").
+    # < 1.0 requires scan_layers=False models without sequence_parallel.
+    tp_activation_sync_fraction: float = 1.0
 
     def __post_init__(self) -> None:
         for f in ("tensor_parallel_size", "pipeline_parallel_size",
@@ -131,6 +148,17 @@ class ParallelConfig:
             raise ValueError(
                 "tp_overlap_comm must be None (auto), True, or False, got "
                 f"{self.tp_overlap_comm!r}")
+        from .parallel.wire_codec import _WIRE_DTYPES
+
+        if self.tp_activation_comm_dtype not in _WIRE_DTYPES:
+            raise ValueError(
+                f"tp_activation_comm_dtype must be one of {_WIRE_DTYPES}, "
+                f"got {self.tp_activation_comm_dtype!r}")
+        f = self.tp_activation_sync_fraction
+        if not (isinstance(f, (int, float)) and 0.0 < f <= 1.0):
+            raise ValueError(
+                "tp_activation_sync_fraction must be in (0, 1], got "
+                f"{f!r}")
 
     @property
     def model_parallel_size(self) -> int:
@@ -254,6 +282,9 @@ class NxDConfig:
             expert_parallel_size=self.parallel.expert_parallel_size,
             dcn_data_parallel_size=self.parallel.dcn_data_parallel_size,
             tp_overlap_comm=self.parallel.tp_overlap_comm,
+            tp_activation_comm_dtype=self.parallel.tp_activation_comm_dtype,
+            tp_activation_sync_fraction=(
+                self.parallel.tp_activation_sync_fraction),
             optimizer_config=self.optimizer,
             mixed_precision_config=self.mixed_precision,
             activation_checkpoint_config=self.activation_checkpoint,
@@ -280,6 +311,8 @@ def neuronx_distributed_config(
     devices: Optional[Sequence[Any]] = None,
     dcn_data_parallel_size: Optional[int] = None,
     tp_overlap_comm: Optional[bool] = None,
+    tp_activation_comm_dtype: str = "fp32",
+    tp_activation_sync_fraction: float = 1.0,
 ) -> NxDConfig:
     """Build an :class:`NxDConfig` and (by default) initialise the global mesh.
 
@@ -295,6 +328,8 @@ def neuronx_distributed_config(
             expert_parallel_size=expert_parallel_size,
             dcn_data_parallel_size=dcn_data_parallel_size,
             tp_overlap_comm=tp_overlap_comm,
+            tp_activation_comm_dtype=tp_activation_comm_dtype,
+            tp_activation_sync_fraction=tp_activation_sync_fraction,
         ),
         optimizer=optimizer_config or OptimizerConfig(),
         mixed_precision=mixed_precision_config or MixedPrecisionConfig(),

@@ -49,6 +49,12 @@ def _lora_kw(cfg: "LlamaConfig", name: str) -> dict:
     return {}
 
 
+def _act_kw(cfg: "LlamaConfig") -> dict:
+    """Activation-wire kwargs threaded into every TP linear."""
+    return {"activation_comm_dtype": cfg.activation_comm_dtype,
+            "activation_comm_block_size": cfg.activation_comm_block_size}
+
+
 @dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 32000
@@ -103,6 +109,21 @@ class LlamaConfig:
     # shapes tile), True = on where shapes allow, False = monolithic.
     # Threaded from ParallelConfig.tp_overlap_comm by configure_model().
     overlap_comm: Optional[bool] = None
+    # Activation-collective compression (docs/comm_compression.md): wire
+    # dtype for every TP activation collective in the stack — "fp32" off,
+    # "int8"/"fp8" blockwise-quantize the payloads (decomposed rings and
+    # monolithic fallbacks alike). Threaded from
+    # ParallelConfig.tp_activation_comm_dtype by configure_model().
+    activation_comm_dtype: str = "fp32"
+    activation_comm_block_size: int = 256
+    # Reduced-sync TP (PAPERS.md "Tensor-Parallelism with Partially
+    # Synchronized Activations"): fraction of decoder layers whose
+    # row-parallel exits run the full all-reduce; the rest keep per-rank
+    # partial sums, compensated by a residual resync before every synced
+    # layer (cm.tp_sync_schedule). < 1.0 requires scan_layers=False (the
+    # schedule varies per layer) and sequence_parallel=False (the
+    # reduce-scatter also reshapes, so it cannot be elided).
+    activation_sync_fraction: float = 1.0
     # LoRA adapters (see neuronx_distributed_tpu.lora); None = disabled
     lora: Optional["LoraConfig"] = None
     # sequence-chunked LM loss (fused_linear_cross_entropy): the loss path
@@ -116,6 +137,24 @@ class LlamaConfig:
                 f"cp_attn_impl must be 'ring', 'ring_pallas' or "
                 f"'ulysses', got {self.cp_attn_impl!r}")
         validate_remat_policy(self.remat_policy)
+        # raises on unknown wire dtypes / bad block sizes
+        cm.wire_config(self.activation_comm_dtype,
+                       self.activation_comm_block_size)
+        if not 0.0 < self.activation_sync_fraction <= 1.0:
+            raise ValueError(
+                f"activation_sync_fraction must be in (0, 1], got "
+                f"{self.activation_sync_fraction}")
+        if self.activation_sync_fraction < 1.0:
+            if self.scan_layers:
+                raise ValueError(
+                    "activation_sync_fraction < 1.0 requires "
+                    "scan_layers=False: the sync schedule varies per layer "
+                    "and scanned layers share one compiled body")
+            if self.sequence_parallel:
+                raise ValueError(
+                    "activation_sync_fraction < 1.0 is incompatible with "
+                    "sequence_parallel: the reduce-scatter exit reshapes "
+                    "the activation and cannot be elided")
         if self.loss_chunk is not None:
             if self.loss_chunk <= 0:
                 raise ValueError(
@@ -209,6 +248,9 @@ class LlamaAttention(nn.Module):
     """
 
     cfg: LlamaConfig
+    # False elides o_proj's exit all-reduce (reduced-sync TP; scheduled per
+    # layer by LlamaModel via cm.tp_sync_schedule)
+    tp_sync: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, cos: jax.Array, sin: jax.Array,
@@ -221,7 +263,7 @@ class LlamaAttention(nn.Module):
             head_dim=head_dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             sequence_parallel=cfg.sequence_parallel, tp_size=cfg.tp_size,
             overlap_comm=cfg.overlap_comm, name="qkv",
-            **_lora_kw(cfg, "qkv"))(x)
+            **_act_kw(cfg), **_lora_kw(cfg, "qkv"))(x)
         b, s = q.shape[0], q.shape[1]
         n_q_local = q.shape[-1] // head_dim
         n_kv_local = k.shape[-1] // head_dim
@@ -342,7 +384,8 @@ class LlamaAttention(nn.Module):
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             sequence_parallel=cfg.sequence_parallel,
             overlap_comm=cfg.overlap_comm, name="o_proj",
-            **_lora_kw(cfg, "o_proj"))(out)
+            tp_sync=self.tp_sync,
+            **_act_kw(cfg), **_lora_kw(cfg, "o_proj"))(out)
         if cache is not None:
             return out, new_cache
         return out
@@ -350,6 +393,8 @@ class LlamaAttention(nn.Module):
 
 class LlamaMLP(nn.Module):
     cfg: LlamaConfig
+    # False elides down's exit all-reduce (reduced-sync TP)
+    tp_sync: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -383,24 +428,30 @@ class LlamaMLP(nn.Module):
         # the fused [H, 2, I] kernel rides the decomposed collective-matmul
         # directly (last-dim contraction, gate/up split preserved);
         # activation-space LoRA needs the gathered input, so it falls back
+        wire = cm.wire_config(cfg.activation_comm_dtype,
+                              cfg.activation_comm_block_size)
         engaged = not lora_act and cm.overlap_engaged(
             cfg.overlap_comm, ps.TP_AXIS, x.shape, 1,
             needs_divisible=not cfg.sequence_parallel)
-        if engaged:
+        if engaged or (wire is not None and not lora_act
+                       and pl._bound_size(ps.TP_AXIS) is not None):
+            impl = "decomposed" if engaged else "monolithic"
             x = x.astype(cfg.dtype)
             if cfg.sequence_parallel:
                 h = cm.all_gather_matmul(x, kernel.astype(cfg.dtype),
-                                         ps.TP_AXIS, 1, impl="decomposed")
+                                         ps.TP_AXIS, 1, impl=impl,
+                                         wire=wire)
             else:
                 h = cm.copy_matmul(x, kernel.astype(cfg.dtype),
-                                   ps.TP_AXIS, 1, impl="decomposed")
+                                   ps.TP_AXIS, 1, impl=impl, wire=wire)
             h = nn.silu(h[..., 0, :]) * h[..., 1, :]
             return pl.RowParallelLinear(
                 features=cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
                 sequence_parallel=cfg.sequence_parallel,
                 overlap_comm=cfg.overlap_comm, name="down",
-                **_lora_kw(cfg, "down"))(h)
+                tp_sync=self.tp_sync,
+                **_act_kw(cfg), **_lora_kw(cfg, "down"))(h)
         if cfg.sequence_parallel:
             x = mappings.gather_from_sequence_parallel_region(
                 x, seq_dim=1, to_model_parallel=True)
@@ -422,11 +473,15 @@ class LlamaMLP(nn.Module):
             param_dtype=cfg.param_dtype,
             sequence_parallel=cfg.sequence_parallel,
             overlap_comm=cfg.overlap_comm, name="down",
-            **_lora_kw(cfg, "down"))(h)
+            tp_sync=self.tp_sync,
+            **_act_kw(cfg), **_lora_kw(cfg, "down"))(h)
 
 
 class LlamaDecoderLayer(nn.Module):
     cfg: LlamaConfig
+    # False elides this layer's row-parallel exit all-reduces (o_proj and
+    # down); LlamaModel's non-scan loop schedules it per layer
+    tp_sync: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, cos: jax.Array, sin: jax.Array,
@@ -436,7 +491,7 @@ class LlamaDecoderLayer(nn.Module):
         h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                     sequence_parallel=cfg.sequence_parallel,
                     name="input_norm")(x)
-        attn_out = LlamaAttention(cfg, name="attn")(
+        attn_out = LlamaAttention(cfg, tp_sync=self.tp_sync, name="attn")(
             h, cos, sin, positions, cache=cache, cache_index=cache_index)
         new_cache = None
         if cache is not None:
@@ -445,7 +500,7 @@ class LlamaDecoderLayer(nn.Module):
         h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                     sequence_parallel=cfg.sequence_parallel,
                     name="post_norm")(x)
-        x = x + LlamaMLP(cfg, name="mlp")(h)
+        x = x + LlamaMLP(cfg, tp_sync=self.tp_sync, name="mlp")(h)
         if cache is not None:
             return x, new_cache
         return x
@@ -596,8 +651,35 @@ class LlamaModel(nn.Module):
                 layer_cls = nn.remat(
                     layer_cls, prevent_cse=False,
                     policy=resolve_remat_policy(cfg.remat_policy))
+            sched = cm.tp_sync_schedule(cfg.num_layers,
+                                        cfg.activation_sync_fraction)
+            # only engage when there is a real bound tp axis: at size 1 (or
+            # under GSPMD) the elided all-reduce is already a no-op, and the
+            # resync arithmetic x_ref + psum(x - x_ref) is not a bitwise
+            # identity, so stay on the plain path
+            n_tp = pl._bound_size(ps.TP_AXIS)
+            reduced = (cfg.activation_sync_fraction < 1.0
+                       and n_tp is not None and n_tp > 1)
+            # Reduced-sync resync: x_ref tracks the last fully-synchronized
+            # hidden state. Unsynced layers leave each rank holding
+            # x_ref + its own share of the elided all-reduce outputs, so a
+            # single psum of the accumulated deviation (x - x_ref) before
+            # the next synced layer recovers the full activation — one
+            # collective amortized over 1/sync_fraction layers.
+            x_ref = x
+            pending = False
             for i in range(cfg.num_layers):
-                x = layer_cls(cfg, name=f"layer_{i}")(x, cos, sin, positions)
+                if reduced and pending and sched[i]:
+                    x = x_ref + mappings.reduce_from_tensor_parallel_region(
+                        x - x_ref)
+                    pending = False
+                x = layer_cls(cfg, tp_sync=sched[i] if reduced else True,
+                              name=f"layer_{i}")(x, cos, sin, positions)
+                if reduced:
+                    if sched[i]:
+                        x_ref = x
+                    else:
+                        pending = True
         x = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                     sequence_parallel=cfg.sequence_parallel, name="norm")(x)
         # NOTE: when sequence_parallel, the returned hidden states are still
@@ -683,7 +765,7 @@ class LlamaForCausalLM(nn.Module):
             sequence_parallel=cfg.sequence_parallel,
             overlap_comm=cfg.overlap_comm,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
-            **_lora_kw(cfg, "lm_head"))(x)
+            **_act_kw(cfg), **_lora_kw(cfg, "lm_head"))(x)
         if labels is not None:
             return lf.causal_lm_loss(logits, labels,
                                      ignore_index=ignore_index)
@@ -814,7 +896,7 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
             features=cfg.vocab_size, use_bias=False, gather_output=True,
             overlap_comm=cfg.overlap_comm,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            **_lora_kw(cfg, "lm_head"))
+            **_act_kw(cfg), **_lora_kw(cfg, "lm_head"))
         logits = head.apply({"params": p["lm_head"]}, x)
     if paged:
         if quantized:

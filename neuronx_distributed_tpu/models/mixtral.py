@@ -22,7 +22,8 @@ from ..parallel import layers as pl
 from ..parallel import loss_functions as lf
 from ..parallel import mappings
 from ..parallel import mesh as ps
-from .llama import LlamaAttention, LlamaConfig, context_parallel_positions
+from .llama import (LlamaAttention, LlamaConfig, _act_kw,
+                    context_parallel_positions)
 
 
 @dataclass(frozen=True)
@@ -69,6 +70,13 @@ def tiny_moe_config(**kw) -> MixtralConfig:
 
 class MixtralDecoderLayer(nn.Module):
     cfg: MixtralConfig
+    # Reduced-sync TP: False elides the attention exit all-reduce. The MoE
+    # block keeps its internal tp reduction (its expert-combine psum also
+    # moves tokens, so it cannot be elided); its replicated output is
+    # scaled to a 1/n share instead, so an unsynced layer's deviation from
+    # the last synced hidden state still sums to the true update under the
+    # model's periodic resync psum.
+    tp_sync: bool = True
 
     @nn.compact
     def __call__(self, x, cos, sin, positions=None, cache=None,
@@ -77,7 +85,7 @@ class MixtralDecoderLayer(nn.Module):
         h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                     sequence_parallel=cfg.sequence_parallel,
                     name="input_norm")(x)
-        attn_out = LlamaAttention(cfg, name="attn")(
+        attn_out = LlamaAttention(cfg, tp_sync=self.tp_sync, name="attn")(
             h, cos, sin, positions, cache=cache, cache_index=cache_index)
         new_cache = None
         if cache is not None:
@@ -110,6 +118,9 @@ class MixtralDecoderLayer(nn.Module):
             # sequence with a plain split (bwd all-gather)
             moe_out = mappings.scatter_to_sequence_parallel_region(
                 moe_out, seq_dim=1)
+        if not self.tp_sync:
+            n = pl._bound_size(ps.TP_AXIS) or 1
+            moe_out = moe_out / n
         x = x + moe_out
         aux_vec = jnp.stack([aux["load_balance_loss"], aux["z_loss"]])
         if cache is not None:
@@ -184,10 +195,32 @@ class MixtralModel(nn.Module):
                 layer_cls = nn.remat(
                     layer_cls, prevent_cse=False,
                     policy=jax.checkpoint_policies.nothing_saveable)
+            from ..ops import collective_matmul as cm
+
+            sched = cm.tp_sync_schedule(cfg.num_layers,
+                                        cfg.activation_sync_fraction)
+            # see LlamaModel: only engage over a real bound tp axis
+            n_tp = pl._bound_size(ps.TP_AXIS)
+            reduced = (cfg.activation_sync_fraction < 1.0
+                       and n_tp is not None and n_tp > 1)
+            # reduced-sync resync (see LlamaModel): psum the accumulated
+            # deviation from the last synced hidden state before every
+            # synced layer
+            x_ref = x
+            pending = False
             for i in range(cfg.num_layers):
-                x, a = layer_cls(cfg, name=f"layer_{i}")(x, cos, sin,
-                                                         positions)
+                if reduced and pending and sched[i]:
+                    x = x_ref + mappings.reduce_from_tensor_parallel_region(
+                        x - x_ref)
+                    pending = False
+                x, a = layer_cls(cfg, tp_sync=sched[i] if reduced else True,
+                                 name=f"layer_{i}")(x, cos, sin, positions)
                 auxes.append(a)
+                if reduced:
+                    if sched[i]:
+                        x_ref = x
+                    else:
+                        pending = True
             aux = jnp.sum(jnp.stack(auxes), axis=0)
         x = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                     sequence_parallel=cfg.sequence_parallel, name="norm")(x)
@@ -208,7 +241,7 @@ class MixtralForCausalLM(nn.Module):
         logits = pl.ColumnParallelLinear(
             features=cfg.vocab_size, use_bias=False, gather_output=False,
             sequence_parallel=cfg.sequence_parallel,
-            overlap_comm=cfg.overlap_comm,
+            overlap_comm=cfg.overlap_comm, **_act_kw(cfg),
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head")(x)
         return logits, aux
 
@@ -285,7 +318,7 @@ def mixtral_forward_with_cache(cfg: MixtralConfig, params,
         {"params": p["model"]["norm"]}, x)
     head = pl.ColumnParallelLinear(
         features=cfg.vocab_size, use_bias=False, gather_output=True,
-        overlap_comm=cfg.overlap_comm,
+        overlap_comm=cfg.overlap_comm, **_act_kw(cfg),
         dtype=cfg.dtype, param_dtype=cfg.param_dtype)
     logits = head.apply({"params": p["lm_head"]}, x)
     new_cache = KVCache(k=new_k, v=new_v, pos=slot_pos,

@@ -48,20 +48,44 @@ counter-clockwise halves for even axis sizes — each shard travels at most
 links. The buffered ordered summation makes the result independent of the
 streaming direction, so uni/bidi are bit-identical too.
 
+Quantized wire format (activation-collective compression)
+----------------------------------------------------------
+Every primitive takes an optional ``wire`` :class:`CompressionConfig`
+(frozen/hashable → a static ``custom_vjp`` nondiff arg, never a
+recompile). When quantized, the ring payloads — gathered shards in the AG
+ring, per-destination partial blocks in the RS ring, and the cotangent
+rings of every backward dual — ship as blockwise int8/fp8 values plus
+per-block fp32 scales (the shared :mod:`..parallel.wire_codec`, the same
+quantizer the gradient collectives use). Payloads keep their original
+tensor layout (``encode_payload``: trailing-dim blocks, no flattening), so
+block boundaries land at identical trailing-dim offsets in the decomposed
+ring and the quantized monolithic fallback, making the two *bitwise*
+equal: each source's contribution is ``DQ(Q(p))`` either way, and the
+reduce-scatter's ascending-rank accumulation happens in the dequantized
+domain in both. ``wire=None`` (or an fp32 config) leaves every code path
+byte-identical to the uncompressed module. Cross-step error-feedback
+residue for the gathered activation payload threads through
+``all_gather_matmul(..., error=)`` exactly like the gradient collectives'
+``comm_error`` (see docs/comm_compression.md).
+
 Fallback
 --------
 Decomposition needs the scattered/pipelined dim to tile evenly over the
 axis (and a gather/scatter dim distinct from the contraction dim). When it
 doesn't — e.g. the serving engine's single-token decode steps — every
 entry point silently falls back to the monolithic path instead of raising;
-``will_decompose`` exposes the decision for tests and benchmarks. The
-layer-level auto knob (``overlap_comm=None``) additionally requires the
-axis size to be ≥ ``MIN_AUTO_AXIS_SIZE`` — below that a ring is all
-latency and no pipelining.
+``will_decompose`` exposes the decision for tests and benchmarks. With a
+quantized ``wire`` the monolithic fallbacks stay compressed (codec-encoded
+gather / all-to-all reduce-scatter / flat quantized all-reduce) whenever
+the shape allows, and silently stay full-precision otherwise — never an
+error, never a recompile. The layer-level auto knob (``overlap_comm=None``)
+additionally requires the axis size to be ≥ ``MIN_AUTO_AXIS_SIZE`` — below
+that a ring is all latency and no pipelining.
 """
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 from typing import Any, Optional, Sequence, Tuple, Union
 
@@ -70,7 +94,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..parallel import comm
+from ..parallel import comm_compressed
 from ..parallel import mesh as ps
+from ..parallel.wire_codec import (CompressionConfig, decode_payload,
+                                   encode_payload)
 
 Array = jax.Array
 Kernels = Union[Array, Sequence[Array]]
@@ -165,6 +192,56 @@ def overlap_engaged(overlap_comm: Optional[bool], axis,
 
 
 # ---------------------------------------------------------------------------
+# wire compression + reduced-sync knobs
+# ---------------------------------------------------------------------------
+
+def wire_config(dtype: Optional[str],
+                block_size: int = 256) -> Optional[CompressionConfig]:
+    """Activation-wire config for the ``wire=`` argument of every primitive
+    here: None (no compression) for ``None``/``"fp32"``, else a hashable
+    :class:`CompressionConfig` (``hierarchical``/``error_feedback`` are
+    gradient-side concepts and stay off)."""
+    if not dtype or dtype == "fp32":
+        return None
+    return CompressionConfig(dtype=dtype, block_size=int(block_size),
+                             hierarchical=False, error_feedback=False)
+
+
+def _norm_wire(wire: Optional[CompressionConfig]
+               ) -> Optional[CompressionConfig]:
+    return wire if (wire is not None and wire.quantized) else None
+
+
+def tp_sync_schedule(num_layers: int,
+                     sync_fraction: float) -> Tuple[bool, ...]:
+    """Static per-layer schedule for reduced-sync TP (PAPERS.md
+    "Tensor-Parallelism with Partially Synchronized Activations").
+
+    ``sync_fraction`` ∈ (0, 1] is the fraction of decoder layers whose
+    row-parallel exits run the full all-reduce; the rest elide it (each
+    rank keeps its local partial product) and are compensated by the
+    periodic residual resync the model inserts before every synced layer.
+    Entry ``i`` True → layer ``i`` syncs. 1.0 → all layers sync (the
+    schedule is the identity and no resync machinery is built). Synced
+    layers are evenly spaced with period ``round(1/f)`` and the last layer
+    always syncs so the final norm / lm-head see a fully synchronized
+    residual stream. Pure and static — the schedule is baked into the
+    compiled program, never a traced branch."""
+    if not 0.0 < sync_fraction <= 1.0:
+        raise ValueError(
+            f"activation_sync_fraction must be in (0, 1], got "
+            f"{sync_fraction!r}")
+    if num_layers <= 0:
+        return ()
+    if sync_fraction >= 1.0:
+        return (True,) * num_layers
+    k = max(1, int(round(1.0 / sync_fraction)))
+    sched = [(i % k) == (k - 1) for i in range(num_layers)]
+    sched[-1] = True
+    return tuple(sched)
+
+
+# ---------------------------------------------------------------------------
 # contraction helpers (shared by both impls so the arithmetic is identical)
 # ---------------------------------------------------------------------------
 
@@ -186,6 +263,23 @@ def _contract_sum(xs: Sequence[Array], ws: Sequence[Array]) -> Array:
     for x, w in zip(xs[1:], ws[1:]):
         out = out + _contract(x, w)
     return out
+
+
+def _ordered_sum(buf: Array, n: int) -> Array:
+    """Left-to-right ascending-source-rank summation of a ``[n, ...]``
+    contribution buffer. Callers must materialize the contributions into
+    ``buf`` via ``dynamic_update_slice`` stores *before* calling: a DUS
+    buffer forces the dequantization multiply to be computed to memory, so
+    the backend cannot contract it into the accumulation adds as an fma
+    (an optimization_barrier alone does NOT stop LLVM's fp contraction on
+    CPU). The adds are then pure fp32 adds in program order, bitwise
+    identical whichever program (ring or monolithic all-to-all) produced
+    the buffer."""
+    buf = lax.optimization_barrier(buf)
+    acc = buf[0]
+    for r in range(1, n):
+        acc = acc + buf[r]
+    return acc
 
 
 def _flat_t(w: Array) -> Array:
@@ -218,59 +312,117 @@ def _shift_perm(n: int, shift: int):
     return [(i, (i + shift) % n) for i in range(n)]
 
 
+def _ship(pair, axis, perm):
+    """ppermute a ``(q, scales)`` wire pair one ring step; scales are
+    absent (None) on the fp path, which then matches the uncompressed ring
+    byte-for-byte."""
+    q, s = pair
+    q = comm.ppermute(q, axis, perm)
+    if s is not None:
+        s = comm.ppermute(s, axis, perm)
+    return q, s
+
+
+def _open(pair, wire, dtype):
+    """Dequantize a received wire pair back into compute dtype (identity
+    on the fp path)."""
+    q, s = pair
+    return decode_payload(q, s, wire, dtype)
+
+
+def _quantized_all_gather(v: Array, axis, dim: int,
+                          wire: Optional[CompressionConfig]) -> Array:
+    """Monolithic all-gather with the payload codec-encoded on the wire.
+    Every rank encodes identically and gathers are pure movement, so the
+    result equals the ring's ``DQ(Q(shard))`` concatenation bitwise."""
+    if wire is None:
+        return comm.all_gather(v, axis, dim)
+    q, s = encode_payload(v, wire)
+    qg = comm.all_gather(q, axis, dim)
+    sg = comm.all_gather(s, axis, dim)
+    return decode_payload(qg, sg, wire, v.dtype)
+
+
+def _quantized_all_reduce(v: Array, axis,
+                          wire: Optional[CompressionConfig]) -> Array:
+    """Monolithic all-reduce fallback: the codec's flat quantized
+    all-reduce (works for any shape via block padding); plain ``psum``
+    when uncompressed."""
+    if wire is None:
+        return comm.all_reduce(v, axis)
+    return comm_compressed.all_reduce(
+        v, axis, config=dataclasses.replace(wire, hierarchical=False),
+        op="sum")
+
+
 def _ag_matmul_decomposed(x: Array, ws: Tuple[Array, ...], axis, dim: int,
-                          bidi: bool) -> Tuple[Array, ...]:
+                          bidi: bool,
+                          wire: Optional[CompressionConfig]
+                          ) -> Tuple[Array, ...]:
     """Ring all-gather-matmul: remote shards stream around the ring while
-    each step's block matmul (independent of the in-flight transfer) runs."""
+    each step's block matmul (independent of the in-flight transfer) runs.
+    With a quantized ``wire`` each rank encodes its shard ONCE and the
+    ``(q, scales)`` pair circulates — one quantization per shard total,
+    exactly what the monolithic quantized gather ships."""
     n = comm._axis_size(axis)
     idx = lax.axis_index(axis)
     dim = _norm_dim(dim, x.ndim)
     l = x.shape[dim]
 
+    pair = encode_payload(x, wire)
+    # the own block round-trips through DQ(Q(·)) too: every rank then
+    # contracts identical gathered values, matching the monolithic path
+    # bitwise (fp wire: encode/open are identities and this is just x)
+    own = _open(pair, wire, x.dtype)
+
     outs = []
     for w in ws:
         shape = list(x.shape[:-1]) + list(w.shape[1:])
         shape[dim] = n * l
-        outs.append(jnp.zeros(tuple(shape), jnp.result_type(x, w)))
+        outs.append(jnp.zeros(tuple(shape), jnp.result_type(own, w)))
 
     def write(outs, chunk, src):
         return [lax.dynamic_update_slice_in_dim(o, _contract(chunk, w),
                                                 src * l, axis=dim)
                 for o, w in zip(outs, ws)]
 
-    outs = write(outs, x, idx)  # own block first — no transfer needed
+    outs = write(outs, own, idx)  # own block first — no transfer needed
     if not bidi:
-        chunk = x
         for t in range(1, n):
             # receive the next shard from the right neighbour; the matmul
             # below consumes the *previous* chunk's successor, so transfer
             # t+1 can fly while block t multiplies
-            chunk = comm.ppermute(chunk, axis, _shift_perm(n, -1))
-            outs = write(outs, chunk, (idx + t) % n)
+            pair = _ship(pair, axis, _shift_perm(n, -1))
+            outs = write(outs, _open(pair, wire, x.dtype), (idx + t) % n)
         return tuple(outs)
-    fwd = bwd = x
+    fwd = bwd = pair
     for t in range(1, n // 2 + 1):
-        fwd = comm.ppermute(fwd, axis, _shift_perm(n, -1))
-        outs = write(outs, fwd, (idx + t) % n)
+        fwd = _ship(fwd, axis, _shift_perm(n, -1))
+        outs = write(outs, _open(fwd, wire, x.dtype), (idx + t) % n)
         if t != n - t:  # at t == n/2 both streams carry the same shard
-            bwd = comm.ppermute(bwd, axis, _shift_perm(n, +1))
-            outs = write(outs, bwd, (idx - t) % n)
+            bwd = _ship(bwd, axis, _shift_perm(n, +1))
+            outs = write(outs, _open(bwd, wire, x.dtype), (idx - t) % n)
     return tuple(outs)
 
 
-def _ag_matmul_monolithic(x: Array, ws: Tuple[Array, ...], axis,
-                          dim: int) -> Tuple[Array, ...]:
-    xg = comm.all_gather(x, axis, dim)
+def _ag_matmul_monolithic(x: Array, ws: Tuple[Array, ...], axis, dim: int,
+                          wire: Optional[CompressionConfig]
+                          ) -> Tuple[Array, ...]:
+    xg = _quantized_all_gather(x, axis, _norm_dim(dim, x.ndim), wire)
     return tuple(_contract(xg, w) for w in ws)
 
 
 def _mm_rs_decomposed(xs: Tuple[Array, ...], ws: Tuple[Array, ...], axis,
-                      dim: int, bidi: bool) -> Array:
+                      dim: int, bidi: bool,
+                      wire: Optional[CompressionConfig]) -> Array:
     """Ring matmul-reduce-scatter: each destination's partial block is
     computed, shipped straight to its owner (shift-``t`` ppermute — one
     hop's worth of latency per step regardless of distance on a torus),
     buffered by source rank, and summed once left-to-right in ascending
-    rank order — the exact addition order of XLA's ``psum_scatter``."""
+    rank order — the exact addition order of XLA's ``psum_scatter``. With
+    a quantized ``wire`` each partial block is encoded before its ppermute
+    and the accumulation happens in the dequantized domain, preserving
+    that same ascending-rank order."""
     n = comm._axis_size(axis)
     idx = lax.axis_index(axis)
     dim = _norm_dim(dim, xs[0].ndim)
@@ -282,7 +434,12 @@ def _mm_rs_decomposed(xs: Tuple[Array, ...], ws: Tuple[Array, ...], axis,
                  for x in xs]
         return _contract_sum(parts, ws)
 
-    own = block(idx)
+    p_own = block(idx)
+    dt = p_own.dtype
+    # the own partial round-trips through DQ(Q(·)) like every shipped one,
+    # so rank position doesn't change which contributions are exact —
+    # identical to the quantized monolithic all-to-all (fp: identity)
+    own = _open(encode_payload(p_own, wire), wire, dt)
     buf = jnp.zeros((n,) + own.shape, own.dtype)
 
     def store(buf, p, src):
@@ -292,66 +449,100 @@ def _mm_rs_decomposed(xs: Tuple[Array, ...], ws: Tuple[Array, ...], axis,
     buf = store(buf, own, idx)
     if not bidi:
         for t in range(1, n):
-            p = block((idx + t) % n)
-            p = comm.ppermute(p, axis, _shift_perm(n, t))
-            buf = store(buf, p, (idx - t) % n)
+            p = encode_payload(block((idx + t) % n), wire)
+            p = _ship(p, axis, _shift_perm(n, t))
+            buf = store(buf, _open(p, wire, dt), (idx - t) % n)
     else:
         for t in range(1, n // 2 + 1):
-            p = block((idx + t) % n)
-            p = comm.ppermute(p, axis, _shift_perm(n, t))
-            buf = store(buf, p, (idx - t) % n)
+            p = encode_payload(block((idx + t) % n), wire)
+            p = _ship(p, axis, _shift_perm(n, t))
+            buf = store(buf, _open(p, wire, dt), (idx - t) % n)
             if t != n - t:
-                q = block((idx - t) % n)
-                q = comm.ppermute(q, axis, _shift_perm(n, -t))
-                buf = store(buf, q, (idx + t) % n)
-    acc = buf[0]
-    for r in range(1, n):  # ascending source rank, left-to-right
-        acc = acc + buf[r]
-    return acc
+                q = encode_payload(block((idx - t) % n), wire)
+                q = _ship(q, axis, _shift_perm(n, -t))
+                buf = store(buf, _open(q, wire, dt), (idx + t) % n)
+    return _ordered_sum(buf, n)
 
 
 def _mm_rs_monolithic(xs: Tuple[Array, ...], ws: Tuple[Array, ...], axis,
-                      dim: int) -> Array:
+                      dim: int,
+                      wire: Optional[CompressionConfig]) -> Array:
     y = _contract_sum(list(xs), list(ws))
-    return comm.reduce_scatter(y, axis, _norm_dim(dim, y.ndim))
+    dim = _norm_dim(dim, y.ndim)
+    if wire is None:
+        return comm.reduce_scatter(y, axis, dim)
+    names = comm._bound_names(axis)
+    n = comm._axis_size(axis)
+    if not names or n is None or n == 1:
+        return y
+    if y.shape[dim] % n:
+        # can't form per-destination blocks; the fp collective has the
+        # same divisibility contract and raises the pointed error
+        return comm.reduce_scatter(y, axis, dim)
+    ax = names if len(names) > 1 else names[0]
+    # stack the n destination slices, quantize each (trailing-dim blocks —
+    # slicing a non-trailing dim never moves a block boundary, so these
+    # are the ring's per-destination partials bit-for-bit), all-to-all the
+    # wire pair, and sum the received contributions in ascending source
+    # rank order in the dequantized domain: bitwise equal to the ring.
+    lead = jnp.moveaxis(y, dim, 0)
+    stacked = lead.reshape((n, lead.shape[0] // n) + lead.shape[1:])
+    q, s = encode_payload(stacked, wire)
+    qr = lax.all_to_all(q, ax, split_axis=0, concat_axis=0, tiled=True)
+    sr = lax.all_to_all(s, ax, split_axis=0, concat_axis=0, tiled=True)
+    dq = decode_payload(qr, sr, wire, y.dtype)
+    # Materialize each source's contribution into the ring's contribution
+    # buffer (output layout, dynamic_update_slice per source) before the
+    # ordered sum. The DUS buffer forces the dequantize multiply to
+    # materialize, so XLA cannot contract it into the accumulation adds as
+    # an fma here while leaving the ring's adds uncontracted — both
+    # programs then perform identical mul-then-add arithmetic.
+    first = jnp.moveaxis(dq[0], 0, dim)
+    buf = jnp.zeros((n,) + first.shape, first.dtype)
+    for r in range(n):
+        piece = first if r == 0 else jnp.moveaxis(dq[r], 0, dim)
+        buf = lax.dynamic_update_slice(
+            buf, piece[None], (r,) + (0,) * piece.ndim)
+    return _ordered_sum(buf, n)
 
 
-def _mm_rs_impl(xs, ws, axis, dim, decomposed, bidi):
+def _mm_rs_impl(xs, ws, axis, dim, decomposed, bidi, wire):
     if decomposed:
-        return _mm_rs_decomposed(xs, ws, axis, dim, bidi)
-    return _mm_rs_monolithic(xs, ws, axis, dim)
+        return _mm_rs_decomposed(xs, ws, axis, dim, bidi, wire)
+    return _mm_rs_monolithic(xs, ws, axis, dim, wire)
 
 
-def _ag_matmul_impl(x, ws, axis, dim, decomposed, bidi):
+def _ag_matmul_impl(x, ws, axis, dim, decomposed, bidi, wire):
     if decomposed:
-        return _ag_matmul_decomposed(x, ws, axis, dim, bidi)
-    return _ag_matmul_monolithic(x, ws, axis, dim)
+        return _ag_matmul_decomposed(x, ws, axis, dim, bidi, wire)
+    return _ag_matmul_monolithic(x, ws, axis, dim, wire)
 
 
 # ---------------------------------------------------------------------------
 # custom_vjp primitives (dual decomposition in the backward)
 # ---------------------------------------------------------------------------
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def _ag_matmul(x, ws, axis, dim, decomposed, bidi):
-    return _ag_matmul_impl(x, ws, axis, dim, decomposed, bidi)
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _ag_matmul(x, ws, axis, dim, decomposed, bidi, wire):
+    return _ag_matmul_impl(x, ws, axis, dim, decomposed, bidi, wire)
 
 
-def _ag_matmul_fwd(x, ws, axis, dim, decomposed, bidi):
-    return _ag_matmul_impl(x, ws, axis, dim, decomposed, bidi), (x, ws)
+def _ag_matmul_fwd(x, ws, axis, dim, decomposed, bidi, wire):
+    return _ag_matmul_impl(x, ws, axis, dim, decomposed, bidi, wire), (x, ws)
 
 
-def _ag_matmul_bwd(axis, dim, decomposed, bidi, res, gs):
+def _ag_matmul_bwd(axis, dim, decomposed, bidi, wire, res, gs):
     x, ws = res
     # dx: the dual — partial input-grads reduce-scattered back onto the
-    # gathered dim, overlapped when the forward was
+    # gathered dim, overlapped (and wire-quantized) when the forward was
     g2s = tuple(_flat_rest(g, w) for g, w in zip(gs, ws))
     wts = tuple(_flat_t(w) for w in ws)
-    dx = _mm_rs_impl(g2s, wts, axis, dim, decomposed, bidi)
+    dx = _mm_rs_impl(g2s, wts, axis, dim, decomposed, bidi, wire)
     dx = dx.astype(x.dtype)
-    # dw: needs the gathered input; re-gathering is pure movement so both
-    # impls see identical bits
-    x_full = comm.all_gather(x, axis, _norm_dim(dim, x.ndim))
+    # dw: needs the gathered input; quantized, the re-gather reconstructs
+    # the same DQ(Q(x)) the forward contracted, so dw differentiates the
+    # function the forward actually computed
+    x_full = _quantized_all_gather(x, axis, _norm_dim(dim, x.ndim), wire)
     dws = tuple(_dkernel(x_full, g, w.shape).astype(w.dtype)
                 for g, w in zip(gs, ws))
     return dx, dws
@@ -360,22 +551,25 @@ def _ag_matmul_bwd(axis, dim, decomposed, bidi, res, gs):
 _ag_matmul.defvjp(_ag_matmul_fwd, _ag_matmul_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def _mm_rs(x, w, axis, dim, decomposed, bidi):
-    return _mm_rs_impl((x,), (w,), axis, dim, decomposed, bidi)
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _mm_rs(x, w, axis, dim, decomposed, bidi, wire):
+    return _mm_rs_impl((x,), (w,), axis, dim, decomposed, bidi, wire)
 
 
-def _mm_rs_fwd(x, w, axis, dim, decomposed, bidi):
-    return _mm_rs_impl((x,), (w,), axis, dim, decomposed, bidi), (x, w)
+def _mm_rs_fwd(x, w, axis, dim, decomposed, bidi, wire):
+    return _mm_rs_impl((x,), (w,), axis, dim, decomposed, bidi, wire), (x, w)
 
 
-def _mm_rs_bwd(axis, dim, decomposed, bidi, res, g):
+def _mm_rs_bwd(axis, dim, decomposed, bidi, wire, res, g):
     x, w = res
-    # dx: all-gather-matmul of the scattered cotangent against w^T
+    # dx: all-gather-matmul of the scattered cotangent against w^T (the
+    # cotangent payload rides the same quantized wire — straight-through
+    # w.r.t. the forward's quantizer, see docs/tp_overlap.md)
     g2 = _flat_rest(g, w)
-    (dx,) = _ag_matmul_impl(g2, (_flat_t(w),), axis, dim, decomposed, bidi)
+    (dx,) = _ag_matmul_impl(g2, (_flat_t(w),), axis, dim, decomposed, bidi,
+                            wire)
     dx = dx.astype(x.dtype)
-    g_full = comm.all_gather(g, axis, _norm_dim(dim, g.ndim))
+    g_full = _quantized_all_gather(g, axis, _norm_dim(dim, g.ndim), wire)
     dw = _dkernel(x, g_full, w.shape).astype(w.dtype)
     return dx, dw
 
@@ -383,19 +577,19 @@ def _mm_rs_bwd(axis, dim, decomposed, bidi, res, g):
 _mm_rs.defvjp(_mm_rs_fwd, _mm_rs_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def _mm_ar(x, w, axis, dim, decomposed, bidi):
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _mm_ar(x, w, axis, dim, decomposed, bidi, wire):
     if decomposed:
-        y = _mm_rs_decomposed((x,), (w,), axis, dim, bidi)
-        return comm.all_gather(y, axis, _norm_dim(dim, y.ndim))
-    return comm.all_reduce(_contract(x, w), axis)
+        y = _mm_rs_decomposed((x,), (w,), axis, dim, bidi, wire)
+        return _quantized_all_gather(y, axis, _norm_dim(dim, y.ndim), wire)
+    return _quantized_all_reduce(_contract(x, w), axis, wire)
 
 
-def _mm_ar_fwd(x, w, axis, dim, decomposed, bidi):
-    return _mm_ar(x, w, axis, dim, decomposed, bidi), (x, w)
+def _mm_ar_fwd(x, w, axis, dim, decomposed, bidi, wire):
+    return _mm_ar(x, w, axis, dim, decomposed, bidi, wire), (x, w)
 
 
-def _mm_ar_bwd(axis, dim, decomposed, bidi, res, g):
+def _mm_ar_bwd(axis, dim, decomposed, bidi, wire, res, g):
     x, w = res
     # the all-reduce's cotangent is replicated: dx needs no collective
     # (identical formula both impls — cf. reduce_from_tensor_parallel_region
@@ -408,26 +602,26 @@ def _mm_ar_bwd(axis, dim, decomposed, bidi, res, g):
 _mm_ar.defvjp(_mm_ar_fwd, _mm_ar_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def _copy_mm(x, ws, axis, dim, decomposed, bidi):
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _copy_mm(x, ws, axis, dim, decomposed, bidi, wire):
     return tuple(_contract(x, w) for w in ws)
 
 
-def _copy_mm_fwd(x, ws, axis, dim, decomposed, bidi):
+def _copy_mm_fwd(x, ws, axis, dim, decomposed, bidi, wire):
     return tuple(_contract(x, w) for w in ws), (x, ws)
 
 
-def _copy_mm_bwd(axis, dim, decomposed, bidi, res, gs):
+def _copy_mm_bwd(axis, dim, decomposed, bidi, wire, res, gs):
     x, ws = res
     # dx = psum(sum_i g_i w_i^T): decomposed as reduce-scatter (overlapped
-    # with the per-block matmuls) + all-gather
+    # with the per-block matmuls) + all-gather, cotangents wire-quantized
     g2s = tuple(_flat_rest(g, w) for g, w in zip(gs, ws))
     wts = tuple(_flat_t(w) for w in ws)
     if decomposed:
-        dx = _mm_rs_decomposed(g2s, wts, axis, dim, bidi)
-        dx = comm.all_gather(dx, axis, _norm_dim(dim, dx.ndim))
+        dx = _mm_rs_decomposed(g2s, wts, axis, dim, bidi, wire)
+        dx = _quantized_all_gather(dx, axis, _norm_dim(dim, dx.ndim), wire)
     else:
-        dx = comm.all_reduce(_contract_sum(g2s, wts), axis)
+        dx = _quantized_all_reduce(_contract_sum(g2s, wts), axis, wire)
     dx = dx.astype(x.dtype)
     # kernels are axis-sharded: dw is local (x is replicated)
     dws = tuple(_dkernel(x, g, w.shape).astype(w.dtype)
@@ -459,7 +653,9 @@ def _unwrap(outs: Tuple[Array, ...], kernels: Kernels):
 
 def all_gather_matmul(x: Array, kernels: Kernels, axis=ps.TP_AXIS,
                       gather_dim: int = 1, *, impl: str = "auto",
-                      bidirectional: Optional[bool] = None):
+                      bidirectional: Optional[bool] = None,
+                      wire: Optional[CompressionConfig] = None,
+                      error: Optional[Array] = None):
     """``all_gather(x, gather_dim) @ w`` for one kernel or a fused tuple
     (e.g. Q/K/V share one gathered stream), decomposed into a ppermute
     ring. ``x [..., gather_dim: l_local, ..., K]``, each kernel
@@ -467,60 +663,92 @@ def all_gather_matmul(x: Array, kernels: Kernels, axis=ps.TP_AXIS,
 
     The sequence-parallel entry of a column-parallel linear. Backward:
     ``dx`` is a (decomposed) matmul-reduce-scatter, ``dw`` a re-gather +
-    single flattened matmul.
+    single flattened matmul. A quantized ``wire`` codec-encodes the ring
+    payloads (fwd shards AND bwd cotangents).
+
+    ``error`` threads cross-step error feedback for the gathered payload —
+    the same contract as the gradient collectives' ``comm_error``: pass
+    last step's residue buffer (``x``'s shape, fp32) and the return becomes
+    ``(out, new_error)`` where ``new_error = (x + e) − DQ(Q(x + e))``.
+    The residue is stop-gradiented state, not a differentiable input.
     """
     ws = _as_tuple(kernels)
+    wire = _norm_wire(wire)
     decomposed, bidi = _prep(impl, axis, x, gather_dim, False, bidirectional)
     n = comm._axis_size(axis)
     if n is None or n <= 1:
-        return _unwrap(tuple(_contract(x, w) for w in ws), kernels)
-    return _unwrap(_ag_matmul(x, ws, axis, gather_dim, decomposed, bidi),
-                   kernels)
+        out = _unwrap(tuple(_contract(x, w) for w in ws), kernels)
+        return (out, error) if error is not None else out
+    new_error = None
+    if error is not None:
+        if wire is None:
+            new_error = jnp.zeros_like(error)
+        else:
+            x = x + lax.stop_gradient(error).astype(x.dtype)
+            q, s = encode_payload(lax.stop_gradient(x), wire)
+            dq = decode_payload(q, s, wire, jnp.float32)
+            new_error = lax.stop_gradient(
+                x.astype(jnp.float32) - dq).astype(error.dtype)
+    out = _unwrap(_ag_matmul(x, ws, axis, gather_dim, decomposed, bidi,
+                             wire), kernels)
+    return (out, new_error) if error is not None else out
 
 
 def matmul_reduce_scatter(x: Array, kernel: Array, axis=ps.TP_AXIS,
                           scatter_dim: int = 1, *, impl: str = "auto",
-                          bidirectional: Optional[bool] = None) -> Array:
+                          bidirectional: Optional[bool] = None,
+                          wire: Optional[CompressionConfig] = None) -> Array:
     """``reduce_scatter(x @ kernel, scatter_dim)`` decomposed so each
     destination's partial block ships while the next block multiplies.
 
     The sequence-parallel exit of a row-parallel linear. Requires
     ``x.shape[scatter_dim] % axis_size == 0`` to decompose; falls back to
-    the monolithic collective otherwise (never an error).
+    the monolithic collective otherwise (never an error). A quantized
+    ``wire`` encodes each partial block before its ppermute (or the
+    all-to-all fallback) — accumulation stays in the dequantized domain in
+    ascending rank order.
     """
+    wire = _norm_wire(wire)
     decomposed, bidi = _prep(impl, axis, x, scatter_dim, True, bidirectional)
     n = comm._axis_size(axis)
     if n is None or n <= 1:
         return _contract(x, kernel)
-    return _mm_rs(x, kernel, axis, scatter_dim, decomposed, bidi)
+    return _mm_rs(x, kernel, axis, scatter_dim, decomposed, bidi, wire)
 
 
 def matmul_all_reduce(x: Array, kernel: Array, axis=ps.TP_AXIS,
                       pipeline_dim: int = 1, *, impl: str = "auto",
-                      bidirectional: Optional[bool] = None) -> Array:
+                      bidirectional: Optional[bool] = None,
+                      wire: Optional[CompressionConfig] = None) -> Array:
     """``all_reduce(x @ kernel)`` decomposed as matmul-reduce-scatter over
     ``pipeline_dim`` (overlapped) followed by an all-gather (movement).
 
-    The plain-TP exit of a row-parallel linear.
+    The plain-TP exit of a row-parallel linear. A quantized ``wire``
+    compresses both legs when decomposed, and falls back to the codec's
+    flat quantized all-reduce monolithically (any shape — the serving
+    engine's single-token decode steps stay compressed AND compile-once).
     """
+    wire = _norm_wire(wire)
     decomposed, bidi = _prep(impl, axis, x, pipeline_dim, True, bidirectional)
     n = comm._axis_size(axis)
     if n is None or n <= 1:
         return _contract(x, kernel)
-    return _mm_ar(x, kernel, axis, pipeline_dim, decomposed, bidi)
+    return _mm_ar(x, kernel, axis, pipeline_dim, decomposed, bidi, wire)
 
 
 def copy_matmul(x: Array, kernels: Kernels, axis=ps.TP_AXIS,
                 pipeline_dim: int = 1, *, impl: str = "auto",
-                bidirectional: Optional[bool] = None):
+                bidirectional: Optional[bool] = None,
+                wire: Optional[CompressionConfig] = None):
     """Plain-TP column entry: forward is a local matmul on the replicated
     input (identical for both impls); the *backward* input-grad all-reduce
     is decomposed into overlapped reduce-scatter + all-gather over
-    ``pipeline_dim``."""
+    ``pipeline_dim`` (cotangents wire-quantized when ``wire`` is)."""
     ws = _as_tuple(kernels)
+    wire = _norm_wire(wire)
     decomposed, bidi = _prep(impl, axis, x, pipeline_dim, True, bidirectional)
     n = comm._axis_size(axis)
     if n is None or n <= 1:
         return _unwrap(tuple(_contract(x, w) for w in ws), kernels)
-    return _unwrap(_copy_mm(x, ws, axis, pipeline_dim, decomposed, bidi),
-                   kernels)
+    return _unwrap(_copy_mm(x, ws, axis, pipeline_dim, decomposed, bidi,
+                            wire), kernels)

@@ -51,70 +51,17 @@ from jax.sharding import PartitionSpec
 
 from . import comm
 from . import mesh as ps
+# The quantizer/scale-layout machinery lives in the shared wire codec
+# (used by both these gradient collectives and the activation rings in
+# ops/collective_matmul.py); re-exported here so the PR 3 public API —
+# CompressionConfig, quantize_blockwise, wire_bytes_per_element, … — keeps
+# importing from this module.
+from .wire_codec import (  # noqa: F401  (re-exports)
+    _QMAX, _WIRE_DTYPES, CompressionConfig, _dequantize, _quantize,
+    dequantize_blockwise, quantize_blockwise, quantize_dequantize,
+    wire_bytes_per_element)
 
 Axis = Union[str, Sequence[str]]
-
-#: Largest representable magnitude of each wire dtype (int8 symmetric;
-#: float8_e4m3fn max finite = 448).
-_QMAX = {"int8": 127.0, "fp8": 448.0}
-
-_WIRE_DTYPES = ("fp32", "int8", "fp8")
-
-
-@dataclasses.dataclass(frozen=True)
-class CompressionConfig:
-    """How gradient collectives move bytes.
-
-    ``dtype``: wire dtype — ``"fp32"`` (no quantization), ``"int8"``
-    (blockwise symmetric int8) or ``"fp8"`` (float8_e4m3fn).
-    ``block_size``: elements per quantization block (one fp32 scale each).
-    ``hierarchical``: two-stage fast-axes-then-slow-axes composition.
-    ``error_feedback``: carry the quantization residue across steps
-    (consumed by the trainer; the collectives themselves only use it when
-    an ``error`` buffer is actually passed).
-    """
-
-    dtype: str = "int8"
-    block_size: int = 256
-    hierarchical: bool = False
-    error_feedback: bool = True
-
-    def __post_init__(self) -> None:
-        if self.dtype not in _WIRE_DTYPES:
-            raise ValueError(
-                f"grad-comm dtype must be one of {_WIRE_DTYPES}, got "
-                f"{self.dtype!r}")
-        if not isinstance(self.block_size, int) or self.block_size < 1:
-            raise ValueError(
-                f"block_size must be a positive int, got {self.block_size!r}")
-
-    @property
-    def quantized(self) -> bool:
-        return self.dtype != "fp32"
-
-    @property
-    def wire_bytes_per_element(self) -> float:
-        """Payload bytes per gradient element including the per-block
-        scales (1 fp32 scale per ``block_size`` elements)."""
-        return wire_bytes_per_element(self.dtype, self.block_size)
-
-    @property
-    def ratio(self) -> float:
-        """Wire-compression ratio vs fp32 (same collective shape)."""
-        return 4.0 / self.wire_bytes_per_element
-
-
-def wire_bytes_per_element(dtype: str, block_size: int = 256) -> float:
-    """Static wire accounting for one gradient element at ``dtype``:
-    1 quantized byte + one fp32 scale per block, 4 bytes unquantized.
-    Module-level and pure so the placement planner's cost model
-    (``plan/cost.py``) charges compressed collectives with the exact
-    arithmetic these collectives implement instead of duplicating it."""
-    if dtype not in ("fp32", "int8", "fp8"):
-        raise ValueError(f"unknown wire dtype {dtype!r}")
-    if dtype == "fp32":
-        return 4.0
-    return 1.0 + 4.0 / block_size
 
 
 def from_config(cfg: Any) -> Optional[CompressionConfig]:
@@ -131,72 +78,6 @@ def from_config(cfg: Any) -> Optional[CompressionConfig]:
         block_size=int(getattr(oc, "grad_comm_block_size", 256)),
         hierarchical=hier,
         error_feedback=bool(getattr(oc, "grad_comm_error_feedback", True)))
-
-
-# --------------------------------------------------------------------------
-# Blockwise quantization
-# --------------------------------------------------------------------------
-
-def _quantize(x: jax.Array, dtype: str) -> Tuple[jax.Array,
-                                                 Optional[jax.Array]]:
-    """Quantize ``x`` (f32, blocks along the last dim) → ``(q, scales)``;
-    identity ``(x, None)`` for fp32."""
-    if dtype == "fp32":
-        return x, None
-    qmax = _QMAX[dtype]
-    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
-    # all-zero blocks get scale 1.0: q is exactly 0, dequant exact
-    scale = jnp.where(amax > 0, amax / qmax, 1.0).astype(jnp.float32)
-    y = x / scale
-    if dtype == "int8":
-        q = jnp.clip(jnp.round(y), -qmax, qmax).astype(jnp.int8)
-    else:
-        q = y.astype(jnp.float8_e4m3fn)
-    return q, scale
-
-
-def _dequantize(q: jax.Array, scale: Optional[jax.Array],
-                dtype: str) -> jax.Array:
-    if dtype == "fp32":
-        return q
-    return q.astype(jnp.float32) * scale
-
-
-def quantize_blockwise(x: jax.Array, config: CompressionConfig
-                       ) -> Tuple[jax.Array, Optional[jax.Array], int]:
-    """Flatten + zero-pad ``x`` into ``[n_blocks, block_size]`` and quantize.
-    Returns ``(q, scales, n_elements)``; for fp32 configs ``q`` is the
-    padded f32 blocks and ``scales`` is None."""
-    flat = x.astype(jnp.float32).reshape(-1)
-    m = flat.shape[0]
-    b = config.block_size
-    nb = max(1, -(-m // b))
-    pad = nb * b - m
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
-    q, s = _quantize(flat.reshape(nb, b), config.dtype)
-    return q, s, m
-
-
-def dequantize_blockwise(q: jax.Array, scales: Optional[jax.Array],
-                         shape: Sequence[int],
-                         config: CompressionConfig) -> jax.Array:
-    """Inverse of :func:`quantize_blockwise` (drops the padding)."""
-    flat = _dequantize(q, scales, config.dtype).reshape(-1)
-    n = 1
-    for d in shape:
-        n *= int(d)
-    return flat[:n].reshape(tuple(shape))
-
-
-def quantize_dequantize(x: jax.Array,
-                        config: CompressionConfig) -> jax.Array:
-    """The round-trip operator ``DQ(Q(x))`` — what the receiving side of a
-    compressed collective reconstructs from this rank's payload."""
-    if not config.quantized:
-        return x
-    q, s, _ = quantize_blockwise(x, config)
-    return dequantize_blockwise(q, s, jnp.shape(x), config).astype(x.dtype)
 
 
 # --------------------------------------------------------------------------

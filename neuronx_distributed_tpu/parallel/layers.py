@@ -99,6 +99,12 @@ class ColumnParallelLinear(nn.Module):
     axis: str = ps.TP_AXIS
     seq_dim: int = 1
     overlap_comm: Optional[bool] = None
+    # Activation-wire compression (docs/comm_compression.md): None/"fp32"
+    # keeps the entry collective full precision; "int8"/"fp8" codec-encode
+    # its payload — on the decomposed ring when ``overlap_comm`` engages,
+    # on the monolithic collective otherwise (LoRA keeps the fp path).
+    activation_comm_dtype: Optional[str] = None
+    activation_comm_block_size: int = 256
     # LoRA adapter (reference modules/lora/tp_layer.py LoraParallelLinear):
     # 0 disables; A is replicated, B is output-sharded like the kernel.
     lora_rank: int = 0
@@ -126,19 +132,26 @@ class ColumnParallelLinear(nn.Module):
                 _partitioned(nn.initializers.zeros_init(), (None, self.axis)),
                 (self.lora_rank, out_local), self.param_dtype)
 
+        wire = cm.wire_config(self.activation_comm_dtype,
+                              self.activation_comm_block_size)
         engaged = self.lora_rank == 0 and cm.overlap_engaged(
             self.overlap_comm, self.axis, x.shape, self.seq_dim,
             needs_divisible=not self.sequence_parallel)
-        if engaged:
+        # Quantized wire without an engaged ring still routes through the
+        # primitives monolithically — the collective is compressed either
+        # way, and the impl choice stays static on shapes (no recompiles).
+        if engaged or (wire is not None and self.lora_rank == 0
+                       and _bound_size(self.axis) is not None):
+            impl = "decomposed" if engaged else "monolithic"
             x = x.astype(self.dtype)
             if self.sequence_parallel:
                 y = cm.all_gather_matmul(x, kernel.astype(self.dtype),
                                          self.axis, self.seq_dim,
-                                         impl="decomposed")
+                                         impl=impl, wire=wire)
             else:
                 y = cm.copy_matmul(x, kernel.astype(self.dtype),
                                    self.axis, self.seq_dim,
-                                   impl="decomposed")
+                                   impl=impl, wire=wire)
             if bias is not None:
                 y = y + bias.astype(self.dtype)
             if self.gather_output:
@@ -363,6 +376,17 @@ class RowParallelLinear(nn.Module):
     axis: str = ps.TP_AXIS
     seq_dim: int = 1
     overlap_comm: Optional[bool] = None
+    # Activation-wire compression for the exit collective (see
+    # ColumnParallelLinear) — quantizes the reduce-scatter / all-reduce.
+    activation_comm_dtype: Optional[str] = None
+    activation_comm_block_size: int = 256
+    # Reduced-sync TP (PAPERS.md "Tensor-Parallelism with Partially
+    # Synchronized Activations"): False elides the exit all-reduce — each
+    # rank keeps its local partial product (bias split 1/n so the shares
+    # still sum to the true output) and the model resyncs periodically via
+    # ``cm.tp_sync_schedule``. Ignored under ``sequence_parallel`` (the
+    # reduce-scatter also reshapes, so it cannot be elided).
+    tp_sync: bool = True
     # LoRA adapter: A is input-sharded like the kernel, B replicated; the
     # lora partial sums ride the layer's existing all-reduce/reduce-scatter.
     lora_rank: int = 0
@@ -379,18 +403,49 @@ class RowParallelLinear(nn.Module):
             _partitioned(self.kernel_init, (self.axis, None)),
             (in_local, self.features), self.param_dtype)
         x = x.astype(self.dtype)
+        if not self.tp_sync and not self.sequence_parallel:
+            # Reduced-sync exit: local partial product, no collective. Each
+            # rank holds a 1/n share of the true output (bias included), so
+            # a later psum of the accumulated deviation recovers the full
+            # activation at the model's periodic resync points.
+            y = jnp.dot(x, kernel.astype(self.dtype))
+            if self.lora_rank > 0:
+                lora_a = self.param(
+                    "lora_a",
+                    _partitioned(default_kernel_init, (self.axis, None)),
+                    (in_local, self.lora_rank), self.param_dtype)
+                lora_b = self.param(
+                    "lora_b",
+                    _partitioned(nn.initializers.zeros_init(), (None, None)),
+                    (self.lora_rank, self.features), self.param_dtype)
+                scale = self.lora_alpha / self.lora_rank
+                x_l = _lora_input(self, x, self.lora_dropout)
+                y = y + scale * jnp.dot(
+                    jnp.dot(x_l, lora_a.astype(self.dtype)),
+                    lora_b.astype(self.dtype))
+            if self.use_bias:
+                bias = self.param("bias",
+                                  _partitioned(self.bias_init, (None,)),
+                                  (self.features,), self.param_dtype)
+                n = _bound_size(self.axis) or 1
+                y = y + bias.astype(self.dtype) / n
+            return y
+        wire = cm.wire_config(self.activation_comm_dtype,
+                              self.activation_comm_block_size)
         engaged = self.lora_rank == 0 and cm.overlap_engaged(
             self.overlap_comm, self.axis, x.shape, self.seq_dim,
             needs_divisible=True)
-        if engaged:
+        if engaged or (wire is not None and self.lora_rank == 0
+                       and _bound_size(self.axis) is not None):
+            impl = "decomposed" if engaged else "monolithic"
             if self.sequence_parallel:
                 y = cm.matmul_reduce_scatter(x, kernel.astype(self.dtype),
                                              self.axis, self.seq_dim,
-                                             impl="decomposed")
+                                             impl=impl, wire=wire)
             else:
                 y = cm.matmul_all_reduce(x, kernel.astype(self.dtype),
                                          self.axis, self.seq_dim,
-                                         impl="decomposed")
+                                         impl=impl, wire=wire)
             if self.use_bias:
                 bias = self.param("bias",
                                   _partitioned(self.bias_init, (None,)),
@@ -520,6 +575,10 @@ class GQAQKVColumnParallelLinear(nn.Module):
     # replicated-KV path (kv_size_multiplier > 1) and activation-space LoRA
     # fall back; weight-space LoRA folds into the kernels and rides along.
     overlap_comm: Optional[bool] = None
+    # Activation-wire compression for the shared entry collective (see
+    # ColumnParallelLinear); same replicated-KV / LoRA fallbacks apply.
+    activation_comm_dtype: Optional[str] = None
+    activation_comm_block_size: int = 256
     # LoRA adapters (weight-space; reference LoraGQAQKVParallelLinear).
     # With lora_dropout active (rate > 0 and a "dropout" rng supplied) the
     # adapters switch to activation space — dropout on the adapter input
@@ -642,20 +701,24 @@ class GQAQKVColumnParallelLinear(nn.Module):
                 bv = jax.lax.dynamic_slice_in_dim(
                     bv, head * self.head_dim, self.head_dim, axis=0)
 
+        wire = cm.wire_config(self.activation_comm_dtype,
+                              self.activation_comm_block_size)
         engaged = (mult == 1 and not lora_act and cm.overlap_engaged(
             self.overlap_comm, self.axis, x.shape, self.seq_dim,
             needs_divisible=not self.sequence_parallel))
-        if engaged:
+        if engaged or (wire is not None and mult == 1 and not lora_act
+                       and _bound_size(self.axis) is not None):
+            impl = "decomposed" if engaged else "monolithic"
             x = x.astype(self.dtype)
             kernels = (wq.astype(self.dtype), wk.astype(self.dtype),
                        wv.astype(self.dtype))
             if self.sequence_parallel:
                 q, k, v = cm.all_gather_matmul(x, kernels, self.axis,
                                                self.seq_dim,
-                                               impl="decomposed")
+                                               impl=impl, wire=wire)
             else:
                 q, k, v = cm.copy_matmul(x, kernels, self.axis,
-                                         self.seq_dim, impl="decomposed")
+                                         self.seq_dim, impl=impl, wire=wire)
             if self.use_bias:
                 q = q + bq.astype(self.dtype)
                 k = k + bk.astype(self.dtype)

@@ -1,0 +1,213 @@
+"""Shared wire codec for compressed collectives.
+
+One quantizer for every byte this repo puts on the wire: the gradient
+collectives in :mod:`.comm_compressed` (PR 3) and the activation rings in
+:mod:`..ops.collective_matmul` both ship blockwise-quantized payloads with
+exactly this scale layout, so the placement planner's cost model can charge
+both with the same :func:`wire_bytes_per_element` arithmetic.
+
+* **Blockwise symmetric quantization** (EQuARX-style, arxiv 2506.17615):
+  a payload is flattened into ``block_size``-element blocks, each block
+  transmitted as int8 (or float8_e4m3fn) values plus one fp32 scale
+  ``amax / qmax``. All-zero blocks get scale 1.0 so their round-trip is
+  exact. int8 at the default 256-element blocks moves
+  ``1 + 4/256 ≈ 1.016`` bytes per element — a ~3.94x wire reduction.
+
+* **Scale layout**: scales ride *alongside* the quantized values with the
+  same leading block structure (``q: [..., nb, b]``, ``scales:
+  [..., nb, 1]``), so a collective ships both through the identical
+  permute/gather pattern and the receiver dequantizes positionally.
+
+Everything here is pure array math — no mesh axes, no collectives — and
+safe to call inside or outside ``shard_map``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: Largest representable magnitude of each wire dtype (int8 symmetric;
+#: float8_e4m3fn max finite = 448).
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+_WIRE_DTYPES = ("fp32", "int8", "fp8")
+
+
+def wire_bytes_per_element(dtype: str, block_size: int = 256) -> float:
+    """Static wire accounting for one payload element at ``dtype``:
+    1 quantized byte + one fp32 scale per block, 4 bytes unquantized.
+    Module-level and pure so the placement planner's cost model
+    (``plan/cost.py``) charges compressed collectives — gradient *and*
+    activation — with the exact arithmetic the codec implements instead
+    of duplicating it. Single source of truth:
+    :attr:`CompressionConfig.wire_bytes_per_element` delegates here."""
+    if dtype not in _WIRE_DTYPES:
+        raise ValueError(f"unknown wire dtype {dtype!r}")
+    if dtype == "fp32":
+        return 4.0
+    return 1.0 + 4.0 / block_size
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressionConfig:
+    """How a compressed collective moves bytes.
+
+    ``dtype``: wire dtype — ``"fp32"`` (no quantization), ``"int8"``
+    (blockwise symmetric int8) or ``"fp8"`` (float8_e4m3fn).
+    ``block_size``: elements per quantization block (one fp32 scale each).
+    ``hierarchical``: two-stage fast-axes-then-slow-axes composition
+    (gradient collectives only; ignored by the activation rings).
+    ``error_feedback``: carry the quantization residue across steps
+    (consumed by the trainer; the collectives themselves only use it when
+    an ``error`` buffer is actually passed).
+
+    Frozen and hashable, so instances can ride through
+    ``jax.custom_vjp`` ``nondiff_argnums`` and jit static arguments
+    without triggering recompiles across identical configs.
+    """
+
+    dtype: str = "int8"
+    block_size: int = 256
+    hierarchical: bool = False
+    error_feedback: bool = True
+
+    def __post_init__(self) -> None:
+        if self.dtype not in _WIRE_DTYPES:
+            raise ValueError(
+                f"wire dtype must be one of {_WIRE_DTYPES}, got "
+                f"{self.dtype!r}")
+        if not isinstance(self.block_size, int) or self.block_size < 1:
+            raise ValueError(
+                f"block_size must be a positive int, got {self.block_size!r}")
+
+    @property
+    def quantized(self) -> bool:
+        return self.dtype != "fp32"
+
+    @property
+    def wire_bytes_per_element(self) -> float:
+        """Payload bytes per element including the per-block scales
+        (1 fp32 scale per ``block_size`` elements)."""
+        return wire_bytes_per_element(self.dtype, self.block_size)
+
+    @property
+    def ratio(self) -> float:
+        """Wire-compression ratio vs fp32 (same collective shape)."""
+        return 4.0 / self.wire_bytes_per_element
+
+
+# --------------------------------------------------------------------------
+# Blockwise quantization
+# --------------------------------------------------------------------------
+
+def _quantize(x: jax.Array, dtype: str) -> Tuple[jax.Array,
+                                                 Optional[jax.Array]]:
+    """Quantize ``x`` (f32, blocks along the last dim) → ``(q, scales)``;
+    identity ``(x, None)`` for fp32."""
+    if dtype == "fp32":
+        return x, None
+    qmax = _QMAX[dtype]
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    # all-zero blocks get scale 1.0: q is exactly 0, dequant exact
+    scale = jnp.where(amax > 0, amax / qmax, 1.0).astype(jnp.float32)
+    y = x / scale
+    if dtype == "int8":
+        q = jnp.clip(jnp.round(y), -qmax, qmax).astype(jnp.int8)
+    else:
+        q = y.astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+def _dequantize(q: jax.Array, scale: Optional[jax.Array],
+                dtype: str) -> jax.Array:
+    if dtype == "fp32":
+        return q
+    return q.astype(jnp.float32) * scale
+
+
+def quantize_blockwise(x: jax.Array, config: CompressionConfig
+                       ) -> Tuple[jax.Array, Optional[jax.Array], int]:
+    """Flatten + zero-pad ``x`` into ``[n_blocks, block_size]`` and quantize.
+    Returns ``(q, scales, n_elements)``; for fp32 configs ``q`` is the
+    padded f32 blocks and ``scales`` is None."""
+    flat = x.astype(jnp.float32).reshape(-1)
+    m = flat.shape[0]
+    b = config.block_size
+    nb = max(1, -(-m // b))
+    pad = nb * b - m
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    q, s = _quantize(flat.reshape(nb, b), config.dtype)
+    return q, s, m
+
+
+def dequantize_blockwise(q: jax.Array, scales: Optional[jax.Array],
+                         shape: Sequence[int],
+                         config: CompressionConfig) -> jax.Array:
+    """Inverse of :func:`quantize_blockwise` (drops the padding)."""
+    flat = _dequantize(q, scales, config.dtype).reshape(-1)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return flat[:n].reshape(tuple(shape))
+
+
+def quantize_dequantize(x: jax.Array,
+                        config: CompressionConfig) -> jax.Array:
+    """The round-trip operator ``DQ(Q(x))`` — what the receiving side of a
+    compressed collective reconstructs from this rank's payload."""
+    if not config.quantized:
+        return x
+    q, s, _ = quantize_blockwise(x, config)
+    return dequantize_blockwise(q, s, jnp.shape(x), config).astype(x.dtype)
+
+
+# --------------------------------------------------------------------------
+# Ring-payload codec (fixed tensor layout, no flattening)
+# --------------------------------------------------------------------------
+
+def encode_payload(x: jax.Array, config: Optional[CompressionConfig]
+                   ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Quantize a ring/collective payload *in place* (no flatten, no pad):
+    the trailing dim is split into whole ``block_size`` blocks when it
+    divides evenly, else the whole trailing dim becomes one block. Returns
+    ``(q, scales)`` with ``scales`` broadcastable against the blocked view;
+    identity ``(x, None)`` for fp32 / None configs.
+
+    Shipping the payload in its original layout (rather than the flat
+    ``[nb, b]`` layout of :func:`quantize_blockwise`) keeps the ppermute
+    shapes identical to the uncompressed ring, so the decomposed
+    collective-matmuls stay layout-compatible with their monolithic
+    fallbacks — block boundaries land at the same trailing-dim offsets
+    either way, which is what makes ring-vs-monolithic quantized parity
+    bitwise (see docs/tp_overlap.md)."""
+    if config is None or not config.quantized:
+        return x, None
+    d = x.shape[-1] if x.ndim else 1
+    b = config.block_size
+    if d % b == 0 and d >= b:
+        blocked = x.astype(jnp.float32).reshape(x.shape[:-1] + (d // b, b))
+        q, s = _quantize(blocked, config.dtype)
+        return q.reshape(x.shape), s
+    q, s = _quantize(x.astype(jnp.float32), config.dtype)
+    return q, s
+
+
+def decode_payload(q: jax.Array, scales: Optional[jax.Array],
+                   config: Optional[CompressionConfig],
+                   out_dtype: jnp.dtype = jnp.float32) -> jax.Array:
+    """Inverse of :func:`encode_payload`; fp32 payloads pass through
+    (already in their original dtype)."""
+    if config is None or not config.quantized or scales is None:
+        return q
+    d = q.shape[-1] if q.ndim else 1
+    b = config.block_size
+    if d % b == 0 and d >= b:
+        blocked = q.reshape(q.shape[:-1] + (d // b, b))
+        return _dequantize(blocked, scales, config.dtype) \
+            .reshape(q.shape).astype(out_dtype)
+    return _dequantize(q, scales, config.dtype).astype(out_dtype)

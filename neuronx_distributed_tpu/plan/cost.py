@@ -193,6 +193,10 @@ class Plan:
     zero1: bool = True
     grad_comm_dtype: str = "fp32"       # fp32 | int8 | fp8
     grad_comm_hierarchical: bool = False
+    # activation-collective wire dtype (ParallelConfig.
+    # tp_activation_comm_dtype): scales the TP-collective term by the
+    # codec's wire_bytes_per_element
+    tp_act_comm_dtype: str = "fp32"     # fp32 | int8 | fp8
     tp_overlap: bool = False
     sequence_parallel: bool = False
     remat: bool = True
@@ -207,6 +211,8 @@ class Plan:
         tags.append("zero1" if self.zero1 else "ddp")
         tags.append(self.grad_comm_dtype
                     + ("/hier" if self.grad_comm_hierarchical else "/flat"))
+        if self.tp_act_comm_dtype != "fp32":
+            tags.append(f"act:{self.tp_act_comm_dtype}")
         if self.tp_overlap:
             tags.append("overlap")
         if self.sequence_parallel:
@@ -252,14 +258,15 @@ def all_to_all_s(nbytes: float, n: int, link: LinkSpec) -> float:
 
 
 def wire_bytes_per_element(dtype: str, block_size: int = 256) -> float:
-    """Bytes per fp32 gradient element on the wire for the compressed
-    collectives: 1 quantized byte + one fp32 scale per block. Delegates
-    to the static accounting exported by parallel/comm_compressed.py so
-    the model charges exactly what the collectives ship; the closed-form
-    fallback keeps this module importable without jax (equality is
-    regression-pinned in tests/test_plan.py)."""
+    """Bytes per fp32 element on the wire for the compressed collectives
+    (gradient rings and quantized TP-activation collectives alike):
+    1 quantized byte + one fp32 scale per block. Delegates to the static
+    accounting exported by parallel/wire_codec.py so the model charges
+    exactly what the collectives ship; the closed-form fallback keeps
+    this module importable without jax (equality is regression-pinned in
+    tests/test_plan.py)."""
     try:
-        from ..parallel.comm_compressed import (
+        from ..parallel.wire_codec import (
             wire_bytes_per_element as _impl,
         )
     except ImportError:
@@ -267,7 +274,7 @@ def wire_bytes_per_element(dtype: str, block_size: int = 256) -> float:
             return 4.0
         if dtype in ("int8", "fp8"):
             return 1.0 + 4.0 / block_size
-        raise ValueError(f"unknown grad_comm_dtype {dtype!r}")
+        raise ValueError(f"unknown comm dtype {dtype!r}")
     return _impl(dtype, block_size)
 
 
@@ -303,11 +310,15 @@ TP_OVERLAP_HIDDEN_FRACTION = 0.7
 def tp_comm_s(plan: Plan, m: ModelSpec, hw: HardwareSpec) -> float:
     """Activation collectives of the TP layers over one step. Per layer,
     Megatron-SP moves 2 all-gathers + 2 reduce-scatters of
-    ``[tokens_local, hidden]`` forward and the duals backward."""
+    ``[tokens_local, hidden]`` forward and the duals backward. When the
+    plan quantizes the activation wire (``tp_act_comm_dtype``), the
+    payload shrinks by the codec's per-element accounting relative to
+    the fp32 wire the collectives would otherwise ship."""
     if plan.tp <= 1:
         return 0.0
     tokens_local = m.tokens_per_step / plan.dp   # per TP group
-    nbytes = tokens_local * m.hidden * m.act_bytes
+    nbytes = (tokens_local * m.hidden * m.act_bytes
+              * wire_bytes_per_element(plan.tp_act_comm_dtype) / 4.0)
     per_layer = 4 * (ring_all_gather_s(nbytes, plan.tp, hw.ici)
                      + ring_reduce_scatter_s(nbytes, plan.tp, hw.ici))
     total = m.layers * per_layer

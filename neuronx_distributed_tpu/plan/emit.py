@@ -43,6 +43,8 @@ def plan_to_config_kwargs(plan: Plan) -> Dict[str, Any]:
         kwargs["dcn_data_parallel_size"] = plan.dcn_dp
     if plan.tp_overlap:
         kwargs["tp_overlap_comm"] = True
+    if plan.tp_act_comm_dtype != "fp32":
+        kwargs["tp_activation_comm_dtype"] = plan.tp_act_comm_dtype
     if plan.sequence_parallel:
         kwargs["sequence_parallel"] = True
     opt = OptimizerConfig(

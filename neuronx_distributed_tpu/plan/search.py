@@ -115,6 +115,7 @@ def _strategies(plan: Plan, m: ModelSpec) -> List[Plan]:
     only proposed where it engages (shared predicate with the op), and
     hierarchical/compressed variants only where a data axis exists."""
     dtypes = ["fp32"] if plan.dp == 1 else ["fp32", "int8"]
+    act_dtypes = ["fp32"] if plan.tp <= 1 else ["fp32", "int8"]
     hiers = [False] if plan.dcn_dp <= 1 else [False, True]
     overlaps = [False]
     sp = plan.tp > 1 and m.seq % plan.tp == 0
@@ -122,9 +123,10 @@ def _strategies(plan: Plan, m: ModelSpec) -> List[Plan]:
     if tp_overlap_engagement(probe, m):
         overlaps.append(True)
     out = []
-    for dt, hi, ov, rm in itertools.product(dtypes, hiers, overlaps,
-                                            (False, True)):
+    for dt, act, hi, ov, rm in itertools.product(dtypes, act_dtypes, hiers,
+                                                 overlaps, (False, True)):
         out.append(replace(plan, grad_comm_dtype=dt,
+                           tp_act_comm_dtype=act,
                            grad_comm_hierarchical=hi, tp_overlap=ov,
                            sequence_parallel=sp, remat=rm,
                            zero1=plan.dp > 1))
@@ -209,4 +211,5 @@ def search(m: ModelSpec, hw: HardwareSpec, devices: int, *,
 
 def _plan_key(p: Plan) -> tuple:
     return (p.tp, p.pp, p.dp, p.ep, p.num_microbatches,
-            p.grad_comm_dtype, p.grad_comm_hierarchical, p.tp_overlap)
+            p.grad_comm_dtype, p.tp_act_comm_dtype,
+            p.grad_comm_hierarchical, p.tp_overlap)

@@ -60,6 +60,8 @@ def dict_to_config_kwargs(doc: Dict[str, Any]) -> Dict[str, Any]:
         elif key in ("tensor_parallel_size", "pipeline_parallel_size",
                      "context_parallel_size", "expert_parallel_size",
                      "dcn_data_parallel_size", "tp_overlap_comm",
+                     "tp_activation_comm_dtype",
+                     "tp_activation_sync_fraction",
                      "sequence_parallel", "seed"):
             kwargs[key] = value
         else:
@@ -83,7 +85,9 @@ def config_to_dict(cfg) -> Dict[str, Any]:
         default = None if key in ("dcn_data_parallel_size",
                                   "tp_overlap_comm") else (
             False if key == "sequence_parallel" else
-            0 if key == "seed" else 1)
+            0 if key == "seed" else
+            "fp32" if key == "tp_activation_comm_dtype" else
+            1.0 if key == "tp_activation_sync_fraction" else 1)
         if value != default:
             doc[key] = value
     return doc

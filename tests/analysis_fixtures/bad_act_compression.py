@@ -1,0 +1,36 @@
+"""Fixture: activation-collective compression violations (never imported,
+only parsed). The ``CompressionConfig`` import puts an activation
+compression config in scope, so full-precision collectives on
+activation-named variables contradict the module's own wire format."""
+
+from jax import lax
+
+from neuronx_distributed_tpu.parallel.wire_codec import CompressionConfig
+
+WIRE = CompressionConfig(dtype="int8")
+
+
+def gather_hidden(hidden):
+    # raw all_gather on an activation while the module configures a
+    # quantized wire — ships 4x the bytes the config promises
+    return lax.all_gather(hidden, "tp", axis=1, tiled=True)
+
+
+def reduce_activations(x):
+    # raw psum on the canonical activation name
+    return lax.psum(x, "tp")
+
+
+def average_acts(acts):
+    # pmean counts too
+    return lax.pmean(acts, "tp")
+
+
+def losses_are_fine(loss):
+    # loss/metric collectives are not activation wires: must NOT fire
+    return lax.pmean(loss, "dp")
+
+
+def weights_are_fine(kernel):
+    # parameter names don't match the activation convention either
+    return lax.psum(kernel, "tp")

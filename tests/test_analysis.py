@@ -87,6 +87,47 @@ def test_comm_compression_exempts_parallel_package():
     assert [f.rule for f in flagged] == ["comm-compression"]
 
 
+def test_comm_compression_activation_extension_fires_on_fixture():
+    fs = _lint("bad_act_compression.py")
+    assert _rules(fs) == {"comm-compression"}
+    # the three activation-named call sites fire; loss/param ones don't
+    assert len([f for f in fs if not f.suppressed]) == 3
+    msgs = " | ".join(f.message for f in fs)
+    assert "full-precision" in msgs
+    assert "wire_config" in msgs
+    assert "lax.all_gather" in msgs and "lax.psum" in msgs \
+        and "lax.pmean" in msgs
+
+
+def test_comm_compression_activation_extension_needs_config_in_scope():
+    # identical collective, no compression config in scope: activation
+    # collectives are the model's own business and the rule stays quiet
+    quiet = ("from jax import lax\n"
+             "def gather(hidden):\n"
+             "    return lax.all_gather(hidden, 'tp', tiled=True)\n")
+    assert analyze_source(quiet, "mymodel/blocks.py",
+                          axes=DEFAULT_AXES) == []
+    # any of the config markers arms it
+    armed = ("from jax import lax\n"
+             "ACT_WIRE = 'int8'  # tp_activation_comm_dtype\n"
+             "def gather(hidden):\n"
+             "    return lax.all_gather(hidden, 'tp', tiled=True)\n")
+    flagged = analyze_source(armed, "mymodel/blocks.py", axes=DEFAULT_AXES)
+    assert [f.rule for f in flagged] == ["comm-compression"]
+    # ops/ composes raw collectives with the codec by design: exempt
+    assert analyze_source(
+        armed, "neuronx_distributed_tpu/ops/collective_matmul.py",
+        axes=DEFAULT_AXES) == []
+
+
+def test_models_package_comm_compression_self_gate():
+    # the model families reference the activation-wire knobs, so they are
+    # in scope for the extension — and must route every activation
+    # collective through the parallel layers / collective_matmul
+    pkg = os.path.join(REPO, "neuronx_distributed_tpu", "models")
+    assert analyze_paths([pkg], select=["comm-compression"]) == []
+
+
 def test_tp_overlap_fires_on_fixture():
     # the gradient-psum case belongs to comm-compression, so select just
     # this rule; 3 blocking collective→matmul pairs fire, the reassigned /

@@ -229,6 +229,54 @@ def test_prefers_hierarchical_compressed_when_dcn():
         < step_cost(twin, MID, HW).total_s
 
 
+def test_activation_compression_strategies_ranked():
+    """The strategy grid proposes int8 activation wires wherever tp > 1,
+    the cost model charges them at the codec's wire-bytes accounting
+    (strictly cheaper TP-comm than the fp32 twin), and the prune
+    accounting invariant survives the extra grid dimension."""
+    result = search(MID, HW, 64, dcn_dp=4, top_k=10)
+    assert result.n_enumerated == len(result.ranked) + len(result.rejected)
+    acts = {r.plan.tp_act_comm_dtype for r in result.ranked
+            if r.plan.tp > 1}
+    assert "int8" in acts
+    # tp=1 layouts never grow the pointless dimension
+    for r in result.ranked:
+        if r.plan.tp <= 1:
+            assert r.plan.tp_act_comm_dtype == "fp32"
+    best = result.best.plan
+    if best.tp > 1:
+        assert best.tp_act_comm_dtype == "int8"
+        assert "act:int8" in best.describe()
+        twin = dataclasses.replace(best, tp_act_comm_dtype="fp32")
+        assert step_cost(best, MID, HW).tp_comm_s \
+            < step_cost(twin, MID, HW).tp_comm_s
+    # the cost scaling is exactly the codec ratio
+    p8 = Plan(devices=8, tp=8, dp=1, tp_act_comm_dtype="int8")
+    p32 = dataclasses.replace(p8, tp_act_comm_dtype="fp32")
+    assert step_cost(p8, MID, HW).tp_comm_s > 0
+    # bandwidth term scales by exactly the codec ratio; only the ring
+    # latency term (~0.1% here) is payload-independent
+    ratio = wire_bytes_per_element("int8") / 4.0
+    assert step_cost(p8, MID, HW).tp_comm_s == pytest.approx(
+        step_cost(p32, MID, HW).tp_comm_s * ratio, rel=1e-2)
+
+
+def test_emit_activation_dtype_round_trips():
+    from neuronx_distributed_tpu import neuronx_distributed_config
+    from neuronx_distributed_tpu.scripts.yaml_converter import (
+        dict_to_config_kwargs)
+
+    plan = Plan(devices=8, tp=4, dp=2, tp_act_comm_dtype="int8")
+    kwargs = plan_to_config_kwargs(plan)
+    assert kwargs["tp_activation_comm_dtype"] == "int8"
+    doc = plan_to_yaml_dict(plan)
+    assert doc["tp_activation_comm_dtype"] == "int8"
+    cfg = neuronx_distributed_config(init_mesh=False,
+                                     **dict_to_config_kwargs(doc))
+    assert cfg == plan_to_config(plan)
+    assert cfg.parallel.tp_activation_comm_dtype == "int8"
+
+
 # ---------------------------------------------------------------------------
 # TP overlap engagement (shared predicate with ops.collective_matmul)
 # ---------------------------------------------------------------------------
