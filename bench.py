@@ -71,7 +71,7 @@ import jax.numpy as jnp  # noqa: E402
 
 
 def main(chaos_spec=None, serving=False, overlap=False, router=False,
-         prefix_heavy=False, plan_mode=False):
+         prefix_heavy=False, plan_mode=False, obs_mode=False):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -273,6 +273,19 @@ def main(chaos_spec=None, serving=False, overlap=False, router=False,
 
             traceback.print_exc()
             print(f"bench: plan metric failed: {e!r}", file=sys.stderr)
+
+    # observability self-measurement drill (docs/observability.md): opt-in
+    # via --obs; disabled-mode overhead of the obs hooks on the serving
+    # path, compile events from the tracker, and the wire-byte counters
+    # cross-checked against the codec's predicted int8 ratio
+    if obs_mode:
+        try:
+            aux.update(obs_metric(platform, n_dev))
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: obs metric failed: {e!r}", file=sys.stderr)
 
     # gradient-collective microbenchmark (docs/comm_compression.md): time a
     # gradient-sized all-reduce at fp32 vs blockwise int8 and report the
@@ -813,6 +826,160 @@ def comm_metric(platform: str, n_dev: int) -> dict:
     }
 
 
+def obs_metric(platform: str, n_dev: int) -> dict:
+    """Observability self-measurement drill (docs/observability.md):
+
+    * **obs_overhead_pct** — the same tiny serving workload through
+      :class:`ServingEngine` with the tracer+metrics enabled vs disabled
+      (min-of-N each, interleaved, to damp host timing noise). Disabled is
+      the default mode, so this is the price of *leaving the hooks in*.
+    * **obs_compile_events** — ``nxd_compile_total`` after the drill; the
+      packed worker compiles exactly once, and any recompile the engine
+      sneaks in shows up here (and as a ``recompile_detected`` event).
+    * **obs_wire_bytes_int8_ratio** — run a quantized ``all_reduce``
+      under ``shard_map`` on the real mesh and read the compressed-vs-raw
+      ratio back from the *runtime counters*; ``vs_baseline`` is measured
+      over the codec's ``wire_bytes_per_element`` prediction (~3.94x), so
+      1.0 means the accounting and the codec agree. On a 1-device mesh
+      the collectives are no-ops, so the codec arithmetic is pushed
+      through the same accounting path instead.
+
+    RETURNS aux entries keyed by metric name — never prints a JSON line.
+    """
+    import numpy as np
+    from flax.core import meta
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_tpu import obs
+    from neuronx_distributed_tpu.inference.engine import (EngineConfig,
+                                                          EngineStats,
+                                                          ServingEngine)
+    from neuronx_distributed_tpu.models import llama
+    from neuronx_distributed_tpu.parallel import comm_compressed as cc
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.parallel.wire_codec import (
+        CompressionConfig, blockwise_wire_bytes)
+
+    was_enabled = obs.enabled()
+    try:
+        ps.destroy_model_parallel()
+        ps.initialize_model_parallel()
+        obs.reset()
+        obs.enable()  # on for the warm run so the first compile is counted
+
+        # the serving drill's model size, not the 2-layer test toy: the
+        # overhead is per-step host work, so a toy step inflates the
+        # percentage far beyond what any real deployment would see
+        if platform == "cpu":
+            cfg = llama.LlamaConfig(
+                vocab_size=1024, hidden_size=256, intermediate_size=704,
+                num_layers=4, num_heads=8, num_kv_heads=8, max_seq_len=512)
+            n_req, max_slots, budget = 6, 4, 16
+            plen_range, new_range = (8, 25), (4, 13)
+            block_size, num_blocks = 8, 64
+        else:
+            cfg = llama.LlamaConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_layers=16, num_heads=8, num_kv_heads=8,
+                max_seq_len=4096)
+            n_req, max_slots, budget = 16, 8, 64
+            plen_range, new_range = (32, 129), (16, 65)
+            block_size, num_blocks = 16, 256
+        ecfg = EngineConfig(
+            block_size=block_size, num_blocks=num_blocks,
+            max_slots=max_slots,
+            max_blocks_per_seq=-(-cfg.max_seq_len // block_size),
+            token_budget=budget, kv_dtype=cfg.dtype)
+        params = meta.unbox(llama.LlamaForCausalLM(cfg).init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+        rng = np.random.RandomState(0)
+        reqs = [(rng.randint(0, cfg.vocab_size,
+                             (rng.randint(*plen_range),)).tolist(),
+                 int(rng.randint(*new_range))) for _ in range(n_req)]
+        eng = ServingEngine(cfg, params, ecfg)
+        eng.submit(reqs[0][0], reqs[0][1], uid="warm")  # compile + warm
+        eng.run()
+
+        def run_once():
+            eng.stats, eng.results = EngineStats(), {}
+            eng._t0 = eng._clock()
+            for i, (p, n) in enumerate(reqs):
+                eng.submit(p, n, uid=f"r{i}")
+            t0 = time.perf_counter()
+            eng.run()
+            return time.perf_counter() - t0
+
+        # interleave on/off runs, alternating which goes first each round,
+        # so warm-up drift (page cache, thermal) cancels instead of
+        # systematically favouring whichever mode runs second
+        t_on, t_off = float("inf"), float("inf")
+        for r in range(4):
+            for on in ((False, True) if r % 2 == 0 else (True, False)):
+                if on:
+                    obs.enable()
+                    t_on = min(t_on, run_once())
+                else:
+                    obs.disable()
+                    t_off = min(t_off, run_once())
+        obs.enable()
+        overhead_pct = (t_on - t_off) / t_off * 100.0
+
+        events = obs.compile_events()
+        compile_once = eng.compile_count() == 1
+
+        # wire-byte counters vs the codec's arithmetic, on the live mesh
+        mesh = ps.get_mesh()
+        group = (dict(mesh.shape).get("dp", 1)
+                 * dict(mesh.shape).get("cp", 1))
+        cfg8 = cc.CompressionConfig(dtype="int8", block_size=256)
+        predicted = 4.0 / CompressionConfig(dtype="int8",
+                                            block_size=256
+                                            ).wire_bytes_per_element
+        elems = 1 << 16
+        if group > 1:
+            x = jnp.asarray(np.random.RandomState(0)
+                            .randn(elems).astype(np.float32))
+
+            def inner(v):
+                return cc.all_reduce(v, ("dp", "cp"), config=cfg8,
+                                     op="mean")
+
+            fn = jax.jit(ps.shard_map(inner, mesh, in_specs=(P(),),
+                                      out_specs=P()))
+            jax.block_until_ready(fn(x))
+        else:
+            # 1-device mesh: the collective is a no-op, so exercise the
+            # accounting with the codec's own byte arithmetic (2 wire
+            # passes, as compressed all_reduce = RS + AG)
+            obs.record_wire_bytes(
+                "grad_all_reduce", "int8",
+                2 * blockwise_wire_bytes(elems, cfg8), 2 * 4.0 * elems)
+        ratio = obs.wire_compression_ratio()
+    finally:
+        if was_enabled:
+            obs.enable()
+        else:
+            obs.disable()
+
+    print(f"bench: obs drill overhead={overhead_pct:+.2f}% "
+          f"(on={t_on * 1e3:.1f}ms off={t_off * 1e3:.1f}ms) "
+          f"compile_events={events:.0f} compile_once={compile_once} "
+          f"wire_ratio={ratio:.3f} (predicted {predicted:.3f})",
+          file=sys.stderr)
+    tag = f"{platform}{n_dev}"
+    return {
+        f"obs_overhead_pct_{tag}": {
+            "value": round(overhead_pct, 3), "unit": "pct",
+            "vs_baseline": 1.0},
+        f"obs_compile_events_{tag}": {
+            "value": int(events), "unit": "compiles",
+            "vs_baseline": 1.0 if compile_once else 0.0},
+        f"obs_wire_bytes_int8_ratio_{tag}": {
+            "value": round(ratio, 4), "unit": "x_fewer_bytes",
+            "vs_baseline": round(ratio / predicted, 4)},
+    }
+
+
 def plan_metric(platform: str, n_dev: int) -> dict:
     """Placement-planner drill (docs/planner.md): run the analytic search
     at this host's device count over the bench model shape and compare the
@@ -1211,7 +1378,14 @@ if __name__ == "__main__":
              "this device count vs the hand-picked bench layout; reports "
              "plan_best_cost / plan_handpicked_cost / "
              "plan_advantage_ratio / plan_search_ms; docs/planner.md)")
+    _p.add_argument(
+        "--obs", action="store_true",
+        help="also run the observability drill (obs on-vs-off overhead on "
+             "the serving path, compile events from the tracker, wire-byte "
+             "counters vs the codec's predicted int8 ratio; "
+             "docs/observability.md)")
     _args = _p.parse_args()
     main(chaos_spec=_args.chaos, serving=_args.serving,
          overlap=_args.overlap, router=_args.router,
-         prefix_heavy=_args.prefix_heavy, plan_mode=_args.plan)
+         prefix_heavy=_args.prefix_heavy, plan_mode=_args.plan,
+         obs_mode=_args.obs)

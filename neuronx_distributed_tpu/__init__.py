@@ -17,6 +17,7 @@ from .config import (
     neuronx_distributed_config,
     configure_model,
 )
+from . import obs
 from . import parallel
 from . import inference
 from . import lora
@@ -38,6 +39,7 @@ __all__ = [
     "CheckpointConfig",
     "neuronx_distributed_config",
     "configure_model",
+    "obs",
     "parallel",
     "inference",
     "lora",

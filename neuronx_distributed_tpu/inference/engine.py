@@ -36,6 +36,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.llama import LlamaConfig, llama_forward_with_cache
+from ..obs.accounting import CompileTracker
+from ..obs.metrics import get_registry
+from ..obs.tracing import get_tracer
 from .kv_cache import PAD_POSITION
 from .paging import (BlockAllocator, CacheExhaustedError, PrefixCache,
                      cow_copy_blocks, init_paged_kv_cache,
@@ -255,9 +258,21 @@ class ServingEngine:
             self._step_fn = None
             self._prefill_fn = self._build_step()
             self._decode_fn = self._build_step()
+            workers = {"prefill": self._prefill_fn,
+                       "decode": self._decode_fn}
         else:
             self._step_fn = self._build_step()
             self._prefill_fn = self._decode_fn = None
+            workers = {"packed": self._step_fn}
+        # observability: per-worker compile trackers (any compile beyond
+        # the first alerts through the event channel — the no-recompile
+        # invariant made observable) + phase spans in step(). All of it
+        # is host-side and polls the jit cache from outside, so the
+        # compile-once behaviour itself is untouched.
+        self._compile_trackers = {
+            name: CompileTracker.for_function(f"engine/{name}", fn)
+            for name, fn in workers.items()}
+        self._obs_cache = None  # (registry, generation, handles...)
 
     # -- construction -----------------------------------------------------
 
@@ -678,15 +693,18 @@ class ServingEngine:
         worker; disaggregated mode runs the prefill worker then the
         decode worker — the KV handoff between them is the shared block
         pool itself (table-row surgery, no tensor copies)."""
-        self._admit()
-        decode_rows, prefill_rows = self._build_schedule()
+        tracer = get_tracer()
+        with tracer.span("engine/admission"):
+            self._admit()
+            decode_rows, prefill_rows = self._build_schedule()
         rows = decode_rows + prefill_rows
         if not rows:
             return 0
         t_start = self._now()
         if self.stats.first_step_t is None:
             self.stats.first_step_t = t_start
-        self._apply_pending_cow()
+        with tracer.span("engine/cow"):
+            self._apply_pending_cow()
         if self._freed_dirty:
             mask = np.zeros((self.ecfg.num_blocks,), np.bool_)
             mask[list(self._freed_dirty)] = True
@@ -708,37 +726,41 @@ class ServingEngine:
         if self.ecfg.disaggregated:
             sampled = np.zeros((len(rows),), np.int32)
             if prefill_rows:          # prefill first: TTFT, and new KV
-                sampled[len(decode_rows):] = self._run_worker(
-                    self._prefill_fn, prefill_rows,
-                    self.ecfg.prefill_budget or self.ecfg.token_budget,
-                    sub)[:len(prefill_rows)]
+                with tracer.span("engine/prefill"):
+                    sampled[len(decode_rows):] = self._run_worker(
+                        self._prefill_fn, prefill_rows,
+                        self.ecfg.prefill_budget or self.ecfg.token_budget,
+                        sub)[:len(prefill_rows)]
             if decode_rows:           # ... lands before decode reads
-                sampled[:len(decode_rows)] = self._run_worker(
-                    self._decode_fn, decode_rows, self.ecfg.max_slots,
-                    sub)[:len(decode_rows)]
+                with tracer.span("engine/decode"):
+                    sampled[:len(decode_rows)] = self._run_worker(
+                        self._decode_fn, decode_rows, self.ecfg.max_slots,
+                        sub)[:len(decode_rows)]
         else:
-            sampled = self._run_worker(
-                self._step_fn, rows, self.ecfg.token_budget, sub)
+            with tracer.span("engine/packed"):
+                sampled = self._run_worker(
+                    self._step_fn, rows, self.ecfg.token_budget, sub)
         if self.prefix_cache is not None and prefill_rows:
             for req in {id(r[0]): r[0] for r in prefill_rows}.values():
                 self._maybe_insert_prefix(req)
 
         now = self._now()
-        for i, (req, _, pos, produce) in enumerate(rows):
-            if req.decoding and pos == req.n_cached:
-                req.n_cached += 1  # this decode row cached its token
-            if not produce:
-                continue
-            tok = int(sampled[i])
-            req.generated.append(tok)
-            self.stats.tokens_generated += 1
-            if req.first_token_time is None:
-                req.first_token_time = now
-                self.stats.ttft_s.append(now - req.arrival_time)
-            if (len(req.generated) >= req.max_new_tokens
-                    or (self.ecfg.eos_id is not None
-                        and tok == self.ecfg.eos_id)):
-                self._retire(req, now)
+        with tracer.span("engine/retirement"):
+            for i, (req, _, pos, produce) in enumerate(rows):
+                if req.decoding and pos == req.n_cached:
+                    req.n_cached += 1  # this decode row cached its token
+                if not produce:
+                    continue
+                tok = int(sampled[i])
+                req.generated.append(tok)
+                self.stats.tokens_generated += 1
+                if req.first_token_time is None:
+                    req.first_token_time = now
+                    self.stats.ttft_s.append(now - req.arrival_time)
+                if (len(req.generated) >= req.max_new_tokens
+                        or (self.ecfg.eos_id is not None
+                            and tok == self.ecfg.eos_id)):
+                    self._retire(req, now)
         self.stats.steps += 1
         self.stats.step_latency_s.append(now - t_start)
         self.stats.last_step_t = now
@@ -748,7 +770,54 @@ class ServingEngine:
             self.allocator.num_shared
             / max(1, self.allocator.num_allocated))
         self.stats.queue_depth = self.queue_depth()
+        self._publish_obs(now - t_start)
         return len(rows)
+
+    #: EngineStats scalar fields bridged into ``nxd_engine_stats`` each
+    #: step. Derived percentiles (ttft_p50 etc.) stay in
+    #: ``stats.report()`` — recomputing them per step would dominate the
+    #: publish cost; latency quantiles come from the
+    #: ``nxd_engine_step_seconds`` histogram instead.
+    _OBS_SCALAR_FIELDS = (
+        "steps", "completed", "rejected", "preempted", "resubmitted",
+        "queue_depth", "tokens_generated", "cow_copies",
+        "prefix_hit_tokens", "prefill_tokens")
+
+    def _publish_obs(self, step_latency_s: float) -> None:
+        """Bridge :class:`EngineStats` into registry gauges and poll the
+        per-worker compile trackers. One bool check when obs is disabled;
+        the no-host-callback invariant holds — everything here runs after
+        the compiled workers returned. Child handles are cached against
+        the registry's reset generation so the steady state is one
+        attribute read + set per field."""
+        reg = get_registry()
+        if not reg.enabled:
+            return
+        for tracker in self._compile_trackers.values():
+            tracker.poll()
+        cache = self._obs_cache
+        if (cache is None or cache[0] is not reg
+                or cache[1] != reg.generation):
+            stats_g = reg.gauge(
+                "nxd_engine_stats",
+                "EngineStats scalar counters bridged per step "
+                "(monotonic fields included — they mirror the engine's "
+                "own counters).",
+                labels=("field",))
+            cache = self._obs_cache = (
+                reg, reg.generation,
+                {f: stats_g.labels(field=f)
+                 for f in self._OBS_SCALAR_FIELDS},
+                reg.gauge("nxd_engine_pool_free_blocks",
+                          "Unallocated KV blocks."),
+                reg.histogram("nxd_engine_step_seconds",
+                              "Serving step wall time."))
+        _, _, fields, free_g, step_h = cache
+        st = self.stats
+        for f, child in fields.items():
+            child.set(float(getattr(st, f)))
+        free_g.set(self.pool_free_blocks())
+        step_h.observe(step_latency_s)
 
     def _retire(self, req: _RequestState, now: float) -> None:
         self._release(req)

@@ -58,6 +58,8 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.metrics import get_registry
+from ..obs.tracing import get_tracer
 from ..resilience.chaos import FaultPlan
 from ..resilience.preemption import EXIT_PREEMPTED, PreemptionGuard
 from ..resilience.watchdog import SpikeDetector, StallTimer
@@ -497,6 +499,12 @@ class ReplicaRouter:
             if req.session:
                 self._sessions[req.session] = rep.name
             placed += 1
+            reg = get_registry()
+            if reg.enabled:
+                reg.counter("nxd_router_placed_total",
+                            "Requests placed onto a replica.",
+                            labels=("replica",)).labels(
+                                replica=rep.name).inc()
         return placed
 
     # -- health + failover -------------------------------------------------
@@ -529,6 +537,12 @@ class ReplicaRouter:
         """Trip the circuit breaker: evict/salvage in-flight requests to
         pending, mark the replica down for a probation window."""
         self.stats.failovers += 1
+        reg = get_registry()
+        if reg.enabled:
+            reg.counter("nxd_router_failovers_total",
+                        "Circuit-breaker trips by replica and cause.",
+                        labels=("replica", "reason")).labels(
+                            replica=rep.name, reason=why).inc()
         for uid, req in list(rep.assigned.items()):
             lost = 0
             if engine_alive and rep.engine is not None:
@@ -564,6 +578,12 @@ class ReplicaRouter:
             rep.state = "probation"
             rep.ok_steps = 0
             self.stats.revivals += 1
+            reg = get_registry()
+            if reg.enabled:
+                reg.counter("nxd_router_revivals_total",
+                            "Replicas revived into probation.",
+                            labels=("replica",)).labels(
+                                replica=rep.name).inc()
 
     # -- stats -------------------------------------------------------------
 
@@ -630,7 +650,8 @@ class ReplicaRouter:
         if self._guard is not None and self._guard.requested:
             self._draining = True
         self._tick_revivals()
-        activity = self._place_pending()
+        with get_tracer().span("router/place"):
+            activity = self._place_pending()
         for rep in self.replicas:
             if not rep.live or not rep.assigned:
                 continue
@@ -662,7 +683,33 @@ class ReplicaRouter:
                 if rep.ok_steps >= self.cfg.probation_ok_steps:
                     rep.state = "up"
         self.stats.steps += 1
+        self._publish_obs()
         return activity
+
+    _BREAKER_STATES = {"up": 0.0, "probation": 1.0, "down": 2.0}
+
+    def _publish_obs(self) -> None:
+        """Bridge breaker state and :class:`RouterStats` into gauges.
+        One bool check when obs is disabled."""
+        reg = get_registry()
+        if not reg.enabled:
+            return
+        breaker = reg.gauge(
+            "nxd_router_replica_state",
+            "Circuit-breaker state per replica (0=up, 1=probation, "
+            "2=down).", labels=("replica",))
+        for rep in self.replicas:
+            breaker.labels(replica=rep.name).set(
+                self._BREAKER_STATES.get(rep.state, 2.0))
+        gauges = reg.gauge(
+            "nxd_router_stats",
+            "RouterStats.to_dict() scalar fields bridged per step.",
+            labels=("field",))
+        for k, v in self.stats.to_dict().items():
+            if isinstance(v, (int, float)):
+                gauges.labels(field=k).set(float(v))
+        reg.gauge("nxd_router_pending",
+                  "Requests waiting for placement.").set(len(self._pending))
 
     def run(self) -> Dict[str, RouterResult]:
         """Drive :meth:`step` until every admitted request resolves.

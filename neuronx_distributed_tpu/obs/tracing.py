@@ -1,0 +1,260 @@
+"""Host-side span tracer: nested spans, chrome-trace export, latency stats.
+
+Subsumes the old ``utils/timeline.Timeline`` (which stays as a thin shim).
+Spans are host-side only — the tracer must never be entered from inside a
+jitted/shard_mapped function (the nxdlint ``observability`` rule enforces
+this): a span around ``step_fn(...)`` measures dispatch+execution, a span
+*inside* would measure trace time once and then lie forever.
+
+Three surfaces:
+
+* ``span(name, **attrs)`` — context manager, nests via a per-thread stack;
+* ``mark_event_start/end(name)`` — name-keyed flat events (the Timeline
+  compatibility surface, also handy across callback boundaries);
+* ``profile_step(logdir)`` — wraps ``jax.profiler`` start/stop_trace and
+  records a host span carrying the logdir attribute, so the device trace
+  is findable from the host timeline.
+
+``chrome_trace()`` / ``save()`` snapshot everything **under the lock** and
+emit still-open spans as zero-duration ``"incomplete"`` events instead of
+silently dropping them (the old Timeline.save raced writers and lost open
+spans).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .metrics import QUANTILES
+
+
+class _NullSpan:
+    """Returned when tracing is disabled: one shared, reentrant no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    __slots__ = ("tracer", "name", "attrs", "t0_us", "parent")
+
+    def __init__(self, tracer: "SpanTracer", name: str,
+                 attrs: Dict[str, Any]):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.t0_us = 0.0
+        self.parent: Optional[str] = None
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def __enter__(self) -> "Span":
+        stack = self.tracer._stack()
+        self.parent = stack[-1].name if stack else None
+        stack.append(self)
+        self.t0_us = time.perf_counter_ns() / 1000.0
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        end_us = time.perf_counter_ns() / 1000.0
+        stack = self.tracer._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        self.tracer._record(self, end_us)
+        return False
+
+
+class SpanTracer:
+    """Thread-safe recorder for nested host spans.
+
+    ``max_events`` bounds memory: beyond it the event list becomes a ring
+    buffer of the most recent spans (per-name stats keep counting — they
+    aggregate at record time, not from the buffer).
+    """
+
+    def __init__(self, enabled: bool = True, max_events: int = 200_000):
+        self.enabled = enabled
+        self.max_events = max_events
+        self._events: List[Dict[str, Any]] = []
+        self._next = 0
+        self._open_named: Dict[str, float] = {}
+        self._stats: Dict[str, List[float]] = {}
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    # -- plumbing ---------------------------------------------------
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _append_event(self, ev: Dict[str, Any]) -> None:
+        # caller holds self._lock
+        if len(self._events) < self.max_events:
+            self._events.append(ev)
+        else:
+            self._events[self._next] = ev
+            self._next = (self._next + 1) % self.max_events
+
+    def _record(self, span: Span, end_us: float) -> None:
+        dur = end_us - span.t0_us
+        ev = {
+            "name": span.name, "ph": "X", "ts": span.t0_us, "dur": dur,
+            "pid": os.getpid(), "tid": threading.get_ident() % 10000,
+        }
+        args = dict(span.attrs)
+        if span.parent is not None:
+            args["parent"] = span.parent
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._append_event(ev)
+            self._stats.setdefault(span.name, []).append(dur)
+
+    # -- span surface -----------------------------------------------
+    def span(self, name: str, **attrs: Any):
+        if not self.enabled:
+            return _NULL_SPAN
+        return Span(self, name, attrs)
+
+    # -- Timeline-compatible name-keyed surface ----------------------
+    def mark_event_start(self, name: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._open_named[name] = time.perf_counter_ns() / 1000.0
+
+    def mark_event_end(self, name: str) -> None:
+        if not self.enabled:
+            return
+        now = time.perf_counter_ns() / 1000.0
+        with self._lock:
+            start = self._open_named.pop(name, None)
+            if start is None:
+                return
+            dur = now - start
+            self._append_event({
+                "name": name, "ph": "X", "ts": start, "dur": dur,
+                "pid": os.getpid(), "tid": threading.get_ident() % 10000,
+            })
+            self._stats.setdefault(name, []).append(dur)
+
+    @contextlib.contextmanager
+    def event(self, name: str):
+        self.mark_event_start(name)
+        try:
+            yield
+        finally:
+            self.mark_event_end(name)
+
+    # -- jax.profiler glue ------------------------------------------
+    @contextlib.contextmanager
+    def profile_step(self, logdir: str = "/tmp/nxd_profile"):
+        """Attach an XLA device trace (viewable in Perfetto/TensorBoard)
+        to a host span, so device and host timelines cross-reference."""
+        import jax
+
+        jax.profiler.start_trace(logdir)
+        span = self.span("profile_step", logdir=logdir)
+        try:
+            with span:
+                yield logdir
+        finally:
+            jax.profiler.stop_trace()
+
+    # -- export ------------------------------------------------------
+    def chrome_trace(self) -> Dict[str, Any]:
+        """Snapshot as a chrome-trace dict.
+
+        Taken entirely under the lock so concurrent writers can't tear
+        the event list; spans still open at snapshot time (both the
+        name-keyed kind and ``span()`` stacks) appear as zero-duration
+        events tagged ``{"incomplete": true}`` rather than vanishing.
+        """
+        now = time.perf_counter_ns() / 1000.0
+        with self._lock:
+            if len(self._events) < self.max_events:
+                events = list(self._events)
+            else:  # unroll the ring into chronological order
+                events = (self._events[self._next:]
+                          + self._events[:self._next])
+            open_named = dict(self._open_named)
+        events = [dict(ev) for ev in events]
+        for name, start in sorted(open_named.items()):
+            events.append({
+                "name": name, "ph": "X", "ts": start, "dur": 0.0,
+                "pid": os.getpid(), "tid": threading.get_ident() % 10000,
+                "args": {"incomplete": True, "open_for_us": now - start},
+            })
+        return {"traceEvents": events}
+
+    def save(self, path: str) -> str:
+        trace = self.chrome_trace()
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return path
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-span-name latency stats (durations in microseconds)."""
+        with self._lock:
+            snap = {name: list(durs) for name, durs in self._stats.items()}
+        out: Dict[str, Dict[str, float]] = {}
+        for name, durs in sorted(snap.items()):
+            durs.sort()
+            n = len(durs)
+            entry = {
+                "count": float(n),
+                "total_us": sum(durs),
+                "mean_us": sum(durs) / n,
+                "min_us": durs[0],
+                "max_us": durs[-1],
+            }
+            for q in QUANTILES:
+                idx = max(0, min(n - 1, int(math.ceil(q * n)) - 1))
+                entry["p%g_us" % (q * 100)] = durs[idx]
+            out[name] = entry
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._next = 0
+            self._open_named.clear()
+            self._stats.clear()
+
+
+#: process-wide default tracer; enabled/disabled in lockstep with the
+#: default metrics registry by ``obs.enable()`` / ``obs.disable()``.
+_DEFAULT: Optional[SpanTracer] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_tracer() -> SpanTracer:
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = SpanTracer(
+                    enabled=os.environ.get("NXD_OBS", "0") == "1")
+    return _DEFAULT

@@ -97,10 +97,33 @@ from ..parallel import comm
 from ..parallel import comm_compressed
 from ..parallel import mesh as ps
 from ..parallel.wire_codec import (CompressionConfig, decode_payload,
-                                   encode_payload)
+                                   encode_payload, payload_wire_bytes)
 
 Array = jax.Array
 Kernels = Union[Array, Sequence[Array]]
+
+
+def _record_act_wire(kind: str, shape: Tuple[int, ...],
+                     wire: Optional[CompressionConfig],
+                     passes: float) -> None:
+    """Traced-bytes accounting for one activation collective: ``shape``
+    is the per-hop payload, ``passes`` the number of ring hops (or
+    monolithic-equivalent passes). Runs in the public wrapper at trace
+    time — never inside the compiled program (the custom_vjp internals
+    are traced code; a tap there would be flagged by nxdlint and would
+    double-count the per-chunk codec calls)."""
+    from ..obs.accounting import record_wire_bytes
+    from ..obs.metrics import get_registry
+
+    if not get_registry().enabled:
+        return
+    m = 1
+    for d in shape:
+        m *= int(d)
+    wire_b = payload_wire_bytes(shape, wire) * passes
+    raw_b = 4.0 * m * passes
+    record_wire_bytes(kind, wire.dtype if wire is not None else "fp32",
+                      wire_b, raw_b)
 
 #: auto mode (``overlap_comm=None``) engages only at axis sizes where the
 #: ring has enough steps to pipeline; below this the monolithic collective
@@ -651,6 +674,16 @@ def _unwrap(outs: Tuple[Array, ...], kernels: Kernels):
     return outs[0]
 
 
+def _scatter_block_shape(x: Array, kernel: Array, dim: int,
+                         n: int) -> Tuple[int, ...]:
+    """Per-hop payload shape of a matmul-RS/AR: the output block destined
+    for one rank — ``x @ kernel``'s shape with ``dim`` cut by ``n``."""
+    y_shape = tuple(x.shape[:-1]) + tuple(kernel.shape[1:])
+    d = dim % len(y_shape)
+    return tuple(max(1, s // n) if i == d else s
+                 for i, s in enumerate(y_shape))
+
+
 def all_gather_matmul(x: Array, kernels: Kernels, axis=ps.TP_AXIS,
                       gather_dim: int = 1, *, impl: str = "auto",
                       bidirectional: Optional[bool] = None,
@@ -689,6 +722,8 @@ def all_gather_matmul(x: Array, kernels: Kernels, axis=ps.TP_AXIS,
             dq = decode_payload(q, s, wire, jnp.float32)
             new_error = lax.stop_gradient(
                 x.astype(jnp.float32) - dq).astype(error.dtype)
+    # ring: each rank's shard takes n-1 hops (monolithic AG moves the same)
+    _record_act_wire("act_all_gather_matmul", tuple(x.shape), wire, n - 1)
     out = _unwrap(_ag_matmul(x, ws, axis, gather_dim, decomposed, bidi,
                              wire), kernels)
     return (out, new_error) if error is not None else out
@@ -713,6 +748,9 @@ def matmul_reduce_scatter(x: Array, kernel: Array, axis=ps.TP_AXIS,
     n = comm._axis_size(axis)
     if n is None or n <= 1:
         return _contract(x, kernel)
+    _record_act_wire("act_matmul_reduce_scatter",
+                     _scatter_block_shape(x, kernel, scatter_dim, n),
+                     wire, n - 1)
     return _mm_rs(x, kernel, axis, scatter_dim, decomposed, bidi, wire)
 
 
@@ -733,6 +771,10 @@ def matmul_all_reduce(x: Array, kernel: Array, axis=ps.TP_AXIS,
     n = comm._axis_size(axis)
     if n is None or n <= 1:
         return _contract(x, kernel)
+    # RS leg + AG leg, each n-1 hops over the same per-destination block
+    _record_act_wire("act_matmul_all_reduce",
+                     _scatter_block_shape(x, kernel, pipeline_dim, n),
+                     wire, 2 * (n - 1))
     return _mm_ar(x, kernel, axis, pipeline_dim, decomposed, bidi, wire)
 
 

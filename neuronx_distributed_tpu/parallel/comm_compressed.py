@@ -103,6 +103,36 @@ def _axis_arg(names: Tuple[str, ...]) -> Axis:
     return names if len(names) > 1 else names[0]
 
 
+def _numel(x: jax.Array) -> int:
+    m = 1
+    for d in jnp.shape(x):
+        m *= int(d)
+    return m
+
+
+def _record_wire(kind: str, n_elements: int,
+                 cfg: Optional[CompressionConfig], passes: float) -> None:
+    """Traced-bytes accounting for one logical collective.
+
+    Runs in the *public wrapper* — host code executed at trace time, so
+    there is never a callback inside the compiled program and the jit
+    cache is untouched. Shapes are static here, so the byte figures are
+    exact per trace; see ``obs.accounting`` for the traced-bytes
+    semantics (counted once per compile, ratio invariant to run count).
+    """
+    from ..obs.accounting import record_wire_bytes
+    from ..obs.metrics import get_registry
+
+    if not get_registry().enabled:
+        return
+    from .wire_codec import blockwise_wire_bytes
+
+    wire = blockwise_wire_bytes(n_elements, cfg) * passes
+    raw = 4.0 * n_elements * passes
+    record_wire_bytes(kind, cfg.dtype if cfg is not None else "fp32",
+                      wire, raw)
+
+
 def _exchange_reduce(q: jax.Array, s: Optional[jax.Array], ax: Axis,
                      dtype: str) -> jax.Array:
     """Quantized reduce-scatter core: all-to-all the per-destination chunks
@@ -182,6 +212,10 @@ def all_reduce(x: jax.Array, axis: Axis = (ps.DP_AXIS, ps.CP_AXIS),
     n = comm._axis_size(axis)
     if not names or n is None or n == 1:
         return (x, error) if error is not None else x
+    # RS + AG composition: two compressed passes over the wire. The
+    # hierarchical path recurses through this public wrapper for its
+    # slow stage, so the shard-sized stage-2 traffic accounts itself.
+    _record_wire("grad_all_reduce", _numel(x), cfg, passes=2)
 
     if cfg.hierarchical:
         fast, slow = split_axis_hierarchy(names)
@@ -265,6 +299,7 @@ def reduce_scatter_flat(x: jax.Array, axis: Axis,
     if not names or n is None or n == 1:
         y = x.reshape(-1)
         return (y, error) if error is not None else y
+    _record_wire("grad_reduce_scatter", _numel(x), cfg, passes=1)
     ax = _axis_arg(names)
     q, s, m, new_error = _stage1_quantize(x, error, n, cfg)
     chunk = _exchange_reduce(q, s, ax, cfg.dtype)
@@ -287,6 +322,7 @@ def all_gather_flat(chunk: jax.Array, shape: Sequence[int], axis: Axis,
         m *= int(d)
     if not names or n is None or n == 1:
         return chunk.reshape(-1)[:m].reshape(tuple(shape))
+    _record_wire("grad_all_gather", m, cfg, passes=1)
     ax = _axis_arg(names)
     b = cfg.block_size
     flat = chunk.astype(jnp.float32).reshape(-1)
@@ -316,6 +352,7 @@ def reduce_scatter(x: jax.Array, axis: Axis, dim: int = 0,
     n = comm._axis_size(axis)
     if not names or n is None or n == 1:
         return (x, error) if error is not None else x
+    _record_wire("grad_reduce_scatter", _numel(x), cfg, passes=1)
     ax = _axis_arg(names)
     dim = dim % x.ndim
     if x.shape[dim] % n != 0:
@@ -367,6 +404,7 @@ def all_gather(x: jax.Array, axis: Axis, dim: int = 0,
     n = comm._axis_size(axis)
     if not names or n is None or n == 1:
         return x
+    _record_wire("grad_all_gather", n * _numel(x), cfg, passes=1)
     ax = _axis_arg(names)
     dim = dim % x.ndim
     if not cfg.quantized:

@@ -211,3 +211,44 @@ def decode_payload(q: jax.Array, scales: Optional[jax.Array],
         return _dequantize(blocked, scales, config.dtype) \
             .reshape(q.shape).astype(out_dtype)
     return _dequantize(q, scales, config.dtype).astype(out_dtype)
+
+
+# --------------------------------------------------------------------------
+# Exact byte accounting (observability)
+# --------------------------------------------------------------------------
+#
+# ``wire_bytes_per_element`` above is the *asymptotic* figure the planner
+# charges with. The two helpers below compute the byte count a concrete
+# payload actually ships — including quantization padding and the
+# whole-trailing-dim block fallback — so the runtime wire counters in
+# ``obs`` account what the codec really moves, not the idealised rate.
+# Pure int/float arithmetic: callable at trace time with static shapes.
+
+def blockwise_wire_bytes(n_elements: int,
+                         config: Optional[CompressionConfig]) -> float:
+    """Bytes shipped for an ``n_elements`` payload through
+    :func:`quantize_blockwise` (flat ``[nb, b]`` layout): padded int8/fp8
+    values + one fp32 scale per block; ``4 * n`` for fp32/None configs."""
+    n = int(n_elements)
+    if config is None or not config.quantized:
+        return 4.0 * n
+    b = config.block_size
+    nb = max(1, -(-n // b))
+    return float(nb * b) + 4.0 * nb
+
+
+def payload_wire_bytes(shape: Sequence[int],
+                       config: Optional[CompressionConfig]) -> float:
+    """Bytes shipped for a payload of ``shape`` through
+    :func:`encode_payload` (in-layout trailing-dim blocks; the whole
+    trailing dim becomes one block when ``block_size`` doesn't divide it)."""
+    dims = tuple(int(d) for d in shape)
+    n = 1
+    for d in dims:
+        n *= d
+    if config is None or not config.quantized:
+        return 4.0 * n
+    d = dims[-1] if dims else 1
+    b = config.block_size
+    n_scales = (n // b) if (d % b == 0 and d >= b) else (n // max(d, 1))
+    return float(n) + 4.0 * max(1, n_scales)

@@ -18,6 +18,9 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 import jax
 
 from ..config import NxDConfig
+from ..obs.accounting import CompileTracker
+from ..obs.metrics import get_registry
+from ..obs.tracing import get_tracer
 from ..utils.logger import get_logger, log_event
 from . import checkpoint as ckpt
 
@@ -89,15 +92,21 @@ class CheckpointCallback(Callback):
     def on_step_end(self, trainer, metrics):
         step = trainer.host_step
         if self.every and step > 0 and step % self.every == 0:
-            ckpt.save_checkpoint(self.path, step, trainer.state,
-                                 async_save=True, num_kept=self.num_kept)
+            with get_tracer().span("train/checkpoint", step=step,
+                                   mode="async"):
+                ckpt.save_checkpoint(self.path, step, trainer.state,
+                                     async_save=True,
+                                     num_kept=self.num_kept)
             self._last_saved = step
 
     def on_train_end(self, trainer):
         step = trainer.host_step
         if step > 0 and step != self._last_saved:
-            ckpt.save_checkpoint(self.path, step, trainer.state,
-                                 async_save=False, num_kept=self.num_kept)
+            with get_tracer().span("train/checkpoint", step=step,
+                                   mode="sync"):
+                ckpt.save_checkpoint(self.path, step, trainer.state,
+                                     async_save=False,
+                                     num_kept=self.num_kept)
             self._last_saved = step
         ckpt.finalize_checkpoint()
 
@@ -130,6 +139,11 @@ class Trainer:
         self._track_prev = any(
             getattr(cb, "needs_prev_state", False) for cb in self.callbacks)
         self._prev_state: Optional[Any] = None
+        # observability: compile tracking of the compiled step (alerts on
+        # recompiles through the shared event channel) + phase spans.
+        # When obs is disabled every hook below is a single bool check.
+        self._compile_tracker = CompileTracker.for_function(
+            "trainer/step", step_fn)
         # host-side mirror of state.step: callbacks read this instead of
         # int(state.step), which would force a device sync every iteration
         # and break async dispatch overlap
@@ -164,17 +178,37 @@ class Trainer:
             cb.on_train_start(self)
         metrics: Dict = {}
         evaluated_at = -1
-        for batch in batches:
+        tracer = get_tracer()
+        reg = get_registry()
+        batch_iter = iter(batches)
+        while True:
             if max_steps is not None and self.host_step >= max_steps:
                 break
+            # phase: data — host-side input pipeline latency
+            with tracer.span("train/data", step=self.host_step):
+                try:
+                    batch = next(batch_iter)
+                except StopIteration:
+                    break
             ids = batch.get("input_ids")
             self.tokens_per_batch = int(ids.size) if ids is not None else 0
             if self._track_prev:
                 self._prev_state = self.state
-            self.state, metrics = self.step_fn(self.state, batch)
+            # phase: step — dispatch of the compiled step (async under
+            # jit: wall time here is dispatch + any blocking compile)
+            t0 = time.perf_counter()
+            with tracer.span("train/step", step=self.host_step):
+                self.state, metrics = self.step_fn(self.state, batch)
+            self._compile_tracker.poll(wall_s=time.perf_counter() - t0)
             self.host_step += 1
-            for cb in self.callbacks:
-                cb.on_step_end(self, metrics)
+            if reg.enabled:
+                reg.counter("nxd_train_steps_total",
+                            "Train steps completed.").inc()
+            # phase: checkpoint et al. — callbacks (CheckpointCallback
+            # opens its own train/checkpoint span inside)
+            with tracer.span("train/callbacks", step=self.host_step):
+                for cb in self.callbacks:
+                    cb.on_step_end(self, metrics)
             if (self.preemption_guard is not None
                     and self.preemption_guard.requested):
                 # step boundary: the request recorded by the signal handler

@@ -9,10 +9,8 @@ one process per host (not per chip); "rank 0" gating maps to
 
 from __future__ import annotations
 
-import json
 import logging
 import os
-from typing import Optional
 
 _LOGGERS = {}
 
@@ -46,9 +44,22 @@ class _Rank0Filter(logging.Filter):
 _RANK0_FILTER = _Rank0Filter()
 
 
+_WARNED_BAD_LEVELS = set()
+
+
 def get_log_level() -> int:
-    level = os.environ.get("NXD_LOG_LEVEL", "INFO").upper()
-    return getattr(logging, level, logging.INFO)
+    raw = os.environ.get("NXD_LOG_LEVEL", "INFO")
+    level = getattr(logging, raw.upper(), None)
+    if isinstance(level, int) and not isinstance(level, bool):
+        return level
+    # Bad value: fall back to INFO, but say so (once per offending value)
+    # instead of silently swallowing the typo forever.
+    if raw not in _WARNED_BAD_LEVELS:
+        _WARNED_BAD_LEVELS.add(raw)
+        logging.getLogger("neuronx_distributed_tpu").warning(
+            "NXD_LOG_LEVEL=%r is not a valid logging level; "
+            "falling back to INFO", raw)
+    return logging.INFO
 
 
 def get_logger(name: str = "neuronx_distributed_tpu",
@@ -57,7 +68,15 @@ def get_logger(name: str = "neuronx_distributed_tpu",
     loggers drop everything below WARNING."""
     key = (name, rank0_only)
     if key in _LOGGERS:
-        return _LOGGERS[key]
+        logger = _LOGGERS[key]
+        # Re-resolve the level on every call: NXD_LOG_LEVEL may have
+        # changed since the logger was first built (tests, notebooks,
+        # long-lived drivers) and caching the first value forever made
+        # the env knob a one-shot.
+        level = get_log_level()
+        if logger.level != level:
+            logger.setLevel(level)
+        return logger
     logger = logging.getLogger(name)
     logger.setLevel(get_log_level())
     if not logger.handlers:
@@ -80,10 +99,15 @@ def log_event(logger: logging.Logger, event: str, **fields) -> None:
     grep/parse them without scraping free-form log text. WARNING level:
     rank0_only loggers on non-zero processes drop below WARNING, and a
     resilience event from *any* rank must stay visible.
+
+    Routed through the ``obs`` event channel: the same call also bumps
+    ``nxd_events_total{event=...}`` and fans out to subscribers, so the
+    NXD_EVENT log lines and the metrics registry share one source of
+    truth. The log-line format is unchanged.
     """
-    payload = {"event": event, **fields}
-    logger.warning("NXD_EVENT %s",
-                   json.dumps(payload, sort_keys=True, default=str))
+    from ..obs.events import emit_event  # lazy: obs imports this module
+
+    emit_event(event, logger=logger, **fields)
 
 
 def rmsg(msg: str) -> str:

@@ -1,75 +1,72 @@
-"""Execution timelines and profiling glue.
+"""Execution timelines and profiling glue — thin shims over ``obs``.
 
 Analogue of the reference's chrome-trace ``Timeline`` (``utils/timeline.py:
-15-141``: mark_event_start/end, per-step JSON chrome events) and
-``PPTimeline`` (``pipeline/timeline.py:10``). On TPU the heavy lifting is
-``jax.profiler`` (XLA traces viewable in Perfetto/TensorBoard); this module
-keeps the reference's lightweight host-side event timeline for schedule
-debugging, and wraps the jax profiler for one-call step captures.
+15-141``) and ``PPTimeline`` (``pipeline/timeline.py:10``). The actual
+recorder now lives in ``neuronx_distributed_tpu.obs.tracing.SpanTracer``;
+this module keeps the historical names so existing callers and scripts
+keep working. New code should use ``obs.get_tracer()`` directly — it adds
+nested spans with attributes, per-span-name latency stats, and shares the
+process-wide enable switch with the metrics registry.
 """
 
 from __future__ import annotations
 
 import contextlib
-import json
-import os
-import threading
-import time
-from typing import Any, Dict, List, Optional
+from typing import Optional
+
+from ..obs.tracing import SpanTracer
 
 
 class Timeline:
-    """Host-side chrome-trace event recorder (reference ``Timeline``)."""
+    """Host-side chrome-trace event recorder (reference ``Timeline``).
+
+    Shim over a private :class:`SpanTracer`. Keeping a tracer per
+    Timeline preserves the old semantics: separate Timelines do not see
+    each other's events and carry their own ``enabled`` flag independent
+    of the global ``obs`` switch.
+
+    ``save`` snapshots under the tracer lock and emits still-open spans
+    as zero-duration ``{"incomplete": true}`` events — the previous
+    implementation read the event list without the lock (racing writer
+    threads) and silently dropped open spans.
+    """
 
     def __init__(self, output_file: str = "timeline.json",
                  enabled: bool = True):
         self.output_file = output_file
-        self.enabled = enabled
-        self._events: List[Dict[str, Any]] = []
-        self._open: Dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._tracer = SpanTracer(enabled=enabled)
+
+    @property
+    def enabled(self) -> bool:
+        return self._tracer.enabled
+
+    @enabled.setter
+    def enabled(self, value: bool) -> None:
+        self._tracer.enabled = value
 
     def mark_event_start(self, name: str) -> None:
-        if self.enabled:
-            with self._lock:
-                self._open[name] = time.perf_counter_ns() / 1000.0
+        self._tracer.mark_event_start(name)
 
     def mark_event_end(self, name: str) -> None:
-        if not self.enabled:
-            return
-        with self._lock:
-            start = self._open.pop(name, None)
-            if start is None:
-                return
-            now = time.perf_counter_ns() / 1000.0
-            self._events.append({
-                "name": name, "ph": "X", "ts": start, "dur": now - start,
-                "pid": os.getpid(), "tid": threading.get_ident() % 10000,
-            })
+        self._tracer.mark_event_end(name)
 
-    @contextlib.contextmanager
     def event(self, name: str):
-        self.mark_event_start(name)
-        try:
-            yield
-        finally:
-            self.mark_event_end(name)
+        return self._tracer.event(name)
 
     def save(self, path: Optional[str] = None) -> str:
-        path = path or self.output_file
-        with open(path, "w") as f:
-            json.dump({"traceEvents": self._events}, f)
-        return path
+        return self._tracer.save(path or self.output_file)
 
 
 @contextlib.contextmanager
 def profile_step(logdir: str = "/tmp/nxd_profile"):
     """Capture an XLA device trace for the enclosed step(s); view with
-    Perfetto / TensorBoard (SURVEY §5: 'jax.profiler traces + Perfetto')."""
-    import jax
+    Perfetto / TensorBoard (SURVEY §5: 'jax.profiler traces + Perfetto').
 
-    jax.profiler.start_trace(logdir)
-    try:
-        yield logdir
-    finally:
-        jax.profiler.stop_trace()
+    Shim over ``obs.get_tracer().profile_step`` — the device trace is
+    additionally recorded as a host span (with the logdir attribute) when
+    tracing is enabled.
+    """
+    from ..obs.tracing import get_tracer
+
+    with get_tracer().profile_step(logdir) as d:
+        yield d

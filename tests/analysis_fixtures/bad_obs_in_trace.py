@@ -1,0 +1,35 @@
+"""Fixture: observability violations (never imported, only parsed)."""
+
+import time
+from time import perf_counter
+
+import jax
+
+
+@jax.jit
+def traced_with_clock(x):
+    t0 = time.time()  # trace-time constant, not a timestamp
+    y = x * 2
+    elapsed = perf_counter() - t0  # `from time import` bare form
+    return y, elapsed
+
+
+def outer(metrics, xs):
+    def body(carry, x):
+        metrics.inc()  # metric record inside a scan body
+        metrics.latency.observe(1.0)
+        return carry + x, x
+
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def host_side_is_fine(tracer, step_fn, x):
+    # NOT traced: spans/timers around the compiled call are the point
+    t0 = time.perf_counter()
+    with tracer.span("step"):
+        y = step_fn(x)
+    return y, time.perf_counter() - t0
+
+
+# bare print in a library module — bypasses logger + event channel
+print("fixture loaded")

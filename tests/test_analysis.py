@@ -271,6 +271,46 @@ def test_paging_refcount_exempts_paging_module():
     assert len(flagged) == 2
 
 
+def test_observability_fires_on_fixture():
+    fs = _lint("bad_obs_in_trace.py")
+    assert _rules(fs) == {"observability"}
+    msgs = " | ".join(f.message for f in fs if not f.suppressed)
+    # both clock forms (time.time and bare perf_counter), both metric
+    # tails, and the module-level bare print fire; the host-side helper
+    # (lines 26-31) stays quiet
+    assert "trace-time constant" in msgs
+    assert ".inc()" in msgs and ".observe()" in msgs
+    assert "bare print()" in msgs
+    assert len([f for f in fs if not f.suppressed]) == 5
+    assert not any(26 <= f.line <= 31 for f in fs if not f.suppressed)
+
+
+def test_observability_print_exemptions():
+    src = "print('hello')\n"
+    # library module: flagged
+    assert {f.rule for f in analyze_source(
+        src, "mypkg/trainer/loop.py", axes=DEFAULT_AXES)} == \
+        {"observability"}
+    # obs/, scripts/, __main__.py, test files: exempt
+    for path in ("mypkg/obs/metrics.py", "mypkg/scripts/launch.py",
+                 "mypkg/plan/__main__.py", "tests/test_something.py",
+                 "tests/conftest.py"):
+        assert analyze_source(src, path, axes=DEFAULT_AXES) == []
+    # explicit stream target is deliberate output, not a bypass
+    assert analyze_source("import sys\nprint('x', file=sys.stderr)\n",
+                          "mypkg/trainer/loop.py", axes=DEFAULT_AXES) == []
+
+
+def test_observability_set_not_flagged_in_traced_code():
+    # x.at[i].set(...) is core JAX — `.set` must not be a metric tail
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return x.at[0].set(1.0)\n")
+    assert analyze_source(src, "mypkg/ops/update.py",
+                          axes=DEFAULT_AXES) == []
+
+
 def test_inference_package_self_gate():
     # the serving engine must pass the rule it motivated: every step
     # array is packed to the fixed token budget, never len(requests) —
@@ -365,7 +405,8 @@ def test_cli_nonzero_on_fixture_corpus():
     assert out_rules == {"mesh-axis", "trace-safety", "custom-vjp",
                          "recompile-hazard", "resilience",
                          "comm-compression", "tp-overlap",
-                         "serving-resilience", "paging-refcount", "plan"}
+                         "serving-resilience", "paging-refcount", "plan",
+                         "observability"}
 
 
 def test_cli_zero_on_clean_file():

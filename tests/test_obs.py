@@ -1,0 +1,666 @@
+"""Unified observability subsystem (``obs/``): registry semantics,
+Prometheus exposition, span tracer + Timeline shim (save-race regression),
+compile tracking, wire-byte accounting vs the codec's predictions, the
+event channel, and the logger satellites."""
+
+import contextlib
+import json
+import logging
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu import obs
+from neuronx_distributed_tpu.obs.metrics import MetricsRegistry
+from neuronx_distributed_tpu.obs.tracing import SpanTracer
+from neuronx_distributed_tpu.parallel import mesh as ps
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    """Isolate the process-wide registry/tracer and restore the enable
+    switch, so obs-enabled tests don't leak state into the rest of the
+    suite (which runs with obs disabled, the default)."""
+    was = obs.enabled()
+    obs.reset()
+    yield
+    obs.reset()
+    if was:
+        obs.enable()
+    else:
+        obs.disable()
+
+
+@contextlib.contextmanager
+def _capture(logger):
+    """Collect records emitted on ``logger`` directly — the package
+    loggers set ``propagate=False``, so caplog's root handler misses
+    them."""
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    logger.addHandler(handler)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(handler)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_counter_labels_and_get_or_create():
+    reg = MetricsRegistry()
+    c = reg.counter("nxd_reqs_total", "Requests.", labels=("kind",))
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(2)
+    c.labels(kind="b").inc(5)
+    assert c.labels(kind="a").value == 3.0
+    assert c.labels(kind="b").value == 5.0
+    # idempotent re-creation returns the same family
+    assert reg.counter("nxd_reqs_total", labels=("kind",)) is c
+    # counters only go up
+    with pytest.raises(ValueError):
+        c.labels(kind="a").inc(-1)
+    # wrong label set
+    with pytest.raises(ValueError):
+        c.labels(nope="x")
+    # unlabeled use of a labeled family
+    with pytest.raises(ValueError):
+        c.inc()
+
+
+def test_duplicate_name_different_kind_or_labels_rejected():
+    reg = MetricsRegistry()
+    reg.counter("nxd_thing_total", labels=("kind",))
+    with pytest.raises(ValueError):
+        reg.gauge("nxd_thing_total", labels=("kind",))
+    with pytest.raises(ValueError):
+        reg.counter("nxd_thing_total", labels=("other",))
+    with pytest.raises(ValueError):
+        reg.counter("bad name")
+
+
+def test_gauge_set_inc_dec():
+    reg = MetricsRegistry()
+    g = reg.gauge("nxd_depth")
+    g.set(4.0)
+    g.inc()
+    g.dec(2.0)
+    assert g.value == 3.0
+    c = reg.counter("nxd_c_total", labels=("k",))
+    with pytest.raises(TypeError):
+        c.labels(k="a").dec()
+
+
+def test_histogram_quantiles_and_bounds():
+    reg = MetricsRegistry()
+    h = reg.histogram("nxd_lat_seconds")
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.count == 100
+    assert h.sum == sum(range(1, 101))
+    assert h.quantile(0.5) == 50.0
+    assert h.quantile(0.9) == 90.0
+    assert h.quantile(0.99) == 99.0
+    assert h.quantile(0.0) == 1.0
+    assert h.quantile(1.0) == 100.0
+
+
+def test_disabled_registry_is_a_noop():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("nxd_c_total")
+    g = reg.gauge("nxd_g")
+    h = reg.histogram("nxd_h_seconds")
+    c.inc(100)
+    g.set(7.0)
+    h.observe(1.0)
+    assert c.value == 0.0 and g.value == 0.0 and h.count == 0
+    reg.enable()
+    c.inc(2)
+    assert c.value == 2.0
+
+
+def test_reset_bumps_generation_and_drops_metrics():
+    reg = MetricsRegistry()
+    reg.counter("nxd_c_total").inc()
+    gen = reg.generation
+    reg.reset()
+    assert reg.get("nxd_c_total") is None
+    assert reg.generation == gen + 1
+
+
+def test_prometheus_exposition_golden():
+    reg = MetricsRegistry()
+    reg.counter("nxd_reqs_total", "Requests.",
+                labels=("kind",)).labels(kind="a").inc(3)
+    reg.gauge("nxd_depth", "Queue depth.").set(2.5)
+    h = reg.histogram("nxd_lat_seconds", "Latency.")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    assert reg.to_prometheus() == """\
+# HELP nxd_depth Queue depth.
+# TYPE nxd_depth gauge
+nxd_depth 2.5
+# HELP nxd_lat_seconds Latency.
+# TYPE nxd_lat_seconds summary
+nxd_lat_seconds{quantile="0.5"} 2
+nxd_lat_seconds{quantile="0.9"} 4
+nxd_lat_seconds{quantile="0.99"} 4
+nxd_lat_seconds_sum 10
+nxd_lat_seconds_count 4
+# HELP nxd_reqs_total Requests.
+# TYPE nxd_reqs_total counter
+nxd_reqs_total{kind="a"} 3
+"""
+
+
+def test_snapshot_nests_into_json():
+    reg = MetricsRegistry()
+    reg.counter("nxd_reqs_total", labels=("kind",)).labels(kind="a").inc(3)
+    reg.histogram("nxd_lat_seconds").observe(2.0)
+    snap = reg.snapshot()
+    json.dumps(snap)  # must be JSON-serialisable as-is (bench.py aux)
+    assert snap["nxd_reqs_total"]["type"] == "counter"
+    assert snap["nxd_reqs_total"]["samples"] == [
+        {"labels": {"kind": "a"}, "value": 3.0}]
+    [hist] = snap["nxd_lat_seconds"]["samples"]
+    assert hist["count"] == 1 and hist["p50"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_chrome_export():
+    tracer = SpanTracer()
+    with tracer.span("outer", step=3):
+        with tracer.span("inner", kind="x"):
+            pass
+    events = tracer.chrome_trace()["traceEvents"]
+    inner, outer = events  # inner closes (records) first
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    assert inner["args"]["parent"] == "outer"
+    assert inner["args"]["kind"] == "x"
+    assert "parent" not in outer["args"] and outer["args"]["step"] == 3
+    for ev in events:
+        assert ev["ph"] == "X" and ev["dur"] >= 0.0
+
+
+def test_span_records_error_attribute():
+    tracer = SpanTracer()
+    with pytest.raises(ValueError):
+        with tracer.span("boom"):
+            raise ValueError("nope")
+    [ev] = tracer.chrome_trace()["traceEvents"]
+    assert ev["args"]["error"] == "ValueError"
+
+
+def test_tracer_stats_per_name():
+    tracer = SpanTracer()
+    for _ in range(5):
+        with tracer.span("work"):
+            pass
+    stats = tracer.stats()
+    assert stats["work"]["count"] == 5.0
+    assert stats["work"]["min_us"] <= stats["work"]["p50_us"] \
+        <= stats["work"]["max_us"]
+    assert stats["work"]["total_us"] >= stats["work"]["max_us"]
+
+
+def test_named_events_and_incomplete_snapshot():
+    tracer = SpanTracer()
+    with tracer.event("closed"):
+        pass
+    tracer.mark_event_start("still_open")
+    events = tracer.chrome_trace()["traceEvents"]
+    by_name = {ev["name"]: ev for ev in events}
+    assert by_name["closed"]["dur"] >= 0.0
+    assert "args" not in by_name["closed"]
+    assert by_name["still_open"]["dur"] == 0.0
+    assert by_name["still_open"]["args"]["incomplete"] is True
+    assert by_name["still_open"]["args"]["open_for_us"] >= 0.0
+    # the open span is still closable after the snapshot
+    tracer.mark_event_end("still_open")
+    closed = [ev for ev in tracer.chrome_trace()["traceEvents"]
+              if ev["name"] == "still_open"]
+    assert len(closed) == 1 and "args" not in closed[0]
+
+
+def test_mark_event_end_without_start_is_ignored():
+    tracer = SpanTracer()
+    tracer.mark_event_end("never_started")
+    assert tracer.chrome_trace()["traceEvents"] == []
+
+
+def test_disabled_tracer_records_nothing():
+    tracer = SpanTracer(enabled=False)
+    s = tracer.span("x")
+    assert s is tracer.span("y")  # one shared null span
+    with s:
+        pass
+    tracer.mark_event_start("a")
+    tracer.mark_event_end("a")
+    assert tracer.chrome_trace()["traceEvents"] == []
+    assert tracer.stats() == {}
+
+
+# ---------------------------------------------------------------------------
+# Timeline shim + save-race regression
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_shim_roundtrip(tmp_path):
+    from neuronx_distributed_tpu.utils.timeline import Timeline
+
+    tl = Timeline(str(tmp_path / "t.json"))
+    with tl.event("step"):
+        pass
+    tl.mark_event_start("manual")
+    tl.mark_event_end("manual")
+    with open(tl.save()) as f:
+        names = {ev["name"] for ev in json.load(f)["traceEvents"]}
+    assert names == {"step", "manual"}
+    # per-Timeline isolation: a second Timeline sees none of it
+    assert json.load(open(Timeline(str(tmp_path / "u.json")).save())) \
+        == {"traceEvents": []}
+
+
+def test_timeline_disabled_flag(tmp_path):
+    from neuronx_distributed_tpu.utils.timeline import Timeline
+
+    tl = Timeline(str(tmp_path / "t.json"), enabled=False)
+    with tl.event("ignored"):
+        pass
+    assert json.load(open(tl.save()))["traceEvents"] == []
+    tl.enabled = True
+    assert tl.enabled
+    with tl.event("kept"):
+        pass
+    assert len(json.load(open(tl.save()))["traceEvents"]) == 1
+
+
+def test_timeline_save_concurrent_with_writer_thread(tmp_path):
+    """Regression: the old Timeline.save iterated the event list while a
+    writer thread appended (RuntimeError / torn JSON) and silently
+    dropped open spans. Every save must now produce valid JSON."""
+    from neuronx_distributed_tpu.utils.timeline import Timeline
+
+    tl = Timeline(str(tmp_path / "race.json"))
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        try:
+            while not stop.is_set():
+                tl.mark_event_start(f"ev{i % 7}")
+                tl.mark_event_end(f"ev{i % 7}")
+                i += 1
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(50):
+            with open(tl.save()) as f:
+                trace = json.load(f)  # torn writes would fail to parse
+            assert "traceEvents" in trace
+    finally:
+        stop.set()
+        t.join()
+    assert errors == []
+
+
+def test_timeline_save_emits_open_span_as_incomplete(tmp_path):
+    from neuronx_distributed_tpu.utils.timeline import Timeline
+
+    tl = Timeline(str(tmp_path / "open.json"))
+    tl.mark_event_start("open_span")
+    with open(tl.save()) as f:
+        [ev] = json.load(f)["traceEvents"]
+    assert ev["name"] == "open_span" and ev["dur"] == 0.0
+    assert ev["args"]["incomplete"] is True
+
+
+# ---------------------------------------------------------------------------
+# compile tracking
+# ---------------------------------------------------------------------------
+
+
+def test_compile_tracker_counts_and_alerts_on_recompile():
+    obs.enable()
+    seen = []
+    unsub = obs.subscribe(lambda ev, fields: seen.append((ev, fields)))
+    try:
+        fn = jax.jit(lambda x: x * 2)
+        tracker = obs.CompileTracker.for_function("test/fn", fn)
+        fn(jnp.ones((4,)))
+        tracker.poll(wall_s=0.5)
+        reg = obs.get_registry()
+        assert obs.compile_events(reg) == 1.0
+        assert reg.get("nxd_recompile_total") is None
+        assert seen == []  # first compile is expected, no alert
+
+        fn(jnp.ones((8,)))  # shape change forces a recompile
+        tracker.poll(wall_s=0.7)
+        assert obs.compile_events(reg) == 2.0
+        recomp = reg.get("nxd_recompile_total")
+        assert recomp.labels(site="test/fn").value == 1.0
+        [(ev, fields)] = seen
+        assert ev == "recompile_detected"
+        assert fields["site"] == "test/fn" and fields["cache_size"] == 2
+        # compile wall time attributed via the histogram
+        hist = reg.get("nxd_compile_wall_seconds")
+        assert hist.labels(site="test/fn").count == 2
+    finally:
+        unsub()
+
+
+def test_compile_tracker_wrap_times_calls():
+    obs.enable()
+    fn = jax.jit(lambda x: x + 1)
+    tracker = obs.CompileTracker.for_function("test/wrapped", fn,
+                                              alert=False)
+    wrapped = tracker.wrap(fn)
+    wrapped(jnp.ones((3,)))
+    wrapped(jnp.ones((3,)))  # cached: no new compile
+    reg = obs.get_registry()
+    assert reg.get("nxd_compile_total").labels(
+        site="test/wrapped").value == 1.0
+
+
+def test_cache_size_best_effort():
+    assert obs.cache_size(lambda x: x) is None
+    fn = jax.jit(lambda x: x)
+    fn(jnp.ones((2,)))
+    assert obs.cache_size(fn) == 1
+
+
+# ---------------------------------------------------------------------------
+# engine: compile-once with obs enabled, stats bridged
+# ---------------------------------------------------------------------------
+
+
+def test_engine_compile_once_with_obs_enabled():
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference.engine import (EngineConfig,
+                                                          ServingEngine)
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+
+    ps.initialize_model_parallel()
+    obs.enable()
+    cfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                      num_layers=2)
+    params = meta.unbox(LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    ecfg = EngineConfig(block_size=4, num_blocks=16, max_slots=2,
+                        max_blocks_per_seq=8, token_budget=8,
+                        kv_dtype=jnp.float32)
+    eng = ServingEngine(cfg, params, ecfg)
+    rng = np.random.RandomState(0)
+    for i in range(5):  # ragged mix: prompt lengths and budgets vary
+        eng.submit(rng.randint(0, cfg.vocab_size,
+                               (int(rng.randint(3, 8)),)).tolist(),
+                   int(rng.randint(2, 6)), uid=f"r{i}")
+    results = eng.run()
+    assert all(r.status == "completed" for r in results.values())
+
+    # the invariant the tracker makes observable: still exactly 1 compile
+    assert eng.compile_count() == 1
+    reg = obs.get_registry()
+    assert obs.compile_events(reg) == 1.0
+    assert reg.get("nxd_recompile_total") is None
+
+    # EngineStats bridged into gauges + step latency histogram
+    fields = {c.labels["field"]: c.value
+              for c in reg.get("nxd_engine_stats").children()}
+    assert fields["completed"] == 5.0
+    assert fields["tokens_generated"] > 0.0
+    assert reg.get("nxd_engine_pool_free_blocks").value >= 0.0
+    assert reg.get("nxd_engine_step_seconds").count > 0
+
+    # phase spans recorded on the process tracer
+    names = set(obs.get_tracer().stats())
+    assert {"engine/admission", "engine/packed",
+            "engine/retirement"} <= names
+
+
+# ---------------------------------------------------------------------------
+# wire-byte counters vs the codec's arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_grad_wire_counters_match_codec_prediction():
+    from neuronx_distributed_tpu.parallel import comm_compressed as cc
+    from neuronx_distributed_tpu.parallel.wire_codec import (
+        CompressionConfig, blockwise_wire_bytes)
+
+    ps.initialize_model_parallel()
+    mesh = ps.get_mesh()
+    group = dict(mesh.shape).get("dp", 1) * dict(mesh.shape).get("cp", 1)
+    assert group == 8
+    obs.enable()
+    cfg8 = cc.CompressionConfig(dtype="int8", block_size=256)
+    elems = 4096
+    x = jnp.ones((elems,), jnp.float32)
+
+    def inner(v):
+        return cc.all_reduce(v, ("dp", "cp"), config=cfg8, op="mean")
+
+    fn = jax.jit(ps.shard_map(inner, mesh, in_specs=(P(),), out_specs=P()))
+    jax.block_until_ready(fn(x))
+
+    wire, raw = obs.wire_totals()
+    # compressed all_reduce = quantized RS + AG: 2 wire passes
+    predicted_wire = 2 * blockwise_wire_bytes(elems, cfg8)
+    predicted_raw = 2 * 4.0 * elems
+    assert wire == pytest.approx(predicted_wire, rel=0.05)
+    assert raw == pytest.approx(predicted_raw, rel=0.05)
+
+    measured = obs.wire_compression_ratio()
+    predicted = 4.0 / CompressionConfig(
+        dtype="int8", block_size=256).wire_bytes_per_element
+    assert measured == pytest.approx(predicted, rel=0.05)
+
+    kinds = {c.labels["collective"]
+             for c in obs.get_registry().get(
+                 "nxd_wire_bytes_total").children()}
+    assert kinds == {"grad_all_reduce"}
+
+
+def test_act_wire_counters_match_payload_prediction():
+    from neuronx_distributed_tpu.ops import collective_matmul as cm
+    from neuronx_distributed_tpu.parallel.wire_codec import (
+        payload_wire_bytes)
+
+    ps.initialize_model_parallel(tensor_model_parallel_size=8)
+    mesh = ps.get_mesh()
+    tp = dict(mesh.shape)["tp"]
+    obs.enable()
+    wire = cm.wire_config("int8")
+    batch, seq, hidden, inter = 2, 64, 32, 64
+    # global shapes; in_specs shard seq over tp, so the per-shard block
+    # the taps see is (batch, seq // tp, hidden)
+    x = jnp.ones((batch, seq, hidden), jnp.float32)
+    wu = jnp.ones((hidden, inter // tp), jnp.float32) * 0.01
+    wd = jnp.ones((inter // tp, hidden), jnp.float32) * 0.01
+
+    def mlp(xv, wuv, wdv):
+        h = cm.all_gather_matmul(xv, wuv, "tp", 1, impl="decomposed",
+                                 wire=wire)
+        return cm.matmul_reduce_scatter(h, wdv, "tp", 1,
+                                        impl="decomposed", wire=wire)
+
+    fn = jax.jit(ps.shard_map(
+        mlp, mesh,
+        in_specs=(P(None, "tp", None), P(None, "tp"), P("tp", None)),
+        out_specs=P(None, "tp", None)))
+    jax.block_until_ready(fn(x, wu, wd))
+
+    vals = {c.labels["collective"]: c.value
+            for c in obs.get_registry().get(
+                "nxd_wire_bytes_total").children()}
+    # AG ring: each rank's [b, s/tp, h] shard takes tp-1 hops
+    pred_ag = payload_wire_bytes((batch, seq // tp, hidden),
+                                 wire) * (tp - 1)
+    # RS ring: per-hop payload is the output block with dim 1 cut by tp
+    pred_rs = payload_wire_bytes((batch, seq // tp, hidden),
+                                 wire) * (tp - 1)
+    assert vals["act_all_gather_matmul"] == pytest.approx(pred_ag,
+                                                          rel=0.05)
+    assert vals["act_matmul_reduce_scatter"] == pytest.approx(pred_rs,
+                                                              rel=0.05)
+    assert obs.wire_compression_ratio() > 3.0  # int8 wire engaged
+
+
+def test_wire_accounting_disabled_is_silent():
+    from neuronx_distributed_tpu.parallel import comm_compressed as cc
+
+    ps.initialize_model_parallel()
+    mesh = ps.get_mesh()
+    assert not obs.enabled()
+    cfg8 = cc.CompressionConfig(dtype="int8", block_size=256)
+
+    def inner(v):
+        return cc.all_reduce(v, ("dp", "cp"), config=cfg8, op="mean")
+
+    fn = jax.jit(ps.shard_map(inner, mesh, in_specs=(P(),), out_specs=P()))
+    jax.block_until_ready(fn(jnp.ones((512,), jnp.float32)))
+    assert obs.wire_totals() == (0.0, 0.0)
+    assert obs.wire_compression_ratio() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# event channel
+# ---------------------------------------------------------------------------
+
+
+def test_log_event_emits_line_and_counts():
+    from neuronx_distributed_tpu.utils.logger import get_logger, log_event
+
+    obs.enable()
+    logger = get_logger("neuronx_distributed_tpu.test_obs_events")
+    with _capture(logger) as lines:
+        log_event(logger, "unit_test_event", detail=1, who="test")
+    [line] = [ln for ln in lines if ln.startswith("NXD_EVENT ")]
+    payload = json.loads(line.split(" ", 1)[1])
+    assert payload == {"detail": 1, "event": "unit_test_event",
+                       "who": "test"}
+    counter = obs.get_registry().get("nxd_events_total")
+    assert counter.labels(event="unit_test_event").value == 1.0
+
+
+def test_log_event_line_survives_disabled_registry():
+    from neuronx_distributed_tpu.utils.logger import get_logger, log_event
+
+    assert not obs.enabled()
+    logger = get_logger("neuronx_distributed_tpu.test_obs_events")
+    with _capture(logger) as lines:
+        log_event(logger, "disabled_mode_event")
+    assert any(ln.startswith("NXD_EVENT ") for ln in lines)
+    assert obs.get_registry().get("nxd_events_total") is None
+
+
+def test_subscriber_fanout_and_unsubscribe():
+    seen = []
+    unsub = obs.subscribe(lambda ev, fields: seen.append((ev, fields)))
+    try:
+        obs.emit_event("sub_test", a=1)
+    finally:
+        unsub()
+    obs.emit_event("sub_test", a=2)  # after unsubscribe: not delivered
+    assert seen == [("sub_test", {"a": 1})]
+    unsub()  # idempotent
+
+
+def test_subscriber_exception_does_not_break_emit():
+    def bad(ev, fields):
+        raise RuntimeError("subscriber bug")
+
+    seen = []
+    unsub_bad = obs.subscribe(bad)
+    unsub_ok = obs.subscribe(lambda ev, fields: seen.append(ev))
+    try:
+        obs.emit_event("resilient_event")
+    finally:
+        unsub_bad()
+        unsub_ok()
+    assert seen == ["resilient_event"]
+
+
+# ---------------------------------------------------------------------------
+# logger satellites
+# ---------------------------------------------------------------------------
+
+
+def test_bad_log_level_warns_once_per_value(monkeypatch):
+    from neuronx_distributed_tpu.utils import logger as lg
+
+    pkg_logger = logging.getLogger("neuronx_distributed_tpu")
+    monkeypatch.setenv("NXD_LOG_LEVEL", "VERBOSE")
+    lg._WARNED_BAD_LEVELS.discard("VERBOSE")
+    lg._WARNED_BAD_LEVELS.discard("NOPE")
+    with _capture(pkg_logger) as lines:
+        assert lg.get_log_level() == logging.INFO
+        assert lg.get_log_level() == logging.INFO  # second call: silent
+        monkeypatch.setenv("NXD_LOG_LEVEL", "NOPE")
+        assert lg.get_log_level() == logging.INFO  # new value warns again
+    warnings = [ln for ln in lines if "NXD_LOG_LEVEL" in ln]
+    assert len(warnings) == 2
+    assert "'VERBOSE'" in warnings[0] and "'NOPE'" in warnings[1]
+
+
+def test_non_level_attribute_rejected(monkeypatch):
+    # getattr(logging, ...) lookups that hit non-level attributes must not
+    # leak through as "levels"
+    from neuronx_distributed_tpu.utils import logger as lg
+
+    monkeypatch.setenv("NXD_LOG_LEVEL", "raiseExceptions")  # bool attr
+    lg._WARNED_BAD_LEVELS.discard("raiseExceptions")
+    assert lg.get_log_level() == logging.INFO
+
+
+def test_get_logger_tracks_env_level_changes(monkeypatch):
+    from neuronx_distributed_tpu.utils.logger import get_logger
+
+    monkeypatch.setenv("NXD_LOG_LEVEL", "INFO")
+    lgr = get_logger("neuronx_distributed_tpu.test_obs_level")
+    assert lgr.level == logging.INFO
+    monkeypatch.setenv("NXD_LOG_LEVEL", "DEBUG")
+    assert get_logger(
+        "neuronx_distributed_tpu.test_obs_level").level == logging.DEBUG
+    monkeypatch.setenv("NXD_LOG_LEVEL", "warning")  # case-insensitive
+    assert get_logger(
+        "neuronx_distributed_tpu.test_obs_level").level == logging.WARNING
+
+
+# ---------------------------------------------------------------------------
+# the single enable switch
+# ---------------------------------------------------------------------------
+
+
+def test_enable_disable_govern_registry_and_tracer():
+    assert not obs.enabled()
+    obs.enable()
+    assert obs.enabled()
+    assert obs.get_registry().enabled and obs.get_tracer().enabled
+    obs.get_registry().counter("nxd_probe_total").inc()
+    with obs.get_tracer().span("probe"):
+        pass
+    obs.disable()
+    assert not obs.get_registry().enabled
+    assert not obs.get_tracer().enabled
+    obs.get_registry().counter("nxd_probe_total").inc(100)  # no-op now
+    assert obs.get_registry().get("nxd_probe_total").value == 1.0
+    assert obs.get_tracer().stats()["probe"]["count"] == 1.0
