@@ -71,7 +71,8 @@ import jax.numpy as jnp  # noqa: E402
 
 
 def main(chaos_spec=None, serving=False, overlap=False, router=False,
-         prefix_heavy=False, plan_mode=False, obs_mode=False):
+         prefix_heavy=False, plan_mode=False, obs_mode=False,
+         elastic=False):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -228,6 +229,19 @@ def main(chaos_spec=None, serving=False, overlap=False, router=False,
 
             traceback.print_exc()
             print(f"bench: router metric failed: {e!r}", file=sys.stderr)
+
+    # elastic-fleet drill (docs/serving.md "Elastic fleet"): opt-in via
+    # --elastic; the full scale cycle (preempt -> live session migration,
+    # chaos scale_burst -> AOT-warm scale-up, scripted + obs-driven
+    # scale-down, revival through the executable cache)
+    if elastic:
+        try:
+            aux.update(elastic_metric(platform))
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: elastic metric failed: {e!r}", file=sys.stderr)
 
     # prefix-heavy serving drill (docs/serving.md): opt-in via
     # --prefix-heavy; 64 requests sharing a system prompt through the
@@ -765,6 +779,86 @@ def router_metric(platform: str) -> dict:
         f"router_greedy_match_ref_{tag}": {
             "value": round(drill["router_greedy_match_ref"], 4),
             "unit": "frac", "vs_baseline": 1.0},
+    }
+
+
+def elastic_metric(platform: str) -> dict:
+    """Elastic-fleet drill (docs/serving.md "Elastic fleet"): run
+    :func:`elastic_chaos_drill` — chaos preempts a replica (its live
+    KV sessions migrate to survivors with zero re-prefill), a
+    ``scale_burst`` forces an AOT-cache-warm scale-up, a scale-down
+    retires a replica by migration, and the preempted replica revives
+    through the cache. RETURNS aux entries keyed by metric name —
+    never prints the JSON line itself."""
+    import tempfile
+
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference.engine import EngineConfig
+    from neuronx_distributed_tpu.inference.router import elastic_chaos_drill
+    from neuronx_distributed_tpu.models import llama
+    from neuronx_distributed_tpu.parallel import mesh as ps
+
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel()
+    if platform == "cpu":
+        cfg = llama.tiny_config(num_layers=2, dtype=jnp.float32,
+                                param_dtype=jnp.float32)
+        n_req, prompt_len, max_new = 8, 8, 4
+        ecfg = EngineConfig(block_size=4, num_blocks=16, max_slots=4,
+                            max_blocks_per_seq=8, token_budget=8,
+                            kv_dtype=jnp.float32)
+    else:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=16, num_heads=8, num_kv_heads=8, max_seq_len=4096)
+        n_req, prompt_len, max_new = 12, 32, 16
+        ecfg = EngineConfig(block_size=16, num_blocks=128, max_slots=8,
+                            max_blocks_per_seq=16, token_budget=64,
+                            kv_dtype=cfg.dtype)
+    params = meta.unbox(llama.LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    with tempfile.TemporaryDirectory(prefix="nxd-aot-") as cache_dir:
+        drill = elastic_chaos_drill(cfg, params, ecfg, n_requests=n_req,
+                                    prompt_len=prompt_len,
+                                    max_new_tokens=max_new,
+                                    clock=lambda: 0.0,
+                                    cache_dir=cache_dir)
+    print(f"bench: elastic drill "
+          f"availability={drill['elastic_availability']} "
+          f"migrated_tokens={drill['migrated_tokens']} "
+          f"reprefilled_tokens={drill['reprefilled_tokens']} "
+          f"cold_ms={drill['bundle_cold_start_ms']:.1f} "
+          f"warm_ms={drill['bundle_cold_start_warm_ms']:.1f}",
+          file=sys.stderr)
+    tag = f"{platform}1"
+    return {
+        f"elastic_availability_{tag}": {
+            "value": round(drill["elastic_availability"], 4),
+            "unit": "frac", "vs_baseline": 1.0},
+        f"bundle_cold_start_warm_ms_{tag}": {
+            "value": round(drill["bundle_cold_start_warm_ms"], 2),
+            "unit": "ms", "vs_baseline": 1.0},
+        f"bundle_cold_start_speedup_{tag}": {
+            "value": round(drill["bundle_cold_start_speedup"], 2),
+            "unit": "x", "vs_baseline": 1.0},
+        f"migrated_tokens_{tag}": {
+            "value": int(drill["migrated_tokens"]), "unit": "tokens",
+            "vs_baseline": 1.0},
+        f"reprefilled_tokens_{tag}": {
+            "value": int(drill["reprefilled_tokens"]), "unit": "tokens",
+            "vs_baseline": 1.0},
+        f"elastic_greedy_match_ref_{tag}": {
+            "value": round(drill["elastic_greedy_match_ref"], 4),
+            "unit": "frac", "vs_baseline": 1.0},
+        f"elastic_scale_events_{tag}": {
+            "value": int(drill["elastic_scale_ups"]
+                         + drill["elastic_scale_downs"]
+                         + drill["elastic_preemptions"]),
+            "unit": "events", "vs_baseline": 1.0},
+        f"elastic_max_compile_count_{tag}": {
+            "value": int(drill["max_compile_count"]), "unit": "compiles",
+            "vs_baseline": 1.0},
     }
 
 
@@ -1363,6 +1457,12 @@ if __name__ == "__main__":
              "a replica mid-decode; reports availability, failovers, and "
              "chaos TTFT p99; docs/serving.md)")
     _p.add_argument(
+        "--elastic", action="store_true",
+        help="also run the elastic-fleet drill (chaos preempt -> live KV "
+             "session migration, scale_burst -> AOT-warm scale-up, "
+             "graceful scale-down, revival through the executable cache; "
+             "docs/serving.md)")
+    _p.add_argument(
         "--prefix-heavy", action="store_true",
         help="also run the prefix-heavy serving drill (64 requests sharing "
              "a system prompt; prefix trie + copy-on-write vs no-sharing "
@@ -1388,4 +1488,4 @@ if __name__ == "__main__":
     main(chaos_spec=_args.chaos, serving=_args.serving,
          overlap=_args.overlap, router=_args.router,
          prefix_heavy=_args.prefix_heavy, plan_mode=_args.plan,
-         obs_mode=_args.obs)
+         obs_mode=_args.obs, elastic=_args.elastic)

@@ -1,0 +1,84 @@
+"""Rule ``elasticity``: serving executables must go through the AOT cache.
+
+The elastic-fleet contract (``docs/serving.md``) is that replica spin-up —
+scale-up, failover revival, disaggregated worker registration — *loads* a
+serialized executable instead of recompiling it, so a new replica is
+serving in milliseconds instead of minutes. Two anti-patterns silently
+reintroduce compile-on-scale:
+
+* **Constructing ``ServingEngine(...)`` without ``aot_cache=``** in
+  serving paths — the engine falls back to plain ``jax.jit``, every
+  spin-up pays a cold compile, and the fleet's cold-start SLO quietly
+  regresses from milliseconds to minutes.
+
+* **Raw ``.lower(...).compile(...)`` chains** in ``inference/`` — AOT
+  compilation outside :meth:`AotExecutableCache.compile_or_load` is
+  invisible to the cache: the executable is rebuilt on every process and
+  never persisted for the next replica.
+
+``aot_cache.py`` itself (the one sanctioned compile site) and
+``model_builder.py`` (whose ``compile()`` is the cache-aware entry point
+with an explicit uncached fallback) are exempt by filename.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Iterator
+
+from . import astutil
+from .core import Finding, LintContext, register
+
+_EXEMPT_FILES = ("aot_cache.py", "model_builder.py")
+_ENGINE_CTORS = ("ServingEngine",)
+
+
+def _in_inference(path: str) -> bool:
+    return "inference" in pathlib.PurePath(path).parts
+
+
+def _is_exempt(path: str) -> bool:
+    return pathlib.PurePath(path).name in _EXEMPT_FILES
+
+
+def _is_lower_compile(node: ast.Call) -> bool:
+    """``<expr>.lower(...).compile(...)`` — an AOT compile chain."""
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "compile"):
+        return False
+    inner = f.value
+    return (isinstance(inner, ast.Call)
+            and isinstance(inner.func, ast.Attribute)
+            and inner.func.attr == "lower")
+
+
+@register(
+    "elasticity",
+    "serving engine/worker construction in inference/ that bypasses the "
+    "AOT executable cache (ServingEngine without aot_cache=, raw "
+    ".lower().compile() chains) — reintroduces compile-on-scale")
+def check(ctx: LintContext) -> Iterator[Finding]:
+    if not _in_inference(ctx.path) or _is_exempt(ctx.path):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.tail_name(node.func)
+        if name in _ENGINE_CTORS:
+            kwargs = {kw.arg for kw in node.keywords}
+            if "aot_cache" not in kwargs and None not in kwargs:
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, "elasticity",
+                    f"`{name}(...)` without `aot_cache=` — this replica "
+                    "cold-compiles on every spin-up instead of loading "
+                    "the fleet's serialized executable; pass the shared "
+                    "AotExecutableCache (or aot_cache=None explicitly "
+                    "for a deliberately uncached engine)")
+        elif _is_lower_compile(node):
+            yield Finding(
+                ctx.path, node.lineno, node.col_offset, "elasticity",
+                "raw `.lower(...).compile(...)` in a serving path — AOT "
+                "compilation outside AotExecutableCache.compile_or_load "
+                "is never persisted, so every new replica recompiles; "
+                "route it through the cache")

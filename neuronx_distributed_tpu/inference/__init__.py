@@ -9,9 +9,13 @@
 * :mod:`.paging` — paged KV block pool + host-side block allocator.
 * :mod:`.engine` — continuous-batching serving engine over the paged pool.
 * :mod:`.router` — multi-replica front-end: placement, admission control,
-  health-checked failover, graceful drain.
+  health-checked failover, graceful drain, obs-driven autoscaling, live
+  KV-session migration.
+* :mod:`.aot_cache` — serialized-executable cache: replicas *load* their
+  compiled step instead of recompiling (warm scale-up/revival).
 """
 
+from . import aot_cache
 from . import generation
 from . import kv_cache
 from . import model_builder
@@ -21,8 +25,9 @@ from . import engine
 from . import sampling
 from . import speculative
 from . import router
+from .aot_cache import AotExecutableCache, AotWorker
 from .engine import (EngineConfig, EngineStats, RequestRejected,
-                     RequestResult, ServingEngine)
+                     RequestResult, ServingEngine, SessionTicket)
 from .generation import (DECODE_BUCKETS, decode_step, generate, pick_bucket,
                          prefill)
 from .kv_cache import KVCache, init_kv_cache
@@ -34,22 +39,25 @@ from .paging import (BlockAllocator, CacheExhaustedError, PagedKVCache,
                      PrefixCache, QuantizedPagedKVCache, cow_copy_blocks,
                      init_paged_kv_cache, init_quantized_paged_kv_cache)
 from .router import (ReplicaRouter, RouterConfig, RouterResult, RouterStats,
-                     ServingPreempted, TenantPolicy)
+                     ScalePolicy, ServingPreempted, TenantPolicy,
+                     elastic_chaos_drill)
 from .sampling import SamplingConfig, sample
 from .speculative import make_speculation_round_fn
 
 __all__ = [
     "generation", "kv_cache", "model_builder", "sampling",
-    "benchmark", "speculative", "paging", "engine", "router",
+    "benchmark", "speculative", "paging", "engine", "router", "aot_cache",
+    "AotExecutableCache", "AotWorker",
     "DECODE_BUCKETS", "decode_step", "generate", "pick_bucket", "prefill",
     "KVCache", "init_kv_cache",
     "BlockAllocator", "CacheExhaustedError", "PagedKVCache",
     "PrefixCache", "QuantizedPagedKVCache", "cow_copy_blocks",
     "init_paged_kv_cache", "init_quantized_paged_kv_cache",
     "ServingEngine", "EngineConfig", "EngineStats", "RequestRejected",
-    "RequestResult",
+    "RequestResult", "SessionTicket",
     "ReplicaRouter", "RouterConfig", "RouterResult", "RouterStats",
-    "ServingPreempted", "TenantPolicy",
+    "ScalePolicy", "ServingPreempted", "TenantPolicy",
+    "elastic_chaos_drill",
     "ModelBuilder", "NxDModel", "generate_buckets", "shard_checkpoint",
     "register_serving_workers", "serving_state_spec",
     "bundle_generate", "bundle_speculative_generate",

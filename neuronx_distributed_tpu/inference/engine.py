@@ -39,10 +39,11 @@ from ..models.llama import LlamaConfig, llama_forward_with_cache
 from ..obs.accounting import CompileTracker
 from ..obs.metrics import get_registry
 from ..obs.tracing import get_tracer
+from .aot_cache import AotExecutableCache, AotWorker, source_fingerprint
 from .kv_cache import PAD_POSITION
 from .paging import (BlockAllocator, CacheExhaustedError, PrefixCache,
-                     cow_copy_blocks, init_paged_kv_cache,
-                     init_quantized_paged_kv_cache)
+                     cow_copy_blocks, extract_blocks, init_paged_kv_cache,
+                     init_quantized_paged_kv_cache, inject_blocks)
 from .sampling import SamplingConfig, sample
 
 
@@ -152,6 +153,31 @@ class _RequestState:
 
 
 @dataclasses.dataclass
+class SessionTicket:
+    """A live request lifted off one engine for landing on another
+    (:meth:`ServingEngine.export_session` → ``import_session``).
+
+    Carries everything the destination needs to continue the session
+    with *zero re-prefill*: the scheduler state plus the session's KV
+    blocks as a portable :func:`~.paging.extract_blocks` payload
+    (``kv``/``n_blocks`` are ``None``/0 for a still-queued request —
+    nothing was prefilled, nothing ships). ``age_s``/``ttft_s`` are
+    relative, so the destination rebuilds arrival/first-token times
+    against its own epoch and latency accounting stays honest across
+    the move."""
+
+    uid: str
+    prompt: List[int]
+    generated: List[int]
+    max_new_tokens: int
+    n_cached: int
+    age_s: float
+    ttft_s: Optional[float]
+    n_blocks: int = 0
+    kv: Optional[Dict[str, Any]] = None
+
+
+@dataclasses.dataclass
 class RequestResult:
     uid: str
     prompt_len: int
@@ -173,6 +199,9 @@ class EngineStats:
     cow_copies: int = 0             # shared blocks cloned before a write
     prefix_hit_tokens: int = 0      # prompt tokens mapped from the trie
     prefill_tokens: int = 0         # prompt tokens actually computed
+    migrated_in: int = 0            # sessions landed via import_session
+    migrated_out: int = 0           # sessions shipped via export_session
+    migrated_tokens: int = 0        # cached tokens landed without prefill
     ttft_s: List[float] = dataclasses.field(default_factory=list)
     step_latency_s: List[float] = dataclasses.field(default_factory=list)
     occupancy: List[float] = dataclasses.field(default_factory=list)
@@ -215,6 +244,9 @@ class EngineStats:
         d["rejected"] = self.rejected
         d["resubmitted"] = self.resubmitted
         d["queue_depth"] = self.queue_depth
+        d["migrated_in"] = self.migrated_in
+        d["migrated_out"] = self.migrated_out
+        d["migrated_tokens"] = self.migrated_tokens
         return d
 
 
@@ -225,10 +257,18 @@ class ServingEngine:
     def __init__(self, model_cfg: LlamaConfig, params,
                  engine_cfg: EngineConfig = EngineConfig(),
                  rng: Optional[jax.Array] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 aot_cache: Optional[AotExecutableCache] = None,
+                 name: Optional[str] = None):
         self.model_cfg = model_cfg
         self.params = params
         self.ecfg = engine_cfg
+        # elastic-fleet hooks: an AOT cache makes worker construction
+        # load-or-compile (replicas after the first spin up without
+        # compiling); a name scopes this engine's obs compile-tracker
+        # sites so a fleet's replicas don't alias one site
+        self.name = name
+        self._aot = aot_cache
         self.allocator = BlockAllocator(engine_cfg.num_blocks)
         self.stats = EngineStats()
         self.results: Dict[str, RequestResult] = {}
@@ -253,15 +293,19 @@ class ServingEngine:
             if engine_cfg.prefix_sharing else None)
         self.cache = self._init_cache()
         if engine_cfg.disaggregated:
-            # two workers, two jit instances: each sees exactly one input
-            # shape, so each compiles exactly once
+            # two workers, two jit/AOT instances: each sees exactly one
+            # input shape, so each compiles exactly once
             self._step_fn = None
-            self._prefill_fn = self._build_step()
-            self._decode_fn = self._build_step()
+            self._prefill_fn = self._build_worker(
+                "prefill",
+                engine_cfg.prefill_budget or engine_cfg.token_budget)
+            self._decode_fn = self._build_worker(
+                "decode", engine_cfg.max_slots)
             workers = {"prefill": self._prefill_fn,
                        "decode": self._decode_fn}
         else:
-            self._step_fn = self._build_step()
+            self._step_fn = self._build_worker(
+                "packed", engine_cfg.token_budget)
             self._prefill_fn = self._decode_fn = None
             workers = {"packed": self._step_fn}
         # observability: per-worker compile trackers (any compile beyond
@@ -269,9 +313,10 @@ class ServingEngine:
         # invariant made observable) + phase spans in step(). All of it
         # is host-side and polls the jit cache from outside, so the
         # compile-once behaviour itself is untouched.
+        site = f"engine/{name}" if name else "engine"
         self._compile_trackers = {
-            name: CompileTracker.for_function(f"engine/{name}", fn)
-            for name, fn in workers.items()}
+            wn: CompileTracker.for_function(f"{site}/{wn}", fn)
+            for wn, fn in workers.items()}
         self._obs_cache = None  # (registry, generation, handles...)
 
     # -- construction -----------------------------------------------------
@@ -316,6 +361,49 @@ class ServingEngine:
         # warns, so keep it off there
         donate = (1,) if jax.default_backend() in ("tpu", "axon") else ()
         return jax.jit(step_fn, donate_argnums=donate)
+
+    def _build_worker(self, worker: str, width: int):
+        """One serving worker: the jitted step, or — with an AOT cache —
+        a load-or-compile :class:`~.aot_cache.AotWorker`. Workers are
+        fully determined by (program, config, shapes), so the cache key
+        folds all of :meth:`_worker_fingerprint` plus the packed width;
+        the first replica per key compiles, every later replica (a
+        scale-up, a probation revival, a restarted process with a disk
+        cache) loads the serialized executable instead."""
+        jitted = self._build_step()
+        if self._aot is None:
+            return jitted
+        key = self._aot.key_for("engine-step", worker, width,
+                                *self._worker_fingerprint())
+        compiled, from_cache = self._aot.compile_or_load(
+            key, jitted, self._example_args(width))
+        return AotWorker(compiled, from_cache)
+
+    def _worker_fingerprint(self) -> Tuple[Any, ...]:
+        """Everything besides shape width that changes the compiled step:
+        model config, engine knobs the traced program reads, the source
+        of the forward + sampler, and the params treedef/shapes/dtypes
+        (values don't matter — params are a runtime operand)."""
+        e = self.ecfg
+        params_spec = tuple(
+            (jax.tree_util.keystr(path), tuple(x.shape), str(x.dtype))
+            for path, x in jax.tree_util.tree_flatten_with_path(
+                self.params)[0])
+        return (repr(self.model_cfg), e.block_size, e.num_blocks,
+                e.max_slots, e.max_blocks_per_seq, e.quantized,
+                str(e.kv_dtype), repr(e.sampling),
+                source_fingerprint(llama_forward_with_cache, sample),
+                params_spec)
+
+    def _example_args(self, width: int):
+        """Abstract-equivalent inputs for AOT lowering: exactly the
+        shapes/dtypes/shardings ``_run_worker`` passes at ``width``
+        (an all-pad batch — only avals matter for lowering)."""
+        tokens = jnp.zeros((1, width), jnp.int32)
+        positions = jnp.full((1, width), PAD_POSITION, jnp.int32)
+        slot_ids = jnp.full((width,), self.ecfg.max_slots, jnp.int32)
+        return (self.params, self.cache, tokens, positions, slot_ids,
+                self._rng)
 
     def worker_compile_counts(self) -> Dict[str, int]:
         """Per-worker compile counts: ``{"packed": n}`` or, when
@@ -447,6 +535,156 @@ class ServingEngine:
                 self.stats.queue_depth = self.queue_depth()
                 return list(req.prompt), list(req.generated)
         raise KeyError(f"request {request_id!r} is not live on this engine")
+
+    # -- live migration ---------------------------------------------------
+
+    def aot_warm(self) -> bool:
+        """True when every worker loaded from the AOT cache — this
+        engine spun up without compiling anything."""
+        fns = ([self._prefill_fn, self._decode_fn]
+               if self.ecfg.disaggregated else [self._step_fn])
+        return all(getattr(fn, "from_cache", False) for fn in fns)
+
+    def export_session(self, request_id: str) -> SessionTicket:
+        """Lift a live request off this engine as a :class:`SessionTicket`
+        — scheduler state plus its KV blocks — leaving no trace here
+        (blocks freed, no ``results`` entry; the session's fate belongs
+        to the importer). Unlike :meth:`evict`, generated tokens and
+        cached KV *survive*: landing the ticket elsewhere re-prefills
+        nothing. Raises ``KeyError`` if the request is not live here."""
+        now = self._now()
+        for req in self._queue:
+            if req.uid == request_id:
+                self._queue.remove(req)
+                self.stats.migrated_out += 1
+                self.stats.queue_depth = self.queue_depth()
+                return SessionTicket(
+                    uid=req.uid, prompt=list(req.prompt),
+                    generated=list(req.generated),
+                    max_new_tokens=req.max_new_tokens,
+                    n_cached=0, age_s=now - req.arrival_time,
+                    ttft_s=None)
+        for req in self._slots:
+            if req is not None and req.uid == request_id:
+                blocks = [int(b) for b in self._tables[req.slot]
+                          if b >= 0]
+                # keep_upto=n_cached: a partially-shared donor block
+                # ships only this session's rows, never the donor's tail
+                kv = extract_blocks(self.cache, blocks,
+                                    keep_upto=req.n_cached)
+                ticket = SessionTicket(
+                    uid=req.uid, prompt=list(req.prompt),
+                    generated=list(req.generated),
+                    max_new_tokens=req.max_new_tokens,
+                    n_cached=req.n_cached,
+                    age_s=now - req.arrival_time,
+                    ttft_s=(req.first_token_time - req.arrival_time
+                            if req.first_token_time is not None
+                            else None),
+                    n_blocks=len(blocks), kv=kv)
+                self._release(req)
+                self.stats.migrated_out += 1
+                self.stats.queue_depth = self.queue_depth()
+                return ticket
+        raise KeyError(f"request {request_id!r} is not live on this engine")
+
+    def import_session(self, ticket: SessionTicket) -> None:
+        """Land a :class:`SessionTicket` here and continue it with zero
+        re-prefill: allocate fresh blocks, inject the shipped KV, rebuild
+        the scheduler state at its exported position. All-or-nothing —
+        :class:`RequestRejected` (draining / never-fits, raised *without*
+        recording a result: the ticket still belongs to the caller) or
+        :class:`CacheExhaustedError` (no slot / no blocks) leave this
+        engine untouched so the caller can try another destination or
+        fall back to resubmission."""
+        if self._draining:
+            raise RequestRejected(
+                "draining", f"{ticket.uid}: engine is draining")
+        if not self.fits(len(ticket.prompt), ticket.max_new_tokens):
+            raise RequestRejected(
+                "never_fits", f"{ticket.uid}: cannot fit this engine")
+        now = self._now()
+        req = _RequestState(
+            uid=ticket.uid, prompt=[int(t) for t in ticket.prompt],
+            max_new_tokens=int(ticket.max_new_tokens),
+            arrival_time=now - ticket.age_s,
+            generated=[int(t) for t in ticket.generated])
+        if ticket.n_blocks == 0:
+            self._queue.append(req)
+            self.stats.migrated_in += 1
+            self.stats.queue_depth = self.queue_depth()
+            return
+        free = self._free_slots()
+        if not free:
+            raise CacheExhaustedError(
+                f"{ticket.uid}: no free slot on this engine")
+        blocks = self._alloc_blocks(ticket.n_blocks)
+        self.cache = inject_blocks(self.cache, blocks, ticket.kv)
+        # injected blocks are fully overwritten (K/V and positions) —
+        # a pending freed-position wipe would null real rows
+        self._freed_dirty.difference_update(blocks)
+        slot = free[0]
+        req.slot = slot
+        req.admit_seq = self._admit_counter
+        self._admit_counter += 1
+        req.n_cached = int(ticket.n_cached)
+        if ticket.ttft_s is not None:
+            req.first_token_time = req.arrival_time + ticket.ttft_s
+        for i, blk in enumerate(blocks):
+            self._tables[slot, i] = blk
+        self._slot_blocks[slot] = list(blocks)
+        self._slots[slot] = req
+        self.stats.migrated_in += 1
+        self.stats.migrated_tokens += req.n_cached
+        self.stats.queue_depth = self.queue_depth()
+        # the landed prompt blocks are publishable prefix state here too
+        self._maybe_insert_prefix(req)
+
+    def export_prefixes(self, max_blocks: Optional[int] = None
+                        ) -> Optional[Dict[str, Any]]:
+        """Ship (up to ``max_blocks``) hottest prefix-trie subtrees with
+        their pool blocks — warm-start material for a fresh replica, so
+        scale-up doesn't start with a cold trie. ``None`` when there is
+        nothing to ship."""
+        if self.prefix_cache is None or self.prefix_cache.size == 0:
+            return None
+        nodes = self.prefix_cache.snapshot(max_blocks)
+        blocks = [n["block"] for n in nodes]
+        kv = extract_blocks(self.cache, blocks, keep_upto=PAD_POSITION)
+        return {"nodes": nodes, "kv": kv}
+
+    def import_prefixes(self, shipment: Optional[Dict[str, Any]]) -> int:
+        """Land an :meth:`export_prefixes` shipment into this engine's
+        trie; returns the number of nodes inserted. Best-effort: a full
+        pool imports nothing (0), nodes the trie already holds keep the
+        local block and the shipped copy frees."""
+        if (self.prefix_cache is None or not shipment
+                or not shipment["nodes"]):
+            return 0
+        nodes = shipment["nodes"]
+        try:
+            blocks = self._alloc_blocks(len(nodes))
+        except CacheExhaustedError:
+            return 0
+        self.cache = inject_blocks(self.cache, blocks, shipment["kv"])
+        self._freed_dirty.difference_update(blocks)
+        chains: List[Optional[int]] = []
+        imported = 0
+        for node, blk in zip(nodes, blocks):
+            parent = (None if node["parent"] is None
+                      else chains[node["parent"]])
+            if node["parent"] is not None and parent is None:
+                chains.append(None)   # orphaned by a collision upstream
+            else:
+                chain, inserted = self.prefix_cache.insert(
+                    parent, node["tokens"], blk)
+                chains.append(chain)
+                imported += inserted
+            # drop the import's own ref: the trie (or nobody) owns the
+            # block now; blocks that actually freed need the stale-
+            # position wipe like any other free
+            self._freed_dirty.update(self.allocator.free([blk]))
+        return imported
 
     def run(self) -> Dict[str, RequestResult]:
         """Drive :meth:`step` until queue and slots drain. With the real
@@ -781,7 +1019,8 @@ class ServingEngine:
     _OBS_SCALAR_FIELDS = (
         "steps", "completed", "rejected", "preempted", "resubmitted",
         "queue_depth", "tokens_generated", "cow_copies",
-        "prefix_hit_tokens", "prefill_tokens")
+        "prefix_hit_tokens", "prefill_tokens", "migrated_in",
+        "migrated_out", "migrated_tokens")
 
     def _publish_obs(self, step_latency_s: float) -> None:
         """Bridge :class:`EngineStats` into registry gauges and poll the

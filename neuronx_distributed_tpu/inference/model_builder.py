@@ -124,16 +124,31 @@ class ModelBuilder:
                 logger.info("traced %s bucket %d", key, bi)
         return self
 
-    def compile(self) -> "NxDModel":
+    def compile(self, cache: Optional[Any] = None) -> "NxDModel":
         """AOT-compile every artifact; priority models first (reference
         compiles the priority HLO first for WLO — here it simply warms XLA's
-        autotuning/compilation cache for the shared weights)."""
+        autotuning/compilation cache for the shared weights).
+
+        With an :class:`~.aot_cache.AotExecutableCache`, each artifact is
+        keyed on its exported StableHLO module (the true program content
+        — config hashing can't lie) and *loaded* when a previous build of
+        the same program already compiled it; misses, version skew, and
+        corrupt entries fall back to compiling (and repopulate)."""
         order = sorted(self._artifacts.items(),
                        key=lambda kv: not self._entries[kv[0][0]].priority)
         for (key, bi), art in order:
             entry = self._entries[key]
-            art.compiled = jax.jit(entry.fn).lower(*art.bucket).compile()
-            logger.info("compiled %s bucket %d", key, bi)
+            if cache is not None:
+                k = cache.key_for("model-builder",
+                                  art.exported.mlir_module())
+                art.compiled, from_cache = cache.compile_or_load(
+                    k, jax.jit(entry.fn), art.bucket)
+                logger.info("%s %s bucket %d",
+                            "loaded" if from_cache else "compiled",
+                            key, bi)
+            else:
+                art.compiled = jax.jit(entry.fn).lower(*art.bucket).compile()
+                logger.info("compiled %s bucket %d", key, bi)
         return NxDModel(self._artifacts)
 
 

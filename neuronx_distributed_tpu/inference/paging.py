@@ -469,6 +469,61 @@ class PrefixCache:
         self._children.setdefault(chain, set())
         return chain, True
 
+    def snapshot(self, max_nodes: Optional[int] = None
+                 ) -> List[Dict[str, Any]]:
+        """Portable dump of (up to ``max_nodes``) trie nodes for shipping
+        to another replica, hottest subtrees first.
+
+        Chain hashes are process-local (Python ``hash``), so entries name
+        their parent by *list index* instead: each entry is ``{"parent":
+        index-into-this-list | None, "tokens": tuple, "block": local
+        block id}``, and parents always precede their children — the
+        importer replays the list in order, re-deriving its own chain
+        hashes via :meth:`insert`. When truncating, whole root-to-leaf
+        paths survive (a child never ships without its parent), ranked by
+        the subtree's most recent match."""
+        # hotness of a node = newest tick anywhere below it, so a hot
+        # leaf keeps its whole ancestor path ahead of cold siblings
+        hot: Dict[int, int] = {}
+
+        def heat(chain: int) -> int:
+            got = hot.get(chain)
+            if got is None:
+                node = self._nodes[chain]
+                got = max([node.tick] + [heat(c) for c in
+                                         self._children.get(chain, ())])
+                hot[chain] = got
+            return got
+
+        out: List[Dict[str, Any]] = []
+        index: Dict[int, int] = {}
+
+        def walk(parent: Optional[int]) -> None:
+            kids = sorted(self._children.get(parent, ()),
+                          key=heat, reverse=True)
+            for chain in kids:
+                if max_nodes is not None and len(out) >= max_nodes:
+                    return
+                node = self._nodes[chain]
+                index[chain] = len(out)
+                out.append({"parent": index.get(parent),
+                            "tokens": node.tokens, "block": node.block})
+                walk(chain)
+
+        walk(None)
+        return out
+
+    def chain_of(self, parent: Optional[int],
+                 tokens: Sequence[int]) -> Optional[int]:
+        """Chain hash of the live node for ``tokens`` under ``parent``,
+        or None — lets a snapshot importer resolve local chains without
+        re-inserting."""
+        chain = self._hash(parent, tuple(tokens))
+        node = self._nodes.get(chain)
+        if node is None or node.tokens != tuple(tokens):
+            return None
+        return chain
+
     def evict(self, want_free: int) -> List[int]:
         """Drop least-recently-matched *leaf* nodes until ``want_free``
         blocks have actually returned to the pool (a dropped node whose
@@ -499,6 +554,63 @@ class PrefixCache:
         self._children.pop(node.chain, None)
         self._children.get(node.parent, set()).discard(node.chain)
         return self.allocator.free([node.block])
+
+
+# ---------------------------------------------------------------------------
+# Block transport: lift a block set out of one replica's pool / land it in
+# another's. Used by live session migration (router drain/preempt) and by
+# prefix-trie warm-up of fresh replicas. Eager host-side code — migrations
+# happen at step boundaries, never inside the compiled step, and the two
+# pools generally live in different engines (possibly different processes
+# round-tripped through pickle), so there is nothing to fuse.
+# ---------------------------------------------------------------------------
+
+def extract_blocks(cache: Any, blocks: Sequence[int],
+                   keep_upto: int) -> Dict[str, Any]:
+    """Lift ``blocks`` out of the pool as host arrays.
+
+    Rows with stored position ``>= keep_upto`` are masked to
+    ``PAD_POSITION`` in the extracted ``pos`` (same hygiene as
+    :func:`cow_copy_blocks`): a migrating session must not carry another
+    tenant's stale rows, only its own ``n_cached`` tokens. Pass
+    ``keep_upto=PAD_POSITION`` to keep every real row (prefix-trie
+    shipments, where the block is full by construction). The payload is
+    ordered like ``blocks`` and is self-contained — :func:`inject_blocks`
+    lands it at arbitrary block ids in an arbitrary compatible pool."""
+    idx = jnp.asarray(list(blocks), jnp.int32)
+    pos = jnp.take(cache.pos, idx, axis=0)
+    pos = jnp.where(pos < keep_upto, pos, PAD_POSITION)
+    payload = {"k": jnp.take(cache.k, idx, axis=1),
+               "v": jnp.take(cache.v, idx, axis=1),
+               "pos": pos}
+    if isinstance(cache, QuantizedPagedKVCache):
+        payload["k_scale"] = jnp.take(cache.k_scale, idx, axis=1)
+        payload["v_scale"] = jnp.take(cache.v_scale, idx, axis=1)
+    return {name: jax.device_get(arr) for name, arr in payload.items()}
+
+
+def inject_blocks(cache: Any, blocks: Sequence[int],
+                  payload: Dict[str, Any]) -> Any:
+    """Land an :func:`extract_blocks` payload at ``blocks`` (same order,
+    freshly allocated by the destination). Every row of the target
+    blocks — K, V, and positions — is overwritten by the payload, so the
+    destination needs no freed-position wipe for them."""
+    if len(blocks) != payload["pos"].shape[0]:
+        raise ValueError(
+            f"payload carries {payload['pos'].shape[0]} block(s) but "
+            f"{len(blocks)} destination ids were given")
+    idx = jnp.asarray(list(blocks), jnp.int32)
+    updates = dict(
+        k=cache.k.at[:, idx].set(jnp.asarray(payload["k"], cache.k.dtype)),
+        v=cache.v.at[:, idx].set(jnp.asarray(payload["v"], cache.v.dtype)),
+        pos=cache.pos.at[idx].set(jnp.asarray(payload["pos"], jnp.int32)))
+    if isinstance(cache, QuantizedPagedKVCache):
+        updates.update(
+            k_scale=cache.k_scale.at[:, idx].set(
+                jnp.asarray(payload["k_scale"], jnp.float32)),
+            v_scale=cache.v_scale.at[:, idx].set(
+                jnp.asarray(payload["v_scale"], jnp.float32)))
+    return cache.replace(**updates)
 
 
 # ---------------------------------------------------------------------------

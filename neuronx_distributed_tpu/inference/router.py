@@ -41,11 +41,27 @@ owns the request lifecycle end to end:
   :class:`ServingPreempted` exits with code 75 so the orchestrator
   reschedules rather than retries.
 
+* **elasticity** — an :class:`~.aot_cache.AotExecutableCache` shared by
+  the fleet makes every replica after the first spin up by *loading* its
+  compiled step (probation revivals included — no recompile, no cold
+  trie when ``warm_prefix_blocks`` ships trie subtrees to the newcomer);
+  a :class:`ScalePolicy` watches the obs signals (queue depth, TTFT p99,
+  pool occupancy) with hysteresis + cooldown and grows/shrinks the fleet
+  through :meth:`ReplicaRouter.scale_up` / ``scale_down``; retiring or
+  preempted replicas *drain by migration* — each live session's KV
+  blocks and scheduler state ship to a survivor
+  (:meth:`~.engine.ServingEngine.export_session` →
+  ``import_session``), so zero tokens re-prefill and greedy outputs
+  stay bit-identical across the move.
+
 Chaos drills inject faults through :meth:`FaultPlan.consult` with
 ``op="step"`` and ``path=<replica name>`` — the plan *returns* directives
-(``crash`` / ``exhaust`` / latency seconds) instead of raising/sleeping,
-so injected latency is virtual and drills are deterministic under fake
-clocks. See :func:`chaos_drill` and ``bench.py --router``.
+(``crash`` / ``exhaust`` / ``preempt`` / latency seconds) instead of
+raising/sleeping, so injected latency is virtual and drills are
+deterministic under fake clocks; the fleet-level tick consults
+``op="scale"``, ``path="fleet"`` for ``scale_burst`` directives. See
+:func:`chaos_drill`, :func:`elastic_chaos_drill` and ``bench.py
+--router`` / ``--elastic``.
 """
 
 from __future__ import annotations
@@ -58,11 +74,13 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.events import emit_event
 from ..obs.metrics import get_registry
 from ..obs.tracing import get_tracer
 from ..resilience.chaos import FaultPlan
 from ..resilience.preemption import EXIT_PREEMPTED, PreemptionGuard
 from ..resilience.watchdog import SpikeDetector, StallTimer
+from .aot_cache import AotExecutableCache
 from .engine import (EngineConfig, RequestRejected, ServingEngine)
 from .paging import CacheExhaustedError
 
@@ -93,6 +111,32 @@ class TenantPolicy:
     rate_tokens_per_s: float = math.inf
     burst_tokens: float = math.inf
     priority: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalePolicy:
+    """Obs-driven autoscaling policy.
+
+    Each router step the fleet's load signals — mean live queue depth
+    (pending + per-replica), TTFT p99 (from the
+    ``nxd_router_ttft_seconds`` histogram when obs is enabled, recent
+    completions otherwise), and worst pool occupancy — are compared
+    against the thresholds. A *hot* signal must persist for
+    ``hysteresis_steps`` consecutive steps before a scale-up (spikes
+    don't flap the fleet), likewise *cold* for scale-down; any scale
+    action then freezes the policy for ``cooldown_steps`` so the fleet
+    settles before the next decision. ``ttft_p99_high_s`` defaults to
+    never-trips — wall-clock TTFT is noisy on CPU test rigs, so queue
+    depth and occupancy are the default drivers."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    queue_high: float = 8.0         # mean live requests per replica
+    queue_low: float = 1.0
+    ttft_p99_high_s: float = math.inf
+    occupancy_high: float = 0.85    # worst replica's pool occupancy
+    hysteresis_steps: int = 3
+    cooldown_steps: int = 8
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,6 +177,12 @@ class RouterConfig:
     exhaust_threshold: int = 3
     probation_steps: int = 8        # router steps a tripped replica sits out
     probation_ok_steps: int = 4     # clean steps to go probation -> up
+    # elasticity: None = fixed fleet (scale_up/scale_down stay manual);
+    # a ScalePolicy turns on the obs-driven autoscale tick
+    scale: Optional[ScalePolicy] = None
+    # trie subtrees shipped to a fresh/revived replica from the hottest
+    # surviving trie (0 = off; needs EngineConfig.prefix_sharing)
+    warm_prefix_blocks: int = 0
 
 
 @dataclasses.dataclass
@@ -163,6 +213,12 @@ class RouterStats:
     resubmitted_tokens: int = 0     # re-done work: re-prefilled + discarded
     revivals: int = 0
     steps: int = 0
+    scale_ups: int = 0              # replicas added (policy or manual)
+    scale_downs: int = 0            # replicas retired by migration
+    preemptions: int = 0            # SIGTERM-style drains (chaos preempt)
+    migrated_sessions: int = 0      # live sessions shipped to a survivor
+    migrated_tokens: int = 0        # cached tokens moved without re-prefill
+    reprefilled_tokens: int = 0     # migration fallbacks that re-prefilled
     ttft_s: List[float] = dataclasses.field(default_factory=list)
 
     def availability(self) -> float:
@@ -184,6 +240,12 @@ class RouterStats:
             "resubmitted_tokens": self.resubmitted_tokens,
             "revivals": self.revivals,
             "steps": self.steps,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "preemptions": self.preemptions,
+            "migrated_sessions": self.migrated_sessions,
+            "migrated_tokens": self.migrated_tokens,
+            "reprefilled_tokens": self.reprefilled_tokens,
             "rejected_by_reason": dict(self.rejected_by_reason),
             "tenant_shed": dict(self.tenant_shed),
             "ttft_p50_ms": float(np.percentile(ttft, 50)) * 1e3,
@@ -249,8 +311,9 @@ class _Replica:
     state: str = "up"               # "up" | "probation" | "down"
     down_steps: int = 0             # steps left before revival
     ok_steps: int = 0               # clean steps while in probation
-    assigned: Dict[str, _RouterRequest] = dataclasses.field(
-        default_factory=dict)
+    generation: int = 0             # bumped per engine replacement, so
+    assigned: Dict[str, _RouterRequest] = dataclasses.field(  # obs series
+        default_factory=dict)       # from before a revival stay distinct
 
     @property
     def live(self) -> bool:
@@ -271,7 +334,8 @@ class ReplicaRouter:
                  engines: Optional[Sequence[ServingEngine]] = None,
                  clock: Optional[Callable[[], float]] = None,
                  preemption_guard: Optional[PreemptionGuard] = None,
-                 chaos: Optional[FaultPlan] = None):
+                 chaos: Optional[FaultPlan] = None,
+                 aot_cache: Optional[AotExecutableCache] = None):
         self.model_cfg = model_cfg
         self.params = params
         self.ecfg = engine_cfg
@@ -292,6 +356,16 @@ class ReplicaRouter:
         # aggregate prefix stats survive failover
         self._eng_acc = {"prefix_hit_tokens": 0, "prefill_tokens": 0,
                          "cow_copies": 0}
+        # one executable cache for the whole fleet: replica 0 compiles
+        # each worker shape once, every later construction — scale-up,
+        # probation revival — loads (memory-only by default; hand in a
+        # disk-backed cache to survive process restarts)
+        self._aot = aot_cache if aot_cache is not None \
+            else AotExecutableCache()
+        # autoscale state (see ScalePolicy)
+        self._scale_cooldown = 0
+        self._scale_up_streak = 0
+        self._scale_down_streak = 0
         if cfg.placement not in ("jsq", "prefix"):
             raise ValueError(
                 f"unknown placement {cfg.placement!r}: want 'jsq' or "
@@ -303,18 +377,28 @@ class ReplicaRouter:
                     f"num_replicas={cfg.num_replicas}")
             engines = list(engines)
         else:
-            engines = [self._new_engine() for _ in range(cfg.num_replicas)]
+            engines = [self._new_engine(f"r{i}")
+                       for i in range(cfg.num_replicas)]
         self.replicas = [
             _Replica(name=f"r{i}", engine=eng, monitor=ReplicaMonitor(cfg))
             for i, eng in enumerate(engines)]
-        pool_tokens = engine_cfg.num_blocks * engine_cfg.block_size
-        self._budget = (cfg.global_token_budget
-                        if cfg.global_token_budget is not None
-                        else cfg.num_replicas * pool_tokens)
+        self._replica_seq = cfg.num_replicas  # next fresh replica name
+        self._recompute_budget()
 
-    def _new_engine(self) -> ServingEngine:
+    def _new_engine(self, name: Optional[str] = None) -> ServingEngine:
         return ServingEngine(self.model_cfg, self.params, self.ecfg,
-                             clock=self._clock)
+                             clock=self._clock, aot_cache=self._aot,
+                             name=name)
+
+    def _recompute_budget(self) -> None:
+        """Global committed-token budget tracks fleet size unless pinned
+        by ``global_token_budget`` — an elastic fleet's capacity is not a
+        constant."""
+        if self.cfg.global_token_budget is not None:
+            self._budget = self.cfg.global_token_budget
+        else:
+            pool_tokens = self.ecfg.num_blocks * self.ecfg.block_size
+            self._budget = max(1, len(self.replicas)) * pool_tokens
 
     # -- time / introspection ---------------------------------------------
 
@@ -553,10 +637,7 @@ class ReplicaRouter:
                     pass  # completed this very step; collected below
             self._requeue(req, None, lost_generated=lost)
         rep.assigned.clear()
-        if req_sessions := [s for s, n in self._sessions.items()
-                            if n == rep.name]:
-            for s in req_sessions:
-                del self._sessions[s]
+        self._drop_sessions_for(rep)
         rep.state = "down"
         rep.down_steps = self.cfg.probation_steps
         rep.ok_steps = 0
@@ -566,6 +647,12 @@ class ReplicaRouter:
             rep.engine = None  # crashed: the instance is gone
         rep.monitor = ReplicaMonitor(self.cfg)
 
+    def _drop_sessions_for(self, rep: _Replica) -> None:
+        """Forget session→replica pins pointing at ``rep`` (migrated
+        sessions were already re-pointed at their destination)."""
+        for s in [s for s, n in self._sessions.items() if n == rep.name]:
+            del self._sessions[s]
+
     def _tick_revivals(self) -> None:
         for rep in self.replicas:
             if rep.state != "down":
@@ -574,7 +661,14 @@ class ReplicaRouter:
             if rep.down_steps > 0:
                 continue
             if rep.engine is None:
-                rep.engine = self._new_engine()
+                # revive through the fleet's AOT cache: the replacement
+                # engine *loads* its compiled step (no recompile), gets a
+                # bumped generation so its obs series don't alias the
+                # dead engine's, and warm-starts its prefix trie from
+                # the hottest survivor instead of coming back cold
+                rep.engine = self._new_engine(rep.name)
+                rep.generation += 1
+                self._warm_prefix(rep)
             rep.state = "probation"
             rep.ok_steps = 0
             self.stats.revivals += 1
@@ -584,6 +678,190 @@ class ReplicaRouter:
                             "Replicas revived into probation.",
                             labels=("replica",)).labels(
                                 replica=rep.name).inc()
+
+    # -- elasticity --------------------------------------------------------
+
+    def _warm_prefix(self, rep: _Replica) -> None:
+        """Ship up to ``warm_prefix_blocks`` hottest trie subtrees from
+        the best-stocked survivor into a fresh/revived replica, KV blocks
+        included — the newcomer serves prefix hits from its first step."""
+        k = self.cfg.warm_prefix_blocks
+        if not k or rep.engine is None:
+            return
+        donors = [r for r in self.live_replicas()
+                  if r is not rep and r.engine.prefix_cache is not None
+                  and r.engine.prefix_cache.size > 0]
+        if not donors:
+            return
+        donor = max(donors, key=lambda r: r.engine.prefix_cache.size)
+        n = rep.engine.import_prefixes(donor.engine.export_prefixes(k))
+        if n:
+            emit_event("router_prefix_warm", replica=rep.name,
+                       donor=donor.name, nodes=n)
+
+    def _migrate_sessions(self, rep: _Replica, why: str) -> int:
+        """Drain ``rep`` by *shipping* each live session — KV blocks and
+        scheduler state — to a survivor (most free pool blocks first), so
+        nothing re-prefills and greedy outputs continue bit-identically.
+        A session no survivor can host falls back to the failover path
+        (resubmit-from-prompt), accounted in ``reprefilled_tokens``."""
+        if rep.engine is None or not rep.assigned:
+            return 0
+        self._collect(rep)  # completions are results, not migrations
+        moved = 0
+        for uid, req in list(rep.assigned.items()):
+            del rep.assigned[uid]
+            try:
+                ticket = rep.engine.export_session(uid)
+            except KeyError:
+                self._requeue(req, None, lost_generated=0)
+                continue
+            dest = None
+            for cand in sorted(
+                    (r for r in self.live_replicas() if r is not rep),
+                    key=lambda r: -r.engine.pool_free_blocks()):
+                try:
+                    cand.engine.import_session(ticket)
+                    dest = cand
+                    break
+                except (RequestRejected, CacheExhaustedError):
+                    continue
+            if dest is not None:
+                dest.assigned[uid] = req
+                if req.session:
+                    self._sessions[req.session] = dest.name
+                self.stats.migrated_sessions += 1
+                self.stats.migrated_tokens += ticket.n_cached
+                moved += 1
+            else:
+                self.stats.reprefilled_tokens += min(
+                    ticket.n_cached, len(ticket.prompt))
+                self._requeue(req, None,
+                              lost_generated=len(ticket.generated))
+        if moved:
+            emit_event("router_sessions_migrated", replica=rep.name,
+                       reason=why, sessions=moved)
+        return moved
+
+    def _preempt_replica(self, rep: _Replica) -> None:
+        """A SIGTERM-style eviction notice (chaos ``preempt``): unlike a
+        crash, the drain window lets every live session migrate out
+        before the engine goes away; the replica then sits out the usual
+        probation window and revives through the AOT cache."""
+        self.stats.preemptions += 1
+        self._migrate_sessions(rep, "preempt")
+        rep.assigned.clear()
+        self._drop_sessions_for(rep)
+        if rep.engine is not None:
+            self._absorb_engine_stats(rep.engine)
+        rep.engine = None
+        rep.state = "down"
+        rep.down_steps = self.cfg.probation_steps
+        rep.ok_steps = 0
+        rep.monitor = ReplicaMonitor(self.cfg)
+        emit_event("router_preempt", replica=rep.name)
+
+    def scale_up(self, why: str = "manual") -> Optional[str]:
+        """Add a replica (warm-started from the AOT cache and, when
+        enabled, a shipped prefix trie). Returns its name, or None at
+        the policy's ``max_replicas`` cap."""
+        pol = self.cfg.scale
+        if pol is not None and len(self.live_replicas()) >= \
+                pol.max_replicas:
+            return None
+        name = f"r{self._replica_seq}"
+        self._replica_seq += 1
+        rep = _Replica(name=name, engine=self._new_engine(name),
+                       monitor=ReplicaMonitor(self.cfg))
+        self.replicas.append(rep)
+        self._recompute_budget()
+        self.stats.scale_ups += 1
+        self._scale_cooldown = pol.cooldown_steps if pol else 0
+        self._scale_up_streak = self._scale_down_streak = 0
+        self._warm_prefix(rep)
+        emit_event("router_scale_up", replica=name, reason=why,
+                   fleet=len(self.live_replicas()),
+                   warm=rep.engine.aot_warm())
+        return name
+
+    def scale_down(self, why: str = "manual") -> Optional[str]:
+        """Gracefully retire one replica — fewest live sessions, newest
+        on ties — migrating its sessions to survivors. Returns the
+        retired name, or None at the ``min_replicas`` floor."""
+        live = self.live_replicas()
+        floor = self.cfg.scale.min_replicas if self.cfg.scale else 1
+        if len(live) <= max(1, floor):
+            return None
+        victim = min(reversed(live), key=lambda r: len(r.assigned))
+        self._collect(victim)
+        self._migrate_sessions(victim, why)
+        self._drop_sessions_for(victim)
+        if victim.engine is not None:
+            self._absorb_engine_stats(victim.engine)
+        self.replicas.remove(victim)
+        self._recompute_budget()
+        self.stats.scale_downs += 1
+        pol = self.cfg.scale
+        self._scale_cooldown = pol.cooldown_steps if pol else 0
+        self._scale_up_streak = self._scale_down_streak = 0
+        emit_event("router_scale_down", replica=victim.name, reason=why,
+                   fleet=len(self.live_replicas()))
+        return victim.name
+
+    def _ttft_p99(self) -> float:
+        """TTFT p99 in seconds — from the obs histogram when enabled,
+        else the recent completions window; 0.0 with no signal yet."""
+        reg = get_registry()
+        if reg.enabled:
+            h = reg.get("nxd_router_ttft_seconds")
+            if h is not None:
+                q = h.quantile(0.99)
+                if not math.isnan(q):
+                    return float(q)
+        if self.stats.ttft_s:
+            return float(np.percentile(
+                np.asarray(self.stats.ttft_s[-64:]), 99))
+        return 0.0
+
+    def _tick_autoscale(self) -> None:
+        """One :class:`ScalePolicy` decision: compare the fleet's load
+        signals against the thresholds, require ``hysteresis_steps`` of
+        agreement, respect the cooldown. No-op without a policy or while
+        draining (a draining fleet must only shrink by completion)."""
+        pol = self.cfg.scale
+        if pol is None or self._draining:
+            return
+        if self._scale_cooldown > 0:
+            self._scale_cooldown -= 1
+            return
+        live = self.live_replicas()
+        if not live:
+            return
+        queue = (len(self._pending) + sum(
+            r.engine.queue_depth() for r in live)) / len(live)
+        occupancy = max(
+            1.0 - r.engine.pool_free_blocks()
+            / max(1, r.engine.allocator.num_blocks) for r in live)
+        ttft = self._ttft_p99()
+        hot = (queue >= pol.queue_high or occupancy >= pol.occupancy_high
+               or ttft >= pol.ttft_p99_high_s)
+        cold = (queue <= pol.queue_low
+                and occupancy < pol.occupancy_high
+                and ttft < pol.ttft_p99_high_s)
+        if hot:
+            self._scale_up_streak += 1
+            self._scale_down_streak = 0
+            if self._scale_up_streak >= pol.hysteresis_steps:
+                self.scale_up(f"obs:queue={queue:.1f}"
+                              f",occ={occupancy:.2f},ttft={ttft:.3f}")
+        elif cold:
+            self._scale_down_streak += 1
+            self._scale_up_streak = 0
+            if self._scale_down_streak >= pol.hysteresis_steps:
+                self.scale_down(f"obs:queue={queue:.1f}"
+                                f",occ={occupancy:.2f}")
+        else:
+            self._scale_up_streak = self._scale_down_streak = 0
 
     # -- stats -------------------------------------------------------------
 
@@ -636,6 +914,13 @@ class ReplicaRouter:
             if res.ttft_s is not None and req.placed_at is not None:
                 ttft = (req.placed_at - req.arrival_time) + res.ttft_s
                 self.stats.ttft_s.append(ttft)
+                reg = get_registry()
+                if reg.enabled:
+                    reg.histogram(
+                        "nxd_router_ttft_seconds",
+                        "End-to-end TTFT (router arrival to first "
+                        "token) — the autoscaler's latency signal."
+                    ).observe(ttft)
             self.results[uid] = RouterResult(
                 uid=uid, tenant=req.tenant, status="completed",
                 tokens=list(res.tokens), replica=rep.name,
@@ -650,9 +935,13 @@ class ReplicaRouter:
         if self._guard is not None and self._guard.requested:
             self._draining = True
         self._tick_revivals()
+        if self._chaos is not None and not self._draining:
+            burst, _ = self._chaos.consult("scale", "fleet")
+            if burst == "scale_burst":
+                self.scale_up("chaos_burst")
         with get_tracer().span("router/place"):
             activity = self._place_pending()
-        for rep in self.replicas:
+        for rep in list(self.replicas):
             if not rep.live or not rep.assigned:
                 continue
             directive, extra_latency = (
@@ -660,6 +949,9 @@ class ReplicaRouter:
                 if self._chaos is not None else (None, 0.0))
             if directive == "crash":
                 self._fail_replica(rep, "crash", engine_alive=False)
+                continue
+            if directive == "preempt":
+                self._preempt_replica(rep)
                 continue
             exhausted = directive == "exhaust"
             rows = 0
@@ -682,6 +974,7 @@ class ReplicaRouter:
                 rep.ok_steps += 1
                 if rep.ok_steps >= self.cfg.probation_ok_steps:
                     rep.state = "up"
+        self._tick_autoscale()
         self.stats.steps += 1
         self._publish_obs()
         return activity
@@ -710,6 +1003,22 @@ class ReplicaRouter:
                 gauges.labels(field=k).set(float(v))
         reg.gauge("nxd_router_pending",
                   "Requests waiting for placement.").set(len(self._pending))
+        reg.gauge("nxd_router_fleet_size",
+                  "Live replicas (elastic fleet).").set(
+                      len(self.live_replicas()))
+        eng_g = reg.gauge(
+            "nxd_router_replica_engine",
+            "Per-replica engine signals, keyed by revival generation so "
+            "series from a replaced engine never alias its predecessor's.",
+            labels=("replica", "generation", "field"))
+        for rep in self.live_replicas():
+            gen = str(rep.generation)
+            eng_g.labels(replica=rep.name, generation=gen,
+                         field="queue_depth").set(
+                             rep.engine.queue_depth())
+            eng_g.labels(replica=rep.name, generation=gen,
+                         field="pool_free_blocks").set(
+                             rep.engine.pool_free_blocks())
 
     def run(self) -> Dict[str, RouterResult]:
         """Drive :meth:`step` until every admitted request resolves.
@@ -781,4 +1090,127 @@ def chaos_drill(model_cfg, params, engine_cfg: EngineConfig,
         "router_admitted": d["admitted"],
         "router_ttft_p99_ms_chaos": d["ttft_p99_ms"],
         "router_greedy_match_ref": float(matches),
+    }
+
+
+def elastic_chaos_drill(model_cfg, params, engine_cfg: EngineConfig,
+                        *, n_requests: int = 8, prompt_len: int = 8,
+                        max_new_tokens: int = 4,
+                        clock: Optional[Callable[[], float]] = None,
+                        seed: int = 0,
+                        cache_dir: Optional[str] = None,
+                        scale_down_step: int = 8) -> Dict[str, Any]:
+    """Deterministic elastic-fleet drill: the full scale cycle under
+    ragged-Poisson load (tests and ``bench.py --elastic``).
+
+    Sequence: measure replica spin-up cold (first build populates the
+    shared AOT cache) vs warm (second build loads), run the request set
+    fault-free on one replica for reference, then run it on a 2-replica
+    elastic fleet where chaos preempts ``r1`` mid-flight (sessions
+    migrate out), a ``scale_burst`` directive forces a scale-up, a
+    scripted ``scale_down`` retires a replica by migration, and the
+    preempted replica revives through the cache. Reports availability,
+    migration vs re-prefill token accounting, cold/warm spin-up times,
+    compile counts, and bit-identity of every completed output against
+    the fault-free reference."""
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, model_cfg.vocab_size,
+                           (prompt_len,)).tolist()
+               for _ in range(n_requests)]
+    arrivals = np.cumsum(rng.exponential(0.02, n_requests))
+    aot = AotExecutableCache(cache_dir)
+
+    t0 = time.perf_counter()
+    ServingEngine(model_cfg, params, engine_cfg, clock=clock,
+                  aot_cache=aot, name="cold-probe")
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    # a disk-backed cache is probed through a *fresh* instance so the
+    # warm number measures deserialize-from-disk, not the mem layer
+    warm_cache = AotExecutableCache(cache_dir) if cache_dir else aot
+    t0 = time.perf_counter()
+    warm_probe = ServingEngine(model_cfg, params, engine_cfg,
+                               clock=clock, aot_cache=warm_cache,
+                               name="warm-probe")
+    warm_ms = (time.perf_counter() - t0) * 1e3
+    warm_loaded = warm_probe.aot_warm()
+    del warm_probe
+
+    def _submit_all(router: ReplicaRouter) -> None:
+        for i, (p, at) in enumerate(zip(prompts, arrivals)):
+            router.submit(p, max_new_tokens, uid=f"req{i}",
+                          arrival_time=float(at))
+
+    # pin the admission budget to the drill's total demand so admission
+    # is identical between the 1-replica reference and the elastic fleet
+    # (the drill measures migration/scaling, not shedding)
+    budget = n_requests * (prompt_len + max_new_tokens)
+    ref = ReplicaRouter(model_cfg, params, engine_cfg,
+                        RouterConfig(num_replicas=1,
+                                     global_token_budget=budget),
+                        clock=clock, aot_cache=aot)
+    _submit_all(ref)
+    ref_results = ref.run()
+
+    plan = FaultPlan.parse(
+        "step|r1 : preempt, after=2, times=1 ; "
+        "scale|fleet : scale_burst, after=5, times=1")
+    router = ReplicaRouter(
+        model_cfg, params, engine_cfg,
+        RouterConfig(num_replicas=2, global_token_budget=budget,
+                     scale=ScalePolicy(min_replicas=1, max_replicas=3)),
+        clock=clock, chaos=plan, aot_cache=aot)
+    _submit_all(router)
+    scaled_down = False
+    while router.has_work():
+        stepped = router.step()
+        if router._clock is not time.monotonic and stepped:
+            # a fake clock freezes wall time, but a real step is not
+            # free — charge a nominal virtual latency so later arrivals
+            # land *while* earlier requests are in flight (the load
+            # shape the chaos rules and autoscaler react to)
+            router._t0 -= 0.05
+        if (not scaled_down and router.stats.steps >= scale_down_step
+                and len(router.live_replicas()) >= 2):
+            router.scale_down("drill")
+            scaled_down = True
+        if stepped == 0 and router.has_work():
+            gaps = [max(r.arrival_time, r.next_try) - router._now()
+                    for r in router._pending]
+            gap = min(gaps) if gaps else 0.0
+            if gap > 0:
+                if router._clock is not time.monotonic:
+                    router._t0 -= gap  # fake clock: fast-forward
+                else:
+                    time.sleep(min(gap, 0.05))
+    results = router.results
+
+    completed = [r for r in results.values() if r.status == "completed"]
+    matches = all(
+        results[uid].tokens == ref_results[uid].tokens
+        for uid in ref_results
+        if results.get(uid) is not None
+        and results[uid].status == "completed")
+    compile_counts = [rep.engine.compile_count()
+                      for rep in router.replicas
+                      if rep.engine is not None]
+    d = router.stats.to_dict()
+    return {
+        "elastic_availability": d["availability"],
+        "elastic_greedy_match_ref": float(matches),
+        "elastic_completed": len(completed),
+        "elastic_admitted": d["admitted"],
+        "elastic_preemptions": d["preemptions"],
+        "elastic_scale_ups": d["scale_ups"],
+        "elastic_scale_downs": d["scale_downs"],
+        "elastic_revivals": d["revivals"],
+        "migrated_sessions": d["migrated_sessions"],
+        "migrated_tokens": d["migrated_tokens"],
+        "reprefilled_tokens": d["reprefilled_tokens"],
+        "bundle_cold_start_ms": cold_ms,
+        "bundle_cold_start_warm_ms": warm_ms,
+        "bundle_cold_start_speedup": cold_ms / max(warm_ms, 1e-9),
+        "aot_warm_loaded": float(warm_loaded),
+        "aot_cache_hits": aot.hits,
+        "aot_cache_misses": aot.misses,
+        "max_compile_count": max(compile_counts, default=0),
     }

@@ -17,9 +17,9 @@ See docs/planner.md.
 """
 
 from .cost import (CostBreakdown, HardwareSpec, LinkSpec, ModelSpec, Plan,
-                   ServingSpec, default_hardware, memory_bytes, param_count,
-                   step_cost, step_flops, tp_overlap_engagement,
-                   wire_bytes_per_element)
+                   ServingSpec, cold_start_s, default_hardware,
+                   memory_bytes, param_count, step_cost, step_flops,
+                   tp_overlap_engagement, wire_bytes_per_element)
 from .emit import (plan_to_config, plan_to_config_kwargs, plan_to_yaml_dict,
                    render_kwargs)
 from .refine import RefinedPlan, proxy_measure, refine
@@ -48,8 +48,8 @@ def handpicked_plan(devices: int, *, platform: str = "cpu",
 
 __all__ = [
     "CostBreakdown", "HardwareSpec", "LinkSpec", "ModelSpec", "Plan",
-    "ServingSpec", "default_hardware", "memory_bytes", "param_count",
-    "step_cost", "step_flops", "tp_overlap_engagement",
+    "ServingSpec", "cold_start_s", "default_hardware", "memory_bytes",
+    "param_count", "step_cost", "step_flops", "tp_overlap_engagement",
     "wire_bytes_per_element",
     "plan_to_config", "plan_to_config_kwargs", "plan_to_yaml_dict",
     "render_kwargs",

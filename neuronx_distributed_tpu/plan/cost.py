@@ -32,6 +32,7 @@ delegate to ``ops.collective_matmul.shapes_tile`` (lazily) and mirror
 from __future__ import annotations
 
 import dataclasses
+import math
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -489,3 +490,49 @@ def step_cost(plan: Plan, m: ModelSpec, hw: HardwareSpec,
         ep_comm_s=ep_comm_s(plan, m, hw),
         grad_comm_s=grad_comm_s(plan, m, hw),
         memory=memory_bytes(plan, m, hw, serving))
+
+
+# ---------------------------------------------------------------------------
+# Replica cold start (serving elasticity)
+# ---------------------------------------------------------------------------
+
+#: XLA compile-time model for one serving step program: a flat front-end
+#: cost plus a per-layer slope. Absolute numbers are calibrated loosely to
+#: observed neuron/XLA compiles; like the step terms, only the *ratios*
+#: drive decisions (cached vs uncached, deeper vs shallower stages).
+COMPILE_BASE_S = 18.0
+COMPILE_PER_LAYER_S = 3.0
+#: AOT path: flat deserialize/link overhead for a cached executable.
+AOT_LOAD_BASE_S = 0.4
+#: serialized-executable size per stage-layer (constants folded out —
+#: the bundle ships program text, not weights).
+AOT_BYTES_PER_LAYER = 4 * 2**20
+
+
+def cold_start_s(plan: Plan, m: ModelSpec, hw: HardwareSpec,
+                 aot_cached: bool = True) -> float:
+    """Seconds to bring one serving replica from process start to its
+    first schedulable step (``docs/serving.md`` "Elastic fleet").
+
+    Two regimes:
+
+    * **uncached** — XLA compiles the stage program from scratch: a flat
+      front-end cost plus a per-layer slope over this stage's
+      ``num_layers / pp`` layers (TP shards the tensors, not the program
+      node count, so it does not shrink compile time).
+    * **aot_cached** — the replica *loads* a serialized executable from
+      the fleet's AOT cache: a flat deserialize cost plus the bundle's
+      bytes over the DCN tier (cache reads cross hosts).
+
+    Either way the weight shard must arrive over DCN. The autoscaler uses
+    the ratio to decide how far ahead of a load spike it must act; a
+    cache hit turns minutes into (milli)seconds, which is why the router
+    refuses to build engines outside the cache (nxdlint ``elasticity``).
+    """
+    stage_layers = max(1, math.ceil(m.layers / plan.pp))
+    weight_shard = param_count(m) * m.act_bytes / (plan.tp * plan.pp)
+    fetch_s = weight_shard / hw.dcn.bandwidth
+    if aot_cached:
+        bundle = AOT_BYTES_PER_LAYER * stage_layers
+        return AOT_LOAD_BASE_S + bundle / hw.dcn.bandwidth + fetch_s
+    return COMPILE_BASE_S + COMPILE_PER_LAYER_S * stage_layers + fetch_s

@@ -24,6 +24,13 @@ name):
   gone and every in-flight request on it must fail over.
 * ``exhaust`` — a KV block-pool exhaustion storm signal (raised as
   ``CacheExhaustedError`` through :meth:`FaultPlan.apply`).
+* ``preempt`` — a SIGTERM-style eviction notice mid-flight (spot/
+  maintenance): unlike ``crash``, the replica gets a drain window, so the
+  router *migrates* its live sessions instead of failing them over.
+  Consult-only — :meth:`FaultPlan.apply` treats it as a no-op directive.
+* ``scale_burst`` — a fleet-level load-spike signal (matched against the
+  router's ``consult("scale", "fleet")`` tick) directing an immediate
+  scale-up; also consult-only.
 
 The router consults the plan through :meth:`FaultPlan.consult`, which
 *returns* the directive instead of raising/sleeping, so injected latency is
@@ -79,12 +86,13 @@ class FaultRule:
     op: str = "*"
     path: str = "*"
     kind: str = "transient"  # transient|permanent|latency|crash|exhaust
-    prob: float = 1.0
+    prob: float = 1.0        # |preempt|scale_burst
     after: int = 0
     times: int = -1
     latency_s: float = 0.0
 
-    _KINDS = ("transient", "permanent", "latency", "crash", "exhaust")
+    _KINDS = ("transient", "permanent", "latency", "crash", "exhaust",
+              "preempt", "scale_burst")
 
     def __post_init__(self) -> None:
         if self.kind not in self._KINDS:
@@ -222,6 +230,9 @@ class FaultPlan:
 
             raise CacheExhaustedError(
                 f"chaos: injected pool-exhaustion storm on {op}({path!r})")
+        # preempt / scale_burst are consult-only directives: they model
+        # orchestrator signals (eviction notice, load spike), not storage
+        # failures, so apply() has nothing to raise for them.
 
 
 class ChaosCheckpointStorage(BaseCheckpointStorage):

@@ -1,0 +1,34 @@
+"""Fixture: replica spin-up that bypasses the AOT executable cache.
+
+A ``ServingEngine`` built without ``aot_cache=`` cold-compiles on every
+scale-up/revival, and a raw ``.lower().compile()`` chain produces an
+executable the cache never sees — both reintroduce compile-on-scale and
+quietly regress the fleet's cold-start SLO from milliseconds to minutes.
+"""
+import jax
+
+from .engine import ServingEngine
+
+
+def spin_up_replica(model_cfg, params, engine_cfg, name):
+    # cold-compiles on every spin-up: no aot_cache= kwarg
+    return ServingEngine(model_cfg, params, engine_cfg, name=name)
+
+
+def compile_step(step_fn, example_args):
+    # invisible to the cache: never serialized for the next replica
+    return jax.jit(step_fn).lower(*example_args).compile()
+
+
+def fine_cached_spin_up(model_cfg, params, engine_cfg, cache, name):
+    # the cache-aware forms do NOT fire
+    engine = ServingEngine(model_cfg, params, engine_cfg,
+                           aot_cache=cache, name=name)
+    compiled, _ = cache.compile_or_load(
+        cache.key_for("fixture", name), jax.jit(lambda x: x), ())
+    return engine, compiled
+
+
+def fine_explicit_opt_out(model_cfg, params, engine_cfg):
+    # an explicit aot_cache=None is a deliberate, visible choice
+    return ServingEngine(model_cfg, params, engine_cfg, aot_cache=None)

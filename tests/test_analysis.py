@@ -244,6 +244,37 @@ def test_serving_resilience_scoped_to_inference_paths():
     assert [f.rule for f in flagged] == ["serving-resilience"]
 
 
+def test_elasticity_fires_on_fixture():
+    fs = _lint(os.path.join("inference", "bad_elasticity.py"))
+    assert _rules(fs) == {"elasticity"}
+    msgs = " | ".join(f.message for f in fs if not f.suppressed)
+    assert "without `aot_cache=`" in msgs
+    assert ".lower(...).compile(...)" in msgs
+    # the cache-aware and explicit-opt-out forms stay quiet
+    assert not any(f.line > 22 for f in fs if not f.suppressed)
+
+
+def test_elasticity_scoped_and_exempts_cache_module():
+    src = ("def boot(cfg, params, ecfg):\n"
+           "    return ServingEngine(cfg, params, ecfg)\n")
+    # outside inference/ an uncached engine is not this rule's business...
+    assert analyze_source(src, "mymodel/examples/demo.py",
+                          axes=DEFAULT_AXES) == []
+    # ...inside it fires
+    flagged = analyze_source(src, "mymodel/inference/boot.py",
+                             axes=DEFAULT_AXES)
+    assert [f.rule for f in flagged] == ["elasticity"]
+    # the sanctioned compile sites are exempt by filename
+    chain = "compiled = jitted.lower(*args).compile()\n"
+    assert analyze_source(chain, "mymodel/inference/aot_cache.py",
+                          axes=DEFAULT_AXES) == []
+    assert analyze_source(chain, "mymodel/inference/model_builder.py",
+                          axes=DEFAULT_AXES) == []
+    assert [f.rule for f in analyze_source(
+        chain, "mymodel/inference/engine.py",
+        axes=DEFAULT_AXES)] == ["elasticity"]
+
+
 def test_paging_refcount_fires_on_fixture():
     fs = _lint(os.path.join("inference", "bad_refcount_bypass.py"))
     assert _rules(fs) == {"paging-refcount"}
@@ -406,7 +437,7 @@ def test_cli_nonzero_on_fixture_corpus():
                          "recompile-hazard", "resilience",
                          "comm-compression", "tp-overlap",
                          "serving-resilience", "paging-refcount", "plan",
-                         "observability"}
+                         "observability", "elasticity"}
 
 
 def test_cli_zero_on_clean_file():

@@ -57,6 +57,28 @@ def test_dcn_traffic_never_cheaper():
         assert costs[-1] > costs[0]
 
 
+def test_cold_start_term():
+    """Replica spin-up (docs/serving.md "Elastic fleet"): an AOT-cached
+    load is an order of magnitude cheaper than a from-scratch compile,
+    compile time shrinks with pipeline sharding (fewer layers per stage
+    program) but not with TP (same program node count), and both regimes
+    still pay the weight-shard fetch."""
+    from neuronx_distributed_tpu.plan import cold_start_s
+
+    p = Plan(devices=8, tp=8, pp=1, dp=1)
+    warm = cold_start_s(p, MID, HW, aot_cached=True)
+    cold = cold_start_s(p, MID, HW, aot_cached=False)
+    assert cold > 10 * warm
+    deeper = Plan(devices=8, tp=2, pp=4, dp=1)
+    assert cold_start_s(deeper, MID, HW, aot_cached=False) < cold
+    wider = Plan(devices=16, tp=16, pp=1, dp=1)
+    cold_wide = cold_start_s(wider, MID, HW, aot_cached=False)
+    # TP halves the fetch, not the compile: the drop is far smaller
+    # than pp sharding's
+    assert cold - cold_wide < cold * 0.5
+    assert cold_start_s(p, MID, HW, aot_cached=True) > 0.0
+
+
 def test_slower_tier_never_cheaper():
     """Same bytes, slower link, higher cost — the α-β primitives are
     monotone in both bandwidth and latency."""
