@@ -72,7 +72,7 @@ import jax.numpy as jnp  # noqa: E402
 
 def main(chaos_spec=None, serving=False, overlap=False, router=False,
          prefix_heavy=False, plan_mode=False, obs_mode=False,
-         elastic=False):
+         elastic=False, sdc=False):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -242,6 +242,19 @@ def main(chaos_spec=None, serving=False, overlap=False, router=False,
 
             traceback.print_exc()
             print(f"bench: elastic metric failed: {e!r}", file=sys.stderr)
+
+    # silent-data-corruption drill (docs/resilience.md "Silent data
+    # corruption"): opt-in via --sdc; chaos bitflips on train params
+    # (fingerprint detection -> watchdog verified rewind) and on served
+    # tokens (shadow spot-check -> quarantine + revive)
+    if sdc:
+        try:
+            aux.update(sdc_metric(platform, n_dev))
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: sdc metric failed: {e!r}", file=sys.stderr)
 
     # prefix-heavy serving drill (docs/serving.md): opt-in via
     # --prefix-heavy; 64 requests sharing a system prompt through the
@@ -862,6 +875,182 @@ def elastic_metric(platform: str) -> dict:
     }
 
 
+def sdc_metric(platform: str, n_dev: int) -> dict:
+    """Silent-data-corruption drill, both halves of the defense
+    (docs/resilience.md "Silent data corruption"). RETURNS aux entries
+    keyed by metric name — never prints a JSON line.
+
+    **Train:** a tiny llama trains with ``integrity_every=2``; for each
+    of three chaos seeds one param bit is flipped at a cadence boundary.
+    The drill reports the detection rate (every flip must be caught at
+    the boundary it landed on — within one cadence window by
+    construction), whether the watchdog rewind restored a
+    content-verified checkpoint, and whether the final loss is
+    bit-identical to a fault-free run over the same batches. The
+    fingerprint's cost rides as ``sdc_fp_overhead_pct`` (steady-state
+    step time with the in-step fingerprint at the default cadence vs
+    without — CPU timing is noisy, the structural numbers are the
+    headline) and ``sdc_integrity_extra_compiles`` (cadence lives inside
+    ``lax.cond``, so it must be 0).
+
+    **Serve:** ``sdc_serving_drill`` — a chaos bitflip corrupts one
+    decoded token (the request *completes*; no crash/latency signal),
+    the greedy shadow spot-check catches the divergence, the corrupted
+    replica is quarantined and revived, and every served answer stays
+    bit-identical to the fault-free reference at availability 1.0.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import neuronx_distributed_tpu as nxd
+    from flax.core import meta
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.resilience import (FaultPlan,
+                                                    IntegrityMonitor,
+                                                    Watchdog)
+    from neuronx_distributed_tpu.trainer import (
+        checkpoint as ckpt,
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+        make_train_step,
+    )
+    from neuronx_distributed_tpu.trainer.loop import (CheckpointCallback,
+                                                      Trainer)
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=1)
+    mcfg = tiny_config(num_layers=1, dtype=jnp.float32,
+                       param_dtype=jnp.float32)
+    model = LlamaForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0),
+                             (len(jax.devices()), 17), 0, mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           batch["input_ids"])
+    tx, state0, sh = initialize_parallel_optimizer(pm, params, 1e-3)
+
+    n_steps, every = 6, 2
+    step = make_train_step(pm, tx, sh, donate=False, integrity_every=every)
+
+    # fault-free reference over the same fixed batches
+    s, m = state0, None
+    for _ in range(n_steps):
+        s, m = step(s, batch)
+    ref_loss = float(m["loss"])
+
+    detected = rewound_verified = loss_matched = 0
+    seeds = (0, 1, 2)
+    for seed in seeds:
+        ckpt_dir = tempfile.mkdtemp(prefix="nxd_bench_sdc_")
+        wd = Watchdog(policy="rewind", checkpoint_path=ckpt_dir)
+        mon = IntegrityMonitor(
+            every=every, watchdog=wd,
+            chaos=FaultPlan.parse(
+                f"seed={seed}; integrity|params : bitflip, after=1, "
+                "times=1"))
+        trainer = Trainer(step, state0, callbacks=[
+            CheckpointCallback(ckpt_dir, every=every), mon])
+        st, metrics = trainer.fit(iter([batch] * (3 * n_steps)),
+                                  max_steps=n_steps)
+        # one flip -> one mismatch at the boundary it landed on
+        detected += int(mon.flips_injected == 1 and mon.mismatches == 1)
+        tags = ckpt.list_complete_tags(ckpt_dir)
+        rewound_verified += int(
+            wd.anomalies == 1
+            and all(ckpt.verify_checkpoint(ckpt_dir, t)[0] for t in tags))
+        loss_matched += int(int(st.step) == n_steps
+                            and float(metrics["loss"]) == ref_loss)
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    detection_rate = detected / len(seeds)
+
+    # steady-state per-step cost of the in-step fingerprint: every=1 is
+    # the worst case (paid every step); the default-cadence overhead is
+    # this divided by the cadence
+    base_step = make_train_step(pm, tx, sh, donate=False)
+    fp_step = make_train_step(pm, tx, sh, donate=False, integrity_every=1)
+
+    def timed(f):
+        s = state0
+        for _ in range(2):  # compile initial + steady layouts
+            s, _ = f(s, batch)
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            s, m = f(s, batch)
+            jax.block_until_ready(m["loss"])
+            best = min(best, time.perf_counter() - t0)
+        return best, f._cache_size()
+
+    t_base, cc_base = timed(base_step)
+    t_fp, cc_fp = timed(fp_step)
+    default_cadence = 50
+    overhead_pct = max(t_fp - t_base, 0.0) / t_base * 100.0
+    amortized_pct = overhead_pct / default_cadence
+
+    # serving half: bitflip -> shadow catch -> quarantine -> revive
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel()
+    from neuronx_distributed_tpu.inference.engine import EngineConfig
+    from neuronx_distributed_tpu.inference.router import sdc_serving_drill
+
+    scfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                       num_layers=2)
+    sparams = meta.unbox(LlamaForCausalLM(scfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    drill = sdc_serving_drill(
+        scfg, sparams,
+        EngineConfig(block_size=4, num_blocks=16, max_slots=2,
+                     max_blocks_per_seq=8, token_budget=8,
+                     kv_dtype=jnp.float32))
+
+    print(f"bench: sdc drill detection={detection_rate:.2f} "
+          f"rewind_verified={rewound_verified}/{len(seeds)} "
+          f"loss_match={loss_matched}/{len(seeds)} "
+          f"fp_overhead@1={overhead_pct:.2f}% "
+          f"(@{default_cadence}={amortized_pct:.3f}%) "
+          f"extra_compiles={cc_fp - cc_base} "
+          f"serve_avail={drill['sdc_serving_availability']} "
+          f"serve_mismatch={drill['sdc_serving_mismatches']} "
+          f"serve_quarantine={drill['sdc_serving_quarantines']}",
+          file=sys.stderr)
+    tag = f"{platform}{n_dev}"
+    return {
+        f"sdc_detection_rate_{tag}": {
+            "value": round(detection_rate, 4), "unit": "frac",
+            "vs_baseline": 1.0},
+        f"sdc_rewind_verified_{tag}": {
+            "value": int(rewound_verified == len(seeds)), "unit": "bool",
+            "vs_baseline": 1.0},
+        f"sdc_final_loss_match_{tag}": {
+            "value": int(loss_matched == len(seeds)), "unit": "bool",
+            "vs_baseline": 1.0},
+        f"sdc_fp_overhead_pct_{tag}": {
+            "value": round(amortized_pct, 4), "unit": "pct",
+            "vs_baseline": 1.0},
+        f"sdc_integrity_extra_compiles_{tag}": {
+            "value": int(cc_fp - cc_base), "unit": "compiles",
+            "vs_baseline": 1.0},
+        f"sdc_serving_availability_{platform}1": {
+            "value": round(drill["sdc_serving_availability"], 4),
+            "unit": "frac", "vs_baseline": 1.0},
+        f"sdc_serving_mismatches_{platform}1": {
+            "value": int(drill["sdc_serving_mismatches"]),
+            "unit": "events", "vs_baseline": 1.0},
+        f"sdc_serving_quarantines_{platform}1": {
+            "value": int(drill["sdc_serving_quarantines"]),
+            "unit": "events", "vs_baseline": 1.0},
+        f"sdc_serving_greedy_match_ref_{platform}1": {
+            "value": round(drill["sdc_serving_greedy_match_ref"], 4),
+            "unit": "frac", "vs_baseline": 1.0},
+        f"sdc_serving_max_compile_count_{platform}1": {
+            "value": int(drill["sdc_serving_max_compile_count"]),
+            "unit": "compiles", "vs_baseline": 1.0},
+    }
+
+
 def comm_metric(platform: str, n_dev: int) -> dict:
     """Gradient-collective microbenchmark: step time of a gradient-sized
     ``all_reduce`` over the data axes at fp32 vs blockwise int8
@@ -1463,6 +1652,12 @@ if __name__ == "__main__":
              "graceful scale-down, revival through the executable cache; "
              "docs/serving.md)")
     _p.add_argument(
+        "--sdc", action="store_true",
+        help="also run the silent-data-corruption drill (chaos bitflips "
+             "on train params and served tokens; fingerprint detection "
+             "rate, watchdog verified rewind, shadow-quarantine serving "
+             "path, fingerprint overhead; docs/resilience.md)")
+    _p.add_argument(
         "--prefix-heavy", action="store_true",
         help="also run the prefix-heavy serving drill (64 requests sharing "
              "a system prompt; prefix trie + copy-on-write vs no-sharing "
@@ -1488,4 +1683,4 @@ if __name__ == "__main__":
     main(chaos_spec=_args.chaos, serving=_args.serving,
          overlap=_args.overlap, router=_args.router,
          prefix_heavy=_args.prefix_heavy, plan_mode=_args.plan,
-         obs_mode=_args.obs, elastic=_args.elastic)
+         obs_mode=_args.obs, elastic=_args.elastic, sdc=_args.sdc)

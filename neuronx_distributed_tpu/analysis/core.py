@@ -90,6 +90,7 @@ def _ensure_rules_loaded() -> None:
     from . import (rules_comm_compression,  # noqa: F401
                    rules_custom_vjp,  # noqa: F401
                    rules_elasticity,  # noqa: F401
+                   rules_integrity,  # noqa: F401
                    rules_mesh_axes,  # noqa: F401
                    rules_observability,  # noqa: F401
                    rules_paging,  # noqa: F401

@@ -1,0 +1,109 @@
+"""Rule ``integrity``: host-side hashing inside JAX-traced code.
+
+Content fingerprints are how the SDC defense (``resilience/integrity``)
+notices corrupted bytes, and the tempting way to compute one is also the
+broken way: ``hashlib.sha256(x.tobytes())`` (or ``zlib.crc32``) inside a
+``jit``/``shard_map``/``scan`` body. Two distinct failures hide there:
+
+* **Trace-time constants** — ``hashlib``/``zlib`` digest *concrete host
+  bytes*. Under tracing, ``x`` is a tracer with no bytes; either the
+  call raises, or (when fed a captured constant) it runs once at trace
+  time and bakes a frozen "fingerprint" into every execution — a check
+  that can never fire.
+
+* **Forced host transfers** — ``.tobytes()`` / ``.tostring()`` on an
+  array inside traced code is a device→host readback; even where JAX
+  tolerates it, it breaks the one-readback-per-cadence budget the
+  integrity layer is designed around.
+
+The fix is the on-device fold: ``resilience.integrity.fingerprint_array``
+/ ``fingerprint_tree`` are pure ``jnp`` bit arithmetic — jit-safe,
+shard_map-safe, one int32 per leaf — with bit-exact host mirrors
+(``fingerprint_array_np``) for the boundary compare. Host code (outside
+traced functions) may hash freely: the checkpoint manifests *should* use
+``hashlib.sha256`` on real files.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from . import astutil
+from .core import Finding, LintContext, register
+from .rules_trace_safety import _traced_function_nodes
+
+#: hashlib constructors whose bare imported names we also recognize
+#: (``from hashlib import sha256``).
+_HASH_CTORS = frozenset({
+    "md5", "sha1", "sha224", "sha256", "sha384", "sha512",
+    "sha3_224", "sha3_256", "sha3_384", "sha3_512",
+    "blake2b", "blake2s", "shake_128", "shake_256", "new",
+})
+
+#: zlib checksum functions (same trace-time-constant failure).
+_ZLIB_FNS = frozenset({"crc32", "adler32"})
+
+#: host readbacks that feed byte-level hashing.
+_READBACK_TAILS = frozenset({"tobytes", "tostring"})
+
+
+def _is_host_hash_call(call: ast.Call) -> bool:
+    tail = astutil.tail_name(call.func)
+    root = astutil.root_name(call.func)
+    if root == "hashlib" and tail is not None:
+        return True
+    if tail in _ZLIB_FNS and root in ("zlib", tail):
+        return True
+    # bare ctor from `from hashlib import sha256` — but not `new` (too
+    # generic unqualified) and not attribute forms like self.sha256(...)
+    return (isinstance(call.func, ast.Name) and tail in _HASH_CTORS
+            and tail != "new")
+
+
+def _is_readback_call(call: ast.Call) -> bool:
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr in _READBACK_TAILS)
+
+
+@register(
+    "integrity",
+    "host-side hashing (hashlib/zlib) or .tobytes() readbacks inside "
+    "JAX-traced code — a frozen trace-time 'fingerprint' that never "
+    "detects anything; use resilience.integrity.fingerprint_array")
+def check(ctx: LintContext) -> Iterator[Finding]:
+    findings: List[Finding] = []
+    traced = _traced_function_nodes(ctx.tree)
+    if traced:
+        seen = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                continue
+            if id(node) not in traced:
+                continue
+            body = node.body if isinstance(node, ast.Lambda) else node
+            for sub in ast.walk(body):
+                if not isinstance(sub, ast.Call) or id(sub) in seen:
+                    continue
+                if _is_host_hash_call(sub):
+                    seen.add(id(sub))
+                    findings.append(Finding(
+                        ctx.path, sub.lineno, sub.col_offset,
+                        "integrity",
+                        "host-side hash inside a JAX-traced function "
+                        "digests trace-time bytes (a frozen constant, "
+                        "or a tracer error) — fingerprint on device "
+                        "with resilience.integrity.fingerprint_array "
+                        "/ fingerprint_tree"))
+                elif _is_readback_call(sub):
+                    seen.add(id(sub))
+                    findings.append(Finding(
+                        ctx.path, sub.lineno, sub.col_offset,
+                        "integrity",
+                        f".{sub.func.attr}() inside a JAX-traced "
+                        "function forces a device->host readback (and "
+                        "usually feeds a host hash) — keep integrity "
+                        "fingerprints on device "
+                        "(resilience.integrity.fingerprint_array)"))
+    yield from findings

@@ -37,13 +37,16 @@ import numpy as np
 
 from ..models.llama import LlamaConfig, llama_forward_with_cache
 from ..obs.accounting import CompileTracker
+from ..obs.events import emit_event
 from ..obs.metrics import get_registry
 from ..obs.tracing import get_tracer
+from ..resilience.integrity import IntegrityError, kv_payload_fingerprints
 from .aot_cache import AotExecutableCache, AotWorker, source_fingerprint
 from .kv_cache import PAD_POSITION
-from .paging import (BlockAllocator, CacheExhaustedError, PrefixCache,
-                     cow_copy_blocks, extract_blocks, init_paged_kv_cache,
-                     init_quantized_paged_kv_cache, inject_blocks)
+from .paging import (PAYLOAD_BLOCK_AXES, BlockAllocator, CacheExhaustedError,
+                     PrefixCache, cow_copy_blocks, extract_blocks,
+                     init_paged_kv_cache, init_quantized_paged_kv_cache,
+                     inject_blocks)
 from .sampling import SamplingConfig, sample
 
 
@@ -88,6 +91,13 @@ class EngineConfig:
     # or token_budget) handing KV off through the shared pool.
     disaggregated: bool = False
     prefill_budget: Optional[int] = None
+    # SDC defense on the migration path: export_session fingerprints the
+    # shipped KV blocks (host-side int32 bit-folds over the extracted
+    # payload) and import_session verifies them before touching the pool.
+    # Host-only — the compiled step is untouched, so compile_count and
+    # AOT cache keys are integrity-agnostic. Tickets without fingerprints
+    # (older exporters, integrity=False) import unchecked.
+    integrity: bool = True
 
 
 class RequestRejected(RuntimeError):
@@ -164,7 +174,14 @@ class SessionTicket:
     nothing was prefilled, nothing ships). ``age_s``/``ttft_s`` are
     relative, so the destination rebuilds arrival/first-token times
     against its own epoch and latency accounting stays honest across
-    the move."""
+    the move.
+
+    ``kv_fp`` (when the exporter runs with ``EngineConfig.integrity``)
+    maps each payload tensor name to its per-block integrity
+    fingerprints, computed over the exact bytes extracted —
+    ``import_session`` recomputes them over the bytes that *arrived* and
+    rejects the whole ticket atomically on any mismatch, naming the
+    corrupted (tensor, block)."""
 
     uid: str
     prompt: List[int]
@@ -175,6 +192,7 @@ class SessionTicket:
     ttft_s: Optional[float]
     n_blocks: int = 0
     kv: Optional[Dict[str, Any]] = None
+    kv_fp: Optional[Dict[str, List[int]]] = None
 
 
 @dataclasses.dataclass
@@ -202,6 +220,7 @@ class EngineStats:
     migrated_in: int = 0            # sessions landed via import_session
     migrated_out: int = 0           # sessions shipped via export_session
     migrated_tokens: int = 0        # cached tokens landed without prefill
+    integrity_rejects: int = 0      # tickets refused: KV fingerprint bad
     ttft_s: List[float] = dataclasses.field(default_factory=list)
     step_latency_s: List[float] = dataclasses.field(default_factory=list)
     occupancy: List[float] = dataclasses.field(default_factory=list)
@@ -572,6 +591,8 @@ class ServingEngine:
                 # ships only this session's rows, never the donor's tail
                 kv = extract_blocks(self.cache, blocks,
                                     keep_upto=req.n_cached)
+                kv_fp = (kv_payload_fingerprints(kv, PAYLOAD_BLOCK_AXES)
+                         if self.ecfg.integrity else None)
                 ticket = SessionTicket(
                     uid=req.uid, prompt=list(req.prompt),
                     generated=list(req.generated),
@@ -581,7 +602,7 @@ class ServingEngine:
                     ttft_s=(req.first_token_time - req.arrival_time
                             if req.first_token_time is not None
                             else None),
-                    n_blocks=len(blocks), kv=kv)
+                    n_blocks=len(blocks), kv=kv, kv_fp=kv_fp)
                 self._release(req)
                 self.stats.migrated_out += 1
                 self.stats.queue_depth = self.queue_depth()
@@ -596,13 +617,37 @@ class ServingEngine:
         recording a result: the ticket still belongs to the caller) or
         :class:`CacheExhaustedError` (no slot / no blocks) leave this
         engine untouched so the caller can try another destination or
-        fall back to resubmission."""
+        fall back to resubmission — as does
+        :class:`~..resilience.integrity.IntegrityError` when the shipped
+        KV blocks fail their fingerprint check (a corrupted session must
+        never be continued, and a *partially* imported one would be
+        worse: the verify runs before any pool mutation)."""
         if self._draining:
             raise RequestRejected(
                 "draining", f"{ticket.uid}: engine is draining")
         if not self.fits(len(ticket.prompt), ticket.max_new_tokens):
             raise RequestRejected(
                 "never_fits", f"{ticket.uid}: cannot fit this engine")
+        if ticket.kv is not None and ticket.kv_fp is not None:
+            arrived = kv_payload_fingerprints(ticket.kv, PAYLOAD_BLOCK_AXES)
+            bad: List[Tuple[str, int]] = []
+            for name, fps in ticket.kv_fp.items():
+                got = arrived.get(name, [])
+                if len(got) != len(fps):
+                    bad.append((name, -1))  # tensor missing/reshaped
+                    continue
+                bad.extend((name, i) for i, (want, have)
+                           in enumerate(zip(fps, got)) if want != have)
+            bad.extend((name, -1) for name in arrived
+                       if name not in ticket.kv_fp)
+            if bad:
+                self.stats.integrity_rejects += 1
+                emit_event("integrity_mismatch", scope="kv_ticket",
+                           uid=ticket.uid, corrupt=bad[:8])
+                raise IntegrityError(
+                    f"{ticket.uid}: shipped KV blocks failed their "
+                    f"integrity fingerprints at (tensor, block) {bad[:8]} "
+                    "— ticket rejected, nothing imported")
         now = self._now()
         req = _RequestState(
             uid=ticket.uid, prompt=[int(t) for t in ticket.prompt],

@@ -565,6 +565,14 @@ class PrefixCache:
 # round-tripped through pickle), so there is nothing to fuse.
 # ---------------------------------------------------------------------------
 
+#: Block axis of each :func:`extract_blocks` payload tensor — ``k``/``v``
+#: (and their scales) are pool-shaped ``[layers, blocks, ...]`` gathered on
+#: axis 1, ``pos`` is ``[blocks, block_size]``. Single source of truth for
+#: per-block integrity fingerprints over shipped payloads
+#: (``resilience.integrity.kv_payload_fingerprints``).
+PAYLOAD_BLOCK_AXES = {"k": 1, "v": 1, "pos": 0, "k_scale": 1, "v_scale": 1}
+
+
 def extract_blocks(cache: Any, blocks: Sequence[int],
                    keep_upto: int) -> Dict[str, Any]:
     """Lift ``blocks`` out of the pool as host arrays.

@@ -183,6 +183,13 @@ class RouterConfig:
     # trie subtrees shipped to a fresh/revived replica from the hottest
     # surviving trie (0 = off; needs EngineConfig.prefix_sharing)
     warm_prefix_blocks: int = 0
+    # SDC defense: every Nth completed request is re-decoded on a
+    # *different* replica as a shadow probe (greedy decoding makes the
+    # re-decode bit-identical on healthy hardware, so any token
+    # divergence is corruption). A mismatch quarantines the primary
+    # through the circuit breaker and adopts the shadow's tokens.
+    # 0 = off. Shadows ride outside admission: no stats, no budget.
+    integrity_shadow_every: int = 0
 
 
 @dataclasses.dataclass
@@ -219,6 +226,8 @@ class RouterStats:
     migrated_sessions: int = 0      # live sessions shipped to a survivor
     migrated_tokens: int = 0        # cached tokens moved without re-prefill
     reprefilled_tokens: int = 0     # migration fallbacks that re-prefilled
+    integrity_shadows: int = 0      # shadow re-decodes launched
+    integrity_mismatches: int = 0   # shadow/primary token divergences
     ttft_s: List[float] = dataclasses.field(default_factory=list)
 
     def availability(self) -> float:
@@ -246,6 +255,8 @@ class RouterStats:
             "migrated_sessions": self.migrated_sessions,
             "migrated_tokens": self.migrated_tokens,
             "reprefilled_tokens": self.reprefilled_tokens,
+            "integrity_shadows": self.integrity_shadows,
+            "integrity_mismatches": self.integrity_mismatches,
             "rejected_by_reason": dict(self.rejected_by_reason),
             "tenant_shed": dict(self.tenant_shed),
             "ttft_p50_ms": float(np.percentile(ttft, 50)) * 1e3,
@@ -297,6 +308,9 @@ class _RouterRequest:
     placed_at: Optional[float] = None
     degraded: bool = False
     charged_tokens: int = 0         # budget charge net of prefix credit
+    shadow_of: Optional[str] = None  # uid of the primary this re-decodes
+    avoid_replica: Optional[str] = None  # don't place on the primary
+    expect_tokens: Optional[List[int]] = None  # primary's recorded tokens
 
     @property
     def total_tokens(self) -> int:
@@ -312,6 +326,7 @@ class _Replica:
     down_steps: int = 0             # steps left before revival
     ok_steps: int = 0               # clean steps while in probation
     generation: int = 0             # bumped per engine replacement, so
+    corrupt_bit: Optional[int] = None  # armed chaos bitflip (SDC drill)
     assigned: Dict[str, _RouterRequest] = dataclasses.field(  # obs series
         default_factory=dict)       # from before a revival stay distinct
 
@@ -542,6 +557,13 @@ class ReplicaRouter:
         live = self.live_replicas()
         if not live:
             return None
+        if req.avoid_replica is not None:
+            # shadow probes must land on *different* hardware than the
+            # primary; with nowhere else to go they fall back (a
+            # same-replica re-decode is a vacuous but harmless check)
+            others = [r for r in live if r.name != req.avoid_replica]
+            if others:
+                live = others
         if self.cfg.affinity and req.session:
             name = self._sessions.get(req.session)
             hit = next((r for r in live if r.name == name), None)
@@ -597,6 +619,20 @@ class ReplicaRouter:
                  lost_generated: int) -> None:
         """Route a request back through pending after its replica failed
         it; bounded retries with exponential backoff."""
+        if req.shadow_of is not None:
+            # shadows are probes, not traffic: a probe that loses its
+            # replica retries quietly and is *dropped* (never a "failed"
+            # result, never counted) once retries run out
+            req.attempts += 1
+            if req.attempts > self.cfg.max_retries:
+                return
+            req.next_try = self._now() + (
+                self.cfg.backoff_base_s * 2 ** (req.attempts - 1))
+            req.placed_at = None
+            if rep is not None and req.uid in rep.assigned:
+                del rep.assigned[req.uid]
+            self._pending.append(req)
+            return
         req.attempts += 1
         # re-done work: the prompt is re-prefilled and any generated
         # tokens are discarded (greedy regenerates them bit-identically)
@@ -908,6 +944,9 @@ class ReplicaRouter:
         for uid in [u for u in rep.assigned if u in eng.results]:
             req = rep.assigned.pop(uid)
             res = eng.results.pop(uid)
+            if req.shadow_of is not None:
+                self._resolve_shadow(rep, req, list(res.tokens))
+                continue
             self._committed -= req.charged_tokens
             self.stats.completed += 1
             ttft = None
@@ -926,6 +965,79 @@ class ReplicaRouter:
                 tokens=list(res.tokens), replica=rep.name,
                 resubmits=req.attempts, ttft_s=ttft,
                 degraded=req.degraded)
+            if (self.cfg.integrity_shadow_every > 0
+                    and (self.stats.completed - 1)
+                    % self.cfg.integrity_shadow_every == 0):
+                self._spawn_shadow(req, rep)
+
+    # -- SDC shadow spot checks --------------------------------------------
+
+    def _spawn_shadow(self, req: _RouterRequest, rep: _Replica) -> None:
+        """Launch a shadow re-decode of a just-completed request on a
+        different replica. Greedy decoding is deterministic, so the
+        shadow's tokens must equal the primary's bit-for-bit; divergence
+        means one of the two replicas silently corrupted data. Shadows
+        bypass admission entirely — not submitted, not admitted, not
+        budget-charged — so availability and TTFT stats describe real
+        traffic only."""
+        shadow = _RouterRequest(
+            uid=f"{req.uid}::shadow", tenant=req.tenant,
+            prompt=list(req.prompt),
+            max_new_tokens=req.max_new_tokens,
+            arrival_time=self._now(), shadow_of=req.uid,
+            avoid_replica=rep.name,
+            expect_tokens=list(self.results[req.uid].tokens))
+        self.stats.integrity_shadows += 1
+        self._pending.append(shadow)
+
+    def _resolve_shadow(self, rep: _Replica, req: _RouterRequest,
+                        tokens: List[int]) -> None:
+        """A shadow completed on ``rep``: compare against the primary's
+        recorded tokens. On divergence, trust the shadow (it ran on
+        hardware the breaker considers healthy *and* re-derived the
+        tokens from the prompt alone): overwrite the served result and
+        quarantine the primary replica through the circuit breaker —
+        the same down→probation→revive path a crash takes, so the
+        suspect hardware re-enters service only after clean steps."""
+        if tokens == (req.expect_tokens or []):
+            return
+        self.stats.integrity_mismatches += 1
+        reg = get_registry()
+        if reg.enabled:
+            reg.counter("nxd_integrity_mismatch_total",
+                        "Integrity fingerprint mismatches detected",
+                        labels=("scope",)).labels(scope="decode").inc()
+        emit_event("integrity_mismatch", scope="decode",
+                   uid=req.shadow_of, primary=req.avoid_replica,
+                   shadow=rep.name)
+        prior = self.results.get(req.shadow_of)
+        if prior is not None:
+            prior.tokens = list(tokens)
+            prior.replica = rep.name
+        primary = next((r for r in self.replicas
+                        if r.name == req.avoid_replica), None)
+        if primary is not None and primary is not rep and primary.live:
+            self._fail_replica(primary, "integrity_mismatch",
+                               engine_alive=False)
+
+    def _apply_bitflip(self, rep: _Replica) -> None:
+        """Chaos ``bitflip`` armed on a serving replica: corrupt one
+        generated token of its next completed (non-shadow) result —
+        modeling SDC on the decode/readback path. The request still
+        completes, availability is unharmed, and nothing crashes: only
+        the shadow spot-check can notice the wrong bytes."""
+        eng = rep.engine
+        for uid, res in eng.results.items():
+            r = rep.assigned.get(uid)
+            if r is None or r.shadow_of is not None or not res.tokens:
+                continue
+            res.tokens = list(res.tokens)
+            res.tokens[-1] = int(res.tokens[-1]) ^ (
+                1 << (rep.corrupt_bit % 4))
+            rep.corrupt_bit = None
+            emit_event("chaos_bitflip", scope="decode",
+                       replica=rep.name, uid=uid)
+            return
 
     def step(self) -> int:
         """One router step: check the preemption guard, tick revivals,
@@ -944,15 +1056,17 @@ class ReplicaRouter:
         for rep in list(self.replicas):
             if not rep.live or not rep.assigned:
                 continue
-            directive, extra_latency = (
-                self._chaos.consult("step", rep.name)
-                if self._chaos is not None else (None, 0.0))
+            directive, extra_latency, detail = (
+                self._chaos.consult_detail("step", rep.name)
+                if self._chaos is not None else (None, 0.0, {}))
             if directive == "crash":
                 self._fail_replica(rep, "crash", engine_alive=False)
                 continue
             if directive == "preempt":
                 self._preempt_replica(rep)
                 continue
+            if directive == "bitflip":
+                rep.corrupt_bit = int(detail.get("bit", 0))
             exhausted = directive == "exhaust"
             rows = 0
             try:
@@ -961,6 +1075,8 @@ class ReplicaRouter:
                 # nothing left to preempt: a real storm, count it
                 exhausted = True
             activity += rows
+            if rep.corrupt_bit is not None:
+                self._apply_bitflip(rep)
             latency = (rep.engine.stats.step_latency_s[-1]
                        if rows and rep.engine.stats.step_latency_s
                        else 0.0) + extra_latency
@@ -1090,6 +1206,66 @@ def chaos_drill(model_cfg, params, engine_cfg: EngineConfig,
         "router_admitted": d["admitted"],
         "router_ttft_p99_ms_chaos": d["ttft_p99_ms"],
         "router_greedy_match_ref": float(matches),
+    }
+
+
+def sdc_serving_drill(model_cfg, params, engine_cfg: EngineConfig,
+                      *, n_requests: int = 6, prompt_len: int = 6,
+                      max_new_tokens: int = 4,
+                      plan_spec: str = ("step|r0 : bitflip, after=2, "
+                                        "times=1"),
+                      num_replicas: int = 2,
+                      clock: Optional[Callable[[], float]] = None,
+                      seed: int = 0) -> Dict[str, Any]:
+    """Deterministic silent-data-corruption drill for serving (tests and
+    ``bench.py --sdc``).
+
+    A chaos ``bitflip`` corrupts one generated token on a replica — the
+    request *completes*, so nothing in the crash/latency machinery can
+    see it. With ``integrity_shadow_every=1`` every completion is
+    re-decoded on a different replica; the token divergence is detected,
+    the corrupted result is replaced with the shadow's healthy tokens,
+    and the primary is quarantined through the circuit breaker. Reports
+    availability (must be unharmed), shadow/mismatch/quarantine counts,
+    and bit-identity of every served output against a fault-free
+    single-replica reference — i.e. the corruption never reached a
+    client."""
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, model_cfg.vocab_size,
+                           (prompt_len,)).tolist()
+               for _ in range(n_requests)]
+
+    def _run(n_rep: int, chaos: Optional[FaultPlan], shadow_every: int):
+        router = ReplicaRouter(
+            model_cfg, params, engine_cfg,
+            RouterConfig(num_replicas=n_rep,
+                         integrity_shadow_every=shadow_every),
+            clock=clock, chaos=chaos)
+        for i, p in enumerate(prompts):
+            router.submit(p, max_new_tokens, uid=f"req{i}")
+        results = router.run()
+        max_cc = max((r.engine.compile_count() for r in router.replicas
+                      if r.engine is not None), default=0)
+        return results, router.stats, max_cc
+
+    ref_results, _, _ = _run(1, None, 0)
+    sdc_results, stats, max_cc = _run(num_replicas,
+                                      FaultPlan.parse(plan_spec), 1)
+    matches = all(
+        sdc_results[uid].tokens == ref_results[uid].tokens
+        for uid in ref_results
+        if sdc_results.get(uid) is not None
+        and sdc_results[uid].status == "completed")
+    d = stats.to_dict()
+    return {
+        "sdc_serving_availability": d["availability"],
+        "sdc_serving_completed": d["completed"],
+        "sdc_serving_shadows": d["integrity_shadows"],
+        "sdc_serving_mismatches": d["integrity_mismatches"],
+        "sdc_serving_quarantines": d["failovers"],
+        "sdc_serving_revivals": d["revivals"],
+        "sdc_serving_greedy_match_ref": float(matches),
+        "sdc_serving_max_compile_count": int(max_cc),
     }
 
 

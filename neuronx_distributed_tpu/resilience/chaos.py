@@ -31,6 +31,13 @@ name):
 * ``scale_burst`` — a fleet-level load-spike signal (matched against the
   router's ``consult("scale", "fleet")`` tick) directing an immediate
   scale-up; also consult-only.
+* ``bitflip`` — a silent-data-corruption event: the consulting layer
+  flips one bit at the seeded position (``bit=<n>``, or drawn from the
+  plan RNG when unset) in whatever it guards — a param leaf at an
+  integrity cadence boundary (``consult_detail("integrity", "params")``),
+  a decoded token on a serving replica, a wire payload. Consult-only like
+  ``preempt``: corruption is injected by the caller, never raised. See
+  ``resilience/integrity.py`` and ``bench.py --sdc``.
 
 The router consults the plan through :meth:`FaultPlan.consult`, which
 *returns* the directive instead of raising/sleeping, so injected latency is
@@ -45,7 +52,8 @@ usable from the CLI (``bench.py --chaos`` / ``--router``)::
 
 Each ``;``-separated clause is ``op[|pathglob] : kind-and-options`` where
 options are ``p=<prob>``, ``after=<n calls>``, ``times=<max fires>``,
-``latency=<seconds>``. A leading ``seed=<int>`` clause seeds the RNG.
+``latency=<seconds>``, ``bit=<position>`` (bitflip rules only). A leading
+``seed=<int>`` clause seeds the RNG.
 """
 
 from __future__ import annotations
@@ -86,13 +94,14 @@ class FaultRule:
     op: str = "*"
     path: str = "*"
     kind: str = "transient"  # transient|permanent|latency|crash|exhaust
-    prob: float = 1.0        # |preempt|scale_burst
+    prob: float = 1.0        # |preempt|scale_burst|bitflip
     after: int = 0
     times: int = -1
     latency_s: float = 0.0
+    bit: int = -1            # bitflip position; -1 = draw from plan RNG
 
     _KINDS = ("transient", "permanent", "latency", "crash", "exhaust",
-              "preempt", "scale_burst")
+              "preempt", "scale_burst", "bitflip")
 
     def __post_init__(self) -> None:
         if self.kind not in self._KINDS:
@@ -156,6 +165,9 @@ class FaultPlan:
                     elif k == "latency":
                         kw["latency_s"] = float(v)
                         kind = kind or "latency"
+                    elif k == "bit":
+                        kw["bit"] = int(v)
+                        kind = kind or "bitflip"
                     else:
                         raise ValueError(f"unknown fault option {k!r}")
                 else:
@@ -168,13 +180,17 @@ class FaultPlan:
         with self._lock:
             return sum(self._fired)
 
-    def _fire(self, op: str, path: str) -> Tuple[Optional[str], float]:
+    def _fire(self, op: str, path: str) -> Tuple[Optional[str], float, dict]:
         """Match + fire every rule for (op, path) under the lock; returns
-        ``(first_raising_kind_or_None, max_latency_s)``. Fire bookkeeping
-        (``after``/``times``/``prob`` draws, the audit log) happens here so
-        :meth:`apply` and :meth:`consult` share one deterministic stream."""
+        ``(first_raising_kind_or_None, max_latency_s, detail)``. Fire
+        bookkeeping (``after``/``times``/``prob`` draws, the audit log)
+        happens here so :meth:`apply` and :meth:`consult` share one
+        deterministic stream. ``detail`` carries rule payloads the caller
+        needs to enact a directive (``bit`` for bitflips — pinned by the
+        rule, or drawn from the seeded RNG so drills replay bit-for-bit)."""
         kind: Optional[str] = None
         latency_s = 0.0
+        detail: dict = {}
         with self._lock:
             for i, rule in enumerate(self.rules):
                 if not rule.matches(op, path):
@@ -192,7 +208,10 @@ class FaultPlan:
                     latency_s = max(latency_s, rule.latency_s)
                 elif kind is None:
                     kind = rule.kind
-        return kind, latency_s
+                    if rule.kind == "bitflip":
+                        detail["bit"] = (rule.bit if rule.bit >= 0
+                                         else self._rng.getrandbits(20))
+        return kind, latency_s, detail
 
     def consult(self, op: str, path: str) -> Tuple[Optional[str], float]:
         """Like :meth:`apply` but *returns* the directive instead of
@@ -200,6 +219,15 @@ class FaultPlan:
         through here — the router interprets ``crash``/``exhaust`` itself
         and treats latency as virtual time, so drills stay deterministic
         under fake clocks."""
+        kind, latency_s, _ = self._fire(op, path)
+        return kind, latency_s
+
+    def consult_detail(self, op: str, path: str) -> Tuple[Optional[str],
+                                                          float, dict]:
+        """:meth:`consult` plus the firing rule's payload — ``detail``
+        holds ``{"bit": <position>}`` when a ``bitflip`` directive fires
+        (the integrity monitor and the router's SDC drill need the seeded
+        position to enact the flip deterministically)."""
         return self._fire(op, path)
 
     def apply(self, op: str, path: str) -> None:
@@ -208,7 +236,7 @@ class FaultPlan:
         The first raising rule wins; latency rules sleep and keep going so a
         latency+transient combination behaves like a slow failing store.
         """
-        kind, sleep_s = self._fire(op, path)
+        kind, sleep_s, _ = self._fire(op, path)
         if sleep_s > 0:
             time.sleep(sleep_s)
         if kind == "transient":
@@ -230,9 +258,10 @@ class FaultPlan:
 
             raise CacheExhaustedError(
                 f"chaos: injected pool-exhaustion storm on {op}({path!r})")
-        # preempt / scale_burst are consult-only directives: they model
-        # orchestrator signals (eviction notice, load spike), not storage
-        # failures, so apply() has nothing to raise for them.
+        # preempt / scale_burst / bitflip are consult-only directives:
+        # they model orchestrator signals (eviction notice, load spike)
+        # or in-band corruption the caller must inject itself, not
+        # storage failures, so apply() has nothing to raise for them.
 
 
 class ChaosCheckpointStorage(BaseCheckpointStorage):
@@ -301,6 +330,10 @@ class ChaosCheckpointStorage(BaseCheckpointStorage):
     def load_text(self, filename: str) -> str:
         return self._run("load_text", filename,
                          lambda: self.inner.load_text(filename))
+
+    def read_bytes(self, filename: str):
+        return self._run("read_bytes", filename,
+                         lambda: self.inner.read_bytes(filename))
 
 
 def wrapper_for_plan(plan: FaultPlan, retries: bool = True,

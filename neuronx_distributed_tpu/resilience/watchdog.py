@@ -307,6 +307,14 @@ class Watchdog:
 
     # --------------------------------------------------------- anomalies
 
+    def report_anomaly(self, trainer, reason: str) -> None:
+        """External anomaly entry point: other detectors (the integrity
+        monitor's fingerprint mismatch, a custom data-quality check) feed
+        the same halt / skip_step / rewind discipline as the built-in
+        non-finite and spike detectors — one recovery policy, one rewind
+        budget, regardless of who detected the problem."""
+        self._anomaly(trainer, reason)
+
     def _anomaly(self, trainer, reason: str) -> None:
         self.anomalies += 1
         log_event(logger, "watchdog_anomaly", policy=self.policy,
@@ -337,6 +345,9 @@ class Watchdog:
             raise WatchdogHalt(
                 f"{reason} — rewound {self._rewinds} times already; "
                 "the run is not recovering")
+        # quiesce in-flight async saves first: the newest verified tag is
+        # often the one committed by this very boundary's CheckpointCallback
+        ckpt.finalize_checkpoint()
         if not ckpt.has_checkpoint(self.checkpoint_path):
             raise WatchdogHalt(
                 f"{reason} — no complete checkpoint under "

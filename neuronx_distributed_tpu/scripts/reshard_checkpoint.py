@@ -33,12 +33,13 @@ def main(argv=None) -> None:
     # disagree (a concurrent writer could complete a newer tag in between)
     tag = args.tag
     if tag in (None, "-1"):
-        storage = ckpt.create_checkpoint_storage(args.input)
-        tags = ckpt._complete_tags(storage, ckpt._normalize_path(args.input))
+        tags = ckpt.list_complete_tags(args.input)
         if not tags:
             raise FileNotFoundError(
                 f"no complete checkpoint under {args.input}")
         tag = tags[-1]
+    ok, why = ckpt.verify_checkpoint(args.input, tag)
+    print(f"verify {args.input}/{tag}: {'ok' if ok else 'FAILED'} ({why})")
     state, user_content = ckpt.load_checkpoint(args.input, tag=tag)
     out_tag = args.output_tag if args.output_tag is not None else tag
     ckpt.save_checkpoint(args.output, out_tag, state,

@@ -149,6 +149,25 @@ def has_checkpoint(path: str, tag: Optional[str] = None) -> bool:
     return len(_complete_tags(storage, path)) > 0
 
 
+def list_complete_tags(path: str) -> List[str]:
+    """All complete (done-marker carrying) tags under ``path``, oldest
+    first, numeric tags before lexical ones — the public face of the
+    engine's own completeness scan, for tooling (``scripts/
+    reshard_checkpoint.py``) that must never re-derive the commit
+    protocol from private helpers."""
+    path = _normalize_path(path)
+    return _complete_tags(create_checkpoint_storage(path), path)
+
+
+def verify_checkpoint(path: str, tag: Any) -> Tuple[bool, str]:
+    """``(ok, detail)`` manifest verification of one complete tag —
+    content digests where recorded (manifest v2), inventory+size
+    otherwise. Does not restore; tooling uses this to report whether the
+    bytes it is about to ship are the bytes that were saved."""
+    path = _normalize_path(path)
+    return _verify_tag(create_checkpoint_storage(path), path, str(tag))
+
+
 def save_checkpoint(
     path: str,
     tag: Any,

@@ -159,6 +159,12 @@ class BaseCheckpointStorage(ABC):
         """Size in bytes, or ``None`` when missing/unsupported."""
         return None
 
+    def read_bytes(self, filename: str) -> Optional[bytes]:
+        """Raw file contents, or ``None`` when missing/unsupported.
+        Manifest content digests (verified resume) hash through this;
+        backends returning ``None`` degrade to inventory+size checks."""
+        return None
+
 
 class FilesysCheckpointStorage(BaseCheckpointStorage):
     """Local/NFS filesystem backend (reference
@@ -218,6 +224,13 @@ class FilesysCheckpointStorage(BaseCheckpointStorage):
     def file_size(self, filename: str) -> Optional[int]:
         try:
             return os.path.getsize(filename)
+        except OSError:
+            return None
+
+    def read_bytes(self, filename: str) -> Optional[bytes]:
+        try:
+            with open(filename, "rb") as f:
+                return f.read()
         except OSError:
             return None
 
@@ -307,6 +320,14 @@ class ObjectStoreCheckpointStorage(BaseCheckpointStorage):
     def file_size(self, filename: str) -> Optional[int]:
         try:
             return int(self._fs.size(filename))
+        except FileNotFoundError:
+            return None
+
+    @retry_with_backoff()
+    def read_bytes(self, filename: str) -> Optional[bytes]:
+        try:
+            with self._fs.open(filename, "rb") as f:
+                return f.read()
         except FileNotFoundError:
             return None
 

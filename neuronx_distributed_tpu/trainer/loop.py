@@ -81,6 +81,14 @@ class CheckpointCallback(Callback):
     slot and can shadow a real resume point), and ``on_train_end`` saves
     the final step synchronously when it is not aligned to ``every`` — the
     tail of a run is never lost to alignment.
+
+    Every tag carries a save manifest with per-shard content digests, so
+    a later rewind (watchdog, or :class:`~..resilience.integrity
+    .IntegrityMonitor` on a fingerprint mismatch) restores from a
+    checkpoint whose *bytes* verify. Order this callback *before* the
+    IntegrityMonitor in ``callbacks``: detection then fires after the
+    boundary's save, and a mismatch rewinds to state captured before the
+    corruption could be persisted.
     """
 
     def __init__(self, path: str, every: int = 1000, num_kept: int = 3):

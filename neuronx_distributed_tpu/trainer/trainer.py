@@ -267,6 +267,7 @@ def make_train_step(
     dropout_rng: Optional[jax.Array] = None,
     skip_nonfinite: bool = False,
     compression: Optional[cc.CompressionConfig] = None,
+    integrity_every: Optional[int] = None,
 ):
     """Build the jitted SPMD train step.
 
@@ -298,6 +299,19 @@ def make_train_step(
     counterpart of the resilience ``Watchdog(policy="skip_step")`` host
     rollback: no extra state copy, no host sync, works with ``donate=True``
     and inside ``scan_steps``.
+
+    ``integrity_every``: compute an on-device integrity fingerprint of the
+    *updated* params inside the compiled step, every K steps
+    (``resilience.integrity.fingerprint_tree`` — an int32 bit-fold, not a
+    host hash). Reported as ``metrics["integrity_fp"]`` (fixed-shape
+    ``int32[n_leaves]``, zeros off-cadence) so the metrics stay one
+    structure and the program count stays one: the cadence gate is a
+    ``lax.cond`` on the step counter, like the ``skip_nonfinite`` select.
+    ``resilience.IntegrityMonitor`` consumes it at cadence boundaries to
+    detect silent data corruption between device write and next read; with
+    ``scan_steps > 1`` only the scan's last step's metric surfaces, so
+    keep ``integrity_every`` a multiple of ``scan_steps`` (or 1) for a
+    usable cadence.
 
     ``compression``: a ``parallel.CompressionConfig`` (typically
     ``comm_compressed.from_config(pm.config)``) switching gradient
@@ -335,6 +349,9 @@ def make_train_step(
                          f"{grad_accum_steps}")
     if scan_steps < 1:
         raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")
+    if integrity_every is not None and integrity_every < 1:
+        raise ValueError(f"integrity_every must be >= 1, got "
+                         f"{integrity_every}")
     if loss_fn is None and grad_fn is None:
         def loss_fn(module, params, batch, rngs=None):
             input_ids, labels = batch["input_ids"], batch["labels"]
@@ -504,6 +521,20 @@ def make_train_step(
             new_err = jax.tree_util.tree_map(keep, new_err,
                                              state.comm_error)
             metrics["nonfinite_skipped"] = (~ok).astype(jnp.int32)
+        if integrity_every is not None:
+            # lazy import: resilience pulls in chaos/storage machinery the
+            # hot path doesn't need unless integrity is on
+            from ..resilience.integrity import fingerprint_tree
+
+            n_leaves = len(jax.tree_util.tree_leaves(new_params))
+            # cond, not select: off-cadence steps must not pay the
+            # fingerprint fold; both branches live in the ONE compiled
+            # program (compile_count unchanged), like skip_nonfinite
+            metrics["integrity_fp"] = jax.lax.cond(
+                (state.step + 1) % integrity_every == 0,
+                lambda p: fingerprint_tree(p),
+                lambda p: jnp.zeros((n_leaves,), jnp.int32),
+                new_params)
         return TrainState(step=state.step + 1, params=new_params,
                           opt_state=new_opt, comm_error=new_err), metrics
 

@@ -1,0 +1,30 @@
+"""Fixture: host-side hashing in traced code (never imported, only
+parsed)."""
+
+import hashlib
+import zlib
+from hashlib import sha256
+
+import jax
+
+
+@jax.jit
+def traced_with_hash(x):
+    # digests trace-time bytes: a frozen "fingerprint" that never fires
+    h = hashlib.sha256(x.tobytes()).digest()
+    crc = zlib.crc32(x.tobytes())
+    return x * 2, h, crc
+
+
+def outer(xs):
+    def body(carry, x):
+        d = sha256(bytes(x)).hexdigest()  # bare imported ctor form
+        return carry + x, d
+
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def host_side_is_fine(path):
+    # NOT traced: manifest digests over real files are the point
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()

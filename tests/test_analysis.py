@@ -342,6 +342,27 @@ def test_observability_set_not_flagged_in_traced_code():
                           axes=DEFAULT_AXES) == []
 
 
+def test_integrity_fires_on_fixture():
+    fs = _lint("bad_host_hash.py")
+    assert _rules(fs) == {"integrity"}
+    msgs = [f.message for f in fs if not f.suppressed]
+    # hashlib.sha256 + zlib.crc32 + bare `sha256` in a scan body, plus
+    # both .tobytes() readbacks; the host-side helper stays quiet
+    assert sum("host-side hash" in m for m in msgs) == 3
+    assert sum(".tobytes()" in m for m in msgs) == 2
+    assert not any(f.line >= 27 for f in fs if not f.suppressed)
+
+
+def test_integrity_host_hashing_outside_trace_ok():
+    # manifest digests over real files are exactly what hashlib is for
+    src = ("import hashlib\n"
+           "def digest(path):\n"
+           "    with open(path, 'rb') as fh:\n"
+           "        return hashlib.sha256(fh.read()).hexdigest()\n")
+    assert analyze_source(src, "mypkg/resilience/manifest.py",
+                          axes=DEFAULT_AXES) == []
+
+
 def test_inference_package_self_gate():
     # the serving engine must pass the rule it motivated: every step
     # array is packed to the fixed token budget, never len(requests) —
@@ -437,7 +458,7 @@ def test_cli_nonzero_on_fixture_corpus():
                          "recompile-hazard", "resilience",
                          "comm-compression", "tp-overlap",
                          "serving-resilience", "paging-refcount", "plan",
-                         "observability", "elasticity"}
+                         "observability", "elasticity", "integrity"}
 
 
 def test_cli_zero_on_clean_file():

@@ -1,0 +1,529 @@
+"""Silent-data-corruption defense: on-device fingerprints (jit-safe,
+bit-exact host mirror), cross-dp-replica consensus, in-step cadence
+metric, IntegrityMonitor + watchdog verified rewind, content-digest
+manifests, KV-ticket import verification, and wire spot checks
+(docs/resilience.md "Silent data corruption")."""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.parallel.wire_codec import (
+    CompressionConfig, quantize_dequantize, spot_check_roundtrip)
+from neuronx_distributed_tpu.resilience import manifest as rman
+from neuronx_distributed_tpu.resilience import (FaultPlan, IntegrityError,
+                                                IntegrityMonitor, Watchdog)
+from neuronx_distributed_tpu.resilience.integrity import (
+    combine_fingerprints, dp_consensus_fingerprints, fingerprint_array,
+    fingerprint_array_np, fingerprint_tree, kv_payload_fingerprints,
+    majority_vote, payload_fingerprint)
+from neuronx_distributed_tpu.trainer import checkpoint as ckpt
+from neuronx_distributed_tpu.trainer.loop import (CheckpointCallback,
+                                                  Trainer)
+from neuronx_distributed_tpu.trainer.trainer import TrainState
+
+
+# ---------------------------------------------------------------------------
+# fingerprint fold: parity, sensitivity, jit behaviour
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int32,
+                                   jnp.bool_])
+def test_fingerprint_host_device_parity(dtype):
+    """The np mirror is bit-identical to the jnp fold — the boundary
+    compare (device-reported vs host bytes) can never false-positive on
+    arithmetic drift."""
+    x = jax.random.normal(jax.random.key(0), (37, 5))
+    if dtype == jnp.bool_:
+        x = x > 0
+    else:
+        x = x.astype(dtype)
+    dev = np.asarray(jax.device_get(fingerprint_array(x, blocks=4)))
+    host = fingerprint_array_np(np.asarray(jax.device_get(x)), blocks=4)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_fingerprint_single_bit_sensitivity():
+    """One flipped mantissa bit changes the fingerprint, and blockwise
+    fingerprints localize it to the containing block."""
+    x = np.asarray(jax.random.normal(jax.random.key(1), (64,)),
+                   dtype=np.float32)
+    bad = x.copy()
+    bad_view = bad.view(np.uint32)
+    bad_view[40] ^= np.uint32(1)  # lowest mantissa bit of element 40
+    clean = fingerprint_array_np(x, blocks=4)
+    dirty = fingerprint_array_np(bad, blocks=4)
+    diff = np.nonzero(clean != dirty)[0]
+    assert diff.tolist() == [2]  # element 40 lives in block 2 (of 16-wide)
+
+
+def test_fingerprint_empty_and_zero_distinct():
+    z8 = fingerprint_array_np(np.zeros((8,), np.float32))
+    z16 = fingerprint_array_np(np.zeros((16,), np.float32))
+    assert int(z8[0]) != int(z16[0])  # length is folded in
+
+
+def test_fingerprint_jit_compiles_once():
+    f = jax.jit(fingerprint_array)
+    a = jnp.ones((32,), jnp.float32)
+    b = jnp.arange(32, dtype=jnp.float32)
+    fa, fb = f(a), f(b)
+    assert f._cache_size() == 1
+    assert int(fa[0]) != int(fb[0])
+
+
+def test_fingerprint_tree_and_combine():
+    tree = {"b": jnp.zeros((3,)), "w": jnp.ones((4, 2))}
+    fps = fingerprint_tree(tree)
+    assert fps.shape == (2,) and fps.dtype == jnp.int32
+    scalar = combine_fingerprints(fps)
+    assert scalar.shape == ()
+    # payload fingerprint covers both legs of a (q, scales) pair
+    q = jnp.ones((4, 8), jnp.int8)
+    s = jnp.ones((4, 1), jnp.float32)
+    assert int(payload_fingerprint(q, s)) != int(payload_fingerprint(q))
+
+
+def test_fingerprint_validation():
+    with pytest.raises(ValueError, match="blocks"):
+        fingerprint_array(jnp.ones((4,)), blocks=0)
+    with pytest.raises(ValueError, match="blocks"):
+        fingerprint_array_np(np.ones((4,)), blocks=0)
+
+
+# ---------------------------------------------------------------------------
+# cross-dp-replica consensus (dryrun mesh: 8 virtual CPU devices)
+# ---------------------------------------------------------------------------
+
+def test_dp_consensus_localizes_divergent_replica():
+    """all-gathered fingerprints + majority vote name the corrupted dp
+    slice and the corrupted leaf — with no reference copy anywhere."""
+    mesh = ps.initialize_model_parallel()  # dp=8 on the virtual mesh
+    victim = 3
+    w = jnp.arange(16, dtype=jnp.float32)
+    b = jnp.ones((4,), jnp.float32)
+
+    def body(w, b):
+        idx = jax.lax.axis_index("dp")
+        bits = jax.lax.bitcast_convert_type(w, jnp.uint32)
+        flipped = jax.lax.bitcast_convert_type(
+            bits ^ jnp.uint32(1 << 7), jnp.float32)
+        w_local = jnp.where(idx == victim, flipped, w)
+        return dp_consensus_fingerprints({"b": b, "w": w_local}, "dp")
+
+    fps = jax.jit(ps.shard_map(
+        body, mesh, in_specs=(P(), P()), out_specs=P()))(w, b)
+    fps = np.asarray(jax.device_get(fps))
+    assert fps.shape == (8, 2)  # [dp, n_leaves]; leaves sorted: b, w
+
+    consensus, divergent = majority_vote(fps)
+    assert divergent == {victim: [1]}  # replica 3, leaf "w" only
+    clean = np.asarray(jax.device_get(
+        fingerprint_tree({"b": b, "w": w})))
+    np.testing.assert_array_equal(consensus, clean)
+
+
+def test_majority_vote_validation_and_clean_fleet():
+    with pytest.raises(ValueError, match="replicas"):
+        majority_vote(np.zeros((4,), np.int32))
+    fps = np.tile(np.asarray([[7, 9]], np.int32), (4, 1))
+    consensus, divergent = majority_vote(fps)
+    assert divergent == {} and consensus.tolist() == [7, 9]
+
+
+# ---------------------------------------------------------------------------
+# in-step cadence metric (make_train_step(integrity_every=K))
+# ---------------------------------------------------------------------------
+
+def test_train_step_integrity_fp_cadence_and_compile_once():
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model, initialize_parallel_optimizer,
+        make_train_step)
+
+    cfg = nxd.neuronx_distributed_config(tensor_parallel_size=2)
+    mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                       num_layers=1)
+    model = LlamaForCausalLM(mcfg)
+    ids = jax.random.randint(jax.random.key(0), (4, 17), 0,
+                             mcfg.vocab_size)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    pm, params = initialize_parallel_model(cfg, model, jax.random.key(1),
+                                           batch["input_ids"])
+    tx, state, sh = initialize_parallel_optimizer(pm, params, 1e-3)
+    step = make_train_step(pm, tx, sh, donate=False, integrity_every=2)
+
+    s1, m1 = step(state, batch)
+    # off-cadence: the metric exists (fixed shape) but is all zeros and
+    # the fold was never paid (lax.cond)
+    n_leaves = len(jax.tree_util.tree_leaves(s1.params))
+    assert m1["integrity_fp"].shape == (n_leaves,)
+    assert not np.any(np.asarray(m1["integrity_fp"]))
+
+    s2, m2 = step(s1, batch)
+    reported = np.asarray(jax.device_get(m2["integrity_fp"]))
+    assert np.any(reported)
+    # boundary: the in-step fingerprint digests the params the step wrote,
+    # bit-identical to the host mirror over the same bytes
+    want = np.concatenate([
+        fingerprint_array_np(np.asarray(jax.device_get(leaf)))
+        for leaf in jax.tree_util.tree_leaves(s2.params)])
+    np.testing.assert_array_equal(reported, want)
+    # cadence lives in lax.cond inside ONE program: more boundary and
+    # off-boundary steps never re-trace. (The cache holds 2 entries with
+    # or without integrity — the initial unpinned-host param layout
+    # compiles separately from the steady state; integrity adds none.)
+    steady = step._cache_size()
+    s3, _ = step(s2, batch)
+    step(s3, batch)
+    assert step._cache_size() == steady
+
+    with pytest.raises(ValueError, match="integrity_every"):
+        make_train_step(pm, tx, sh, integrity_every=0)
+
+
+# ---------------------------------------------------------------------------
+# IntegrityMonitor: detection -> watchdog verified rewind
+# ---------------------------------------------------------------------------
+
+def _fake_state(step=0):
+    return TrainState(step=jnp.asarray(step, jnp.int32),
+                      params={"w": jnp.zeros((64,), jnp.float32)},
+                      opt_state={"m": jnp.zeros((64,), jnp.float32)})
+
+
+def _fp_step_fn(s, batch):
+    """Fake step with the in-step fingerprint metric the monitor needs."""
+    new = jax.tree_util.tree_map(lambda x: x + 1.0, s.params)
+    return TrainState(step=s.step + 1, params=new,
+                      opt_state=s.opt_state), {
+        "loss": jnp.asarray(0.1), "grad_norm": jnp.asarray(1.0),
+        "integrity_fp": fingerprint_tree(new)}
+
+
+def _batches(n):
+    return iter([{"input_ids": jnp.zeros((1, 2), jnp.int32)}] * n)
+
+
+def test_monitor_validation():
+    with pytest.raises(ValueError, match="cadence"):
+        IntegrityMonitor(every=0)
+
+
+def test_monitor_requires_step_metric():
+    mon = IntegrityMonitor(every=1)
+    trainer = Trainer(lambda s, b: (TrainState(
+        step=s.step + 1, params=s.params, opt_state=s.opt_state),
+        {"loss": jnp.asarray(0.1)}), _fake_state(), callbacks=[mon])
+    with pytest.raises(IntegrityError, match="integrity_every"):
+        trainer.fit(_batches(3), max_steps=3)
+
+
+def test_monitor_clean_run_no_false_positives():
+    mon = IntegrityMonitor(every=2)
+    trainer = Trainer(_fp_step_fn, _fake_state(), callbacks=[mon])
+    st, _ = trainer.fit(_batches(6), max_steps=6)
+    assert int(st.step) == 6
+    assert mon.checks == 3 and mon.mismatches == 0
+
+
+def test_monitor_detects_flip_and_raises_without_watchdog():
+    chaos = FaultPlan.parse("integrity|params : bitflip, times=1")
+    mon = IntegrityMonitor(every=2, chaos=chaos)
+    trainer = Trainer(_fp_step_fn, _fake_state(), callbacks=[mon])
+    with pytest.raises(IntegrityError, match="mismatch at step 2"):
+        trainer.fit(_batches(6), max_steps=6)
+    assert mon.flips_injected == 1 and mon.mismatches == 1
+
+
+def test_monitor_mismatch_rewinds_to_verified_checkpoint(tmp_path):
+    """Acceptance drill: chaos flips a param bit at a cadence boundary;
+    the monitor detects it within that window and the watchdog rewind
+    restores the newest content-verified checkpoint. With identical
+    per-step batches the replayed run converges to the fault-free final
+    state bit-for-bit."""
+    path = str(tmp_path / "ckpt")
+    wd = Watchdog(policy="rewind", checkpoint_path=path)
+    chaos = FaultPlan.parse(
+        "seed=5; integrity|params : bitflip, after=1, times=1")
+    mon = IntegrityMonitor(every=2, watchdog=wd, chaos=chaos)
+    # checkpoint BEFORE monitor: the boundary's save happens before the
+    # (injected) corruption, so the rewind target is always clean
+    trainer = Trainer(_fp_step_fn, _fake_state(), callbacks=[
+        CheckpointCallback(path, every=2), mon])
+    st, _ = trainer.fit(_batches(12), max_steps=6)
+
+    assert mon.flips_injected == 1  # fired at the step-4 boundary
+    assert mon.mismatches == 1      # detected at the same boundary
+    assert wd.anomalies == 1        # recovery delegated to the watchdog
+    assert int(st.step) == 6
+    # fault-free run of 6 identical steps ends at w = 6.0 exactly
+    np.testing.assert_array_equal(np.asarray(st.params["w"]),
+                                  np.full((64,), 6.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# chaos bitflip DSL
+# ---------------------------------------------------------------------------
+
+def test_bitflip_dsl_parse_and_consult_detail():
+    plan = FaultPlan.parse("integrity|params : bitflip, after=1, bit=12")
+    (r,) = plan.rules
+    assert (r.kind, r.after, r.bit) == ("bitflip", 1, 12)
+    # `bit=` alone implies the kind
+    assert FaultPlan.parse("x : bit=3").rules[0].kind == "bitflip"
+
+    assert plan.consult_detail("integrity", "params") == (None, 0.0, {})
+    kind, lat, detail = plan.consult_detail("integrity", "params")
+    assert (kind, lat, detail) == ("bitflip", 0.0, {"bit": 12})
+    assert plan.injected == ["bitflip integrity params"]  # audit log
+
+
+def test_bitflip_seeded_bit_deterministic():
+    spec = "seed=11; integrity|* : bitflip, times=3"
+
+    def draws(plan):
+        return [plan.consult_detail("integrity", "params")[2].get("bit")
+                for _ in range(3)]
+
+    a = draws(FaultPlan.parse(spec))
+    b = draws(FaultPlan.parse(spec))
+    assert a == b and all(isinstance(x, int) for x in a)
+    assert draws(FaultPlan.parse("seed=12; integrity|* : bitflip, "
+                                 "times=3")) != a
+
+
+def test_bitflip_is_consult_only_in_apply():
+    plan = FaultPlan.parse("save_text : bitflip")
+    plan.apply("save_text", "/x")  # no raise: corruption is caller-side
+    assert plan.fire_count() == 1
+
+
+# ---------------------------------------------------------------------------
+# content-digest manifests / verified rewind target
+# ---------------------------------------------------------------------------
+
+def _state(seed=0):
+    k = jax.random.key(seed)
+    return {"params": {"w": jax.random.normal(k, (8, 4)),
+                       "b": jnp.zeros((4,))},
+            "step": jnp.asarray(7, jnp.int32)}
+
+
+def _flip_byte_same_size(path, tag):
+    """Silent corruption: flip one byte of the largest shard, size
+    unchanged — invisible to the v1 (size-only) check."""
+    sdir = os.path.join(path, str(tag), "state")
+    files = [os.path.join(r, f) for r, _, fs in os.walk(sdir) for f in fs]
+    victim = max(files, key=os.path.getsize)
+    with open(victim, "r+b") as fh:
+        fh.seek(os.path.getsize(victim) // 2)
+        byte = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([byte[0] ^ 0x10]))
+    return victim
+
+
+def test_manifest_catches_same_size_corruption(tmp_path, caplog):
+    path = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(path, 1, _state(1), async_save=False)
+    ckpt.save_checkpoint(path, 2, _state(2), async_save=False)
+    _flip_byte_same_size(path, 2)
+
+    ok, why = ckpt.verify_checkpoint(path, 2)
+    assert not ok and "content digest mismatch" in why
+    ok, why = ckpt.verify_checkpoint(path, 1)
+    assert ok and "digests verified" in why
+
+    # auto-resume skips the corrupt tag, landing on verified bytes
+    with caplog.at_level(logging.WARNING):
+        loaded, _ = ckpt.load_checkpoint(path, tag=None)
+    np.testing.assert_allclose(loaded["params"]["w"],
+                               _state(1)["params"]["w"])
+    # explicit tag: fail-stop, never silently substitute
+    with pytest.raises(ckpt.CheckpointCorruptionError):
+        ckpt.load_checkpoint(path, tag=2)
+
+
+def test_legacy_v1_manifest_verifies_by_size_with_one_warning(
+        tmp_path, caplog):
+    path = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(path, 1, _state(), async_save=False)
+    mpath = os.path.join(path, "1", rman.MANIFEST_FILE)
+    man = json.load(open(mpath))
+    files = [[p, size] for p, size, _ in man["files"]]  # strip digests
+    json.dump({"version": 1, "tag": "1", "files": files,
+               "meta_sha256": rman._meta_sha256(files)}, open(mpath, "w"))
+
+    rman._warned_no_digest = False
+    storage = ckpt.create_checkpoint_storage(path)
+    with caplog.at_level(logging.WARNING):
+        ok, why = rman.verify_manifest(
+            storage, os.path.join(path, "1"), mpath)
+        assert ok and "by size" in why
+        ok, _ = rman.verify_manifest(
+            storage, os.path.join(path, "1"), mpath)
+        assert ok
+    warns = [r for r in caplog.records
+             if "no content digests" in r.getMessage()]
+    assert len(warns) == 1  # once per process, not once per verify
+    loaded, _ = ckpt.load_checkpoint(path, tag=None)
+    np.testing.assert_allclose(loaded["params"]["w"],
+                               _state()["params"]["w"])
+
+
+# ---------------------------------------------------------------------------
+# public checkpoint tag API + reshard CLI verify status
+# ---------------------------------------------------------------------------
+
+def test_list_complete_tags_public_api(tmp_path):
+    path = str(tmp_path / "ckpt")
+    assert ckpt.list_complete_tags(path) == []
+    ckpt.save_checkpoint(path, 2, _state(), async_save=False)
+    ckpt.save_checkpoint(path, 10, _state(), async_save=False)
+    tags = ckpt.list_complete_tags(path)
+    assert set(tags) == {"2", "10"}
+    ok, why = ckpt.verify_checkpoint(path, 10)
+    assert ok and "digests verified" in why
+
+
+def test_reshard_cli_prints_verify_status(tmp_path, capsys):
+    from neuronx_distributed_tpu.scripts import reshard_checkpoint
+
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    ckpt.save_checkpoint(src, 42, _state(3), async_save=False)
+    reshard_checkpoint.main(["--input", src, "--output", dst])
+    out = capsys.readouterr().out
+    assert "verify" in out and "ok" in out
+    loaded, _ = ckpt.load_checkpoint(dst, 42)
+    np.testing.assert_allclose(loaded["params"]["w"],
+                               _state(3)["params"]["w"])
+
+
+# ---------------------------------------------------------------------------
+# KV-session ticket verification (serving migration path)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def tiny_model():
+    ps.initialize_model_parallel()
+    from flax.core import meta
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+    cfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                      num_layers=2)
+    params = meta.unbox(LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    return cfg, params
+
+
+def _engine(tiny_model, name="e", **kw):
+    from neuronx_distributed_tpu.inference.engine import (EngineConfig,
+                                                          ServingEngine)
+    cfg, params = tiny_model
+    base = dict(block_size=4, num_blocks=16, max_slots=2,
+                max_blocks_per_seq=8, token_budget=8,
+                kv_dtype=jnp.float32)
+    base.update(kw)
+    return ServingEngine(cfg, params, EngineConfig(**base), name=name)
+
+
+def test_kv_ticket_import_rejects_corrupt_block_atomically(tiny_model):
+    cfg, _ = tiny_model
+    src = _engine(tiny_model, "src")
+    dst = _engine(tiny_model, "dst")
+    prompt = np.random.RandomState(7).randint(
+        0, cfg.vocab_size, (6,)).tolist()
+    src.submit(prompt, max_new_tokens=6, uid="m")
+    for _ in range(3):
+        src.step()
+    ticket = src.export_session("m")
+    assert ticket.kv is not None and ticket.kv_fp is not None
+    assert ticket.kv_fp.keys() == ticket.kv.keys()
+
+    # silent in-transit corruption: one value in one shipped K block
+    orig_k = np.array(ticket.kv["k"])
+    k = orig_k.copy()
+    k.reshape(-1)[3] += 1.0
+    ticket.kv = {**ticket.kv, "k": k}
+
+    free = dst.pool_free_blocks()
+    with pytest.raises(IntegrityError, match="KV blocks"):
+        dst.import_session(ticket)
+    # atomic reject: nothing mutated on the destination
+    assert dst.pool_free_blocks() == free
+    assert "m" not in dst.results
+    assert dst.stats.migrated_in == 0
+    assert dst.stats.integrity_rejects == 1
+
+    # restoring the real bytes makes the same ticket importable
+    ticket.kv = {**ticket.kv, "k": orig_k}
+    dst.import_session(ticket)
+    assert dst.stats.migrated_in == 1
+
+
+def test_kv_ticket_fp_disabled_by_config(tiny_model):
+    cfg, _ = tiny_model
+    src = _engine(tiny_model, "src", integrity=False)
+    prompt = np.random.RandomState(8).randint(
+        0, cfg.vocab_size, (6,)).tolist()
+    src.submit(prompt, max_new_tokens=4, uid="q")
+    for _ in range(2):
+        src.step()
+    assert src.export_session("q").kv_fp is None
+
+
+def test_kv_payload_fingerprints_localize_block():
+    from neuronx_distributed_tpu.inference.paging import PAYLOAD_BLOCK_AXES
+    payload = {"k": np.ones((2, 3, 4, 2, 8), np.float32),
+               "v": np.ones((2, 3, 4, 2, 8), np.float32),
+               "pos": np.arange(3, dtype=np.int32)}
+    fps = kv_payload_fingerprints(payload, PAYLOAD_BLOCK_AXES)
+    assert [len(v) for v in fps.values()] == [3, 3, 3]
+    payload["v"][:, 1] += 1.0  # corrupt block 1 of v only
+    fps2 = kv_payload_fingerprints(payload, PAYLOAD_BLOCK_AXES)
+    assert fps2["k"] == fps["k"] and fps2["pos"] == fps["pos"]
+    assert [i for i, (a, b) in enumerate(zip(fps["v"], fps2["v"]))
+            if a != b] == [1]
+
+
+# ---------------------------------------------------------------------------
+# wire-integrity spot checks
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["int8", "fp8"])
+def test_wire_spot_check_roundtrip(dtype):
+    cfg = CompressionConfig(dtype=dtype, block_size=8)
+    x = jax.random.normal(jax.random.key(2), (4, 32))
+
+    dec, tx, rx = spot_check_roundtrip(x, cfg, payload_fingerprint)
+    assert int(tx) == int(rx)  # lossy codec, but same bytes both ends
+    np.testing.assert_array_equal(np.asarray(dec),
+                                  np.asarray(quantize_dequantize(x, cfg)))
+
+    def corrupt(q, s):
+        bits = jax.lax.bitcast_convert_type(q, jnp.uint8)
+        idx = (0,) * bits.ndim
+        bits = bits.at[idx].set(bits[idx] ^ np.uint8(4))
+        return jax.lax.bitcast_convert_type(bits, q.dtype), s
+
+    _, tx, rx = spot_check_roundtrip(x, cfg, payload_fingerprint,
+                                     corrupt=corrupt)
+    assert int(tx) != int(rx)  # the flipped wire bit is visible
+
+
+def test_wire_spot_check_fp32_passthrough():
+    x = jax.random.normal(jax.random.key(3), (3, 8))
+    dec, tx, rx = spot_check_roundtrip(x, None, payload_fingerprint)
+    np.testing.assert_array_equal(np.asarray(dec), np.asarray(x))
+    assert int(tx) == int(rx)

@@ -194,15 +194,19 @@ def test_manifest_written_on_save(tmp_path):
     mpath = os.path.join(path, "1", rman.MANIFEST_FILE)
     assert os.path.isfile(mpath)
     man = json.load(open(mpath))
-    assert man["version"] == 1 and man["tag"] == "1"
-    names = [p for p, _ in man["files"]]
+    assert man["version"] == 2 and man["tag"] == "1"
+    names = [e[0] for e in man["files"]]
     assert "user_content.json" in names
     assert any(p.startswith("state/") for p in names)
     # the done-marker and the manifest itself are excluded
     assert ckpt.DONE_FILE not in names and rman.MANIFEST_FILE not in names
-    # sizes are exact
-    for rel, size in man["files"]:
-        assert os.path.getsize(os.path.join(path, "1", rel)) == size
+    # sizes are exact and every entry carries a content digest
+    import hashlib
+    for rel, size, digest in man["files"]:
+        full = os.path.join(path, "1", rel)
+        assert os.path.getsize(full) == size
+        assert hashlib.sha256(
+            open(full, "rb").read()).hexdigest() == digest
 
     storage = ckpt.create_checkpoint_storage(path)
     ok, why = rman.verify_manifest(storage, os.path.join(path, "1"), mpath)

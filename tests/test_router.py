@@ -260,3 +260,20 @@ def test_router_stats_to_dict(tiny_model):
     # engine stats compose with router stats
     eng = router.replicas[0].engine
     assert "queue_depth" in eng.stats.to_dict()
+
+
+def test_sdc_serving_drill(tiny_model):
+    """End-to-end serving SDC drill: a chaos bitflip corrupts one decode
+    result; the shadow spot-check catches it, the corrupted replica is
+    quarantined and revived, no request fails, and every final answer is
+    bit-identical to the fault-free single-replica reference."""
+    from neuronx_distributed_tpu.inference.router import sdc_serving_drill
+
+    cfg, params = tiny_model
+    out = sdc_serving_drill(cfg, params, _ecfg())
+    assert out["sdc_serving_availability"] == 1.0
+    assert out["sdc_serving_completed"] == 6
+    assert out["sdc_serving_mismatches"] == 1
+    assert out["sdc_serving_quarantines"] >= 1
+    assert out["sdc_serving_shadows"] >= 1
+    assert out["sdc_serving_greedy_match_ref"] == 1.0
