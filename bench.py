@@ -72,7 +72,7 @@ import jax.numpy as jnp  # noqa: E402
 
 def main(chaos_spec=None, serving=False, overlap=False, router=False,
          prefix_heavy=False, plan_mode=False, obs_mode=False,
-         elastic=False, sdc=False):
+         elastic=False, sdc=False, moe=False):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -288,6 +288,19 @@ def main(chaos_spec=None, serving=False, overlap=False, router=False,
 
             traceback.print_exc()
             print(f"bench: tp-act metric failed: {e!r}", file=sys.stderr)
+
+    # dropless blockwise MoE drill (docs/moe.md): opt-in via --moe;
+    # blockwise-vs-capacity throughput, the dropless guarantee, the EP
+    # dispatch wire ratio, ring-overlap speedup, and the mixtral serving
+    # one-executable invariant under shifting expert load
+    if moe:
+        try:
+            aux.update(moe_metric(platform, n_dev))
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: moe metric failed: {e!r}", file=sys.stderr)
 
     # placement-planner drill (docs/planner.md): opt-in via --plan; the
     # analytic search at this host's device count vs the hand-picked
@@ -1526,6 +1539,164 @@ def tp_act_metric(platform: str, n_dev: int) -> dict:
     }
 
 
+def moe_metric(platform: str, n_dev: int) -> dict:
+    """Dropless blockwise MoE drill (docs/moe.md): opt-in via --moe.
+
+    Four measurements, RETURNED as aux entries keyed by metric name:
+
+    * ``moe_blockwise_tokens_per_sec`` / ``moe_capacity_tokens_per_sec`` —
+      fwd+bwd token throughput of the blockwise (dropless grouped-GLU)
+      expert bank vs the capacity mask-einsum path at the same shapes;
+    * ``moe_dropped_tokens`` — routed (token, k) assignments the blockwise
+      run dropped: 0 by construction, asserted against the aux the layer
+      itself reports (the capacity contrast at factor 1.0 drops for real);
+    * ``moe_ep_wire_ratio`` — fp32 bytes / quantized bytes on the EP
+      dispatch wire at the codec's accounting (hardware-independent);
+    * ``moe_overlap_speedup`` — int8 ppermute-ring dispatch (per-chunk
+      compute overlapping later hops) vs the int8 monolithic collectives
+      on the largest power-of-two ep mesh this host supports. On CPU the
+      ring's extra dispatches usually outweigh the overlap, so a value
+      below 1.0 there is honest, not a bug;
+    * ``moe_max_compile_count`` — executable count of a mixtral blockwise
+      ServingEngine across submissions with shifting expert load (the
+      one-executable invariant: must be 1).
+    """
+    import numpy as np
+    from flax.core import meta
+    from jax.sharding import PartitionSpec as P
+
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.modules.moe import ExpertMLPs
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.parallel.wire_codec import CompressionConfig
+
+    ratio = 4.0 / CompressionConfig(dtype="int8").wire_bytes_per_element
+
+    if platform == "cpu":
+        t, h, inter, e, k, block = 512, 64, 128, 4, 2, 64
+    else:
+        t, h, inter, e, k, block = 2048, 256, 704, 8, 2, 128
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(t, h).astype(np.float32) * 0.1)
+    gates = jnp.full((t, k), 1.0 / k, jnp.float32)
+    idx = jnp.asarray(rng.randint(0, e, (t, k)))
+
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel()
+
+    def build(mode):
+        m = ExpertMLPs(num_experts=e, hidden_size=h, intermediate_size=inter,
+                       top_k=k, capacity_factor=1.0, dispatch_mode=mode,
+                       block_size=block, dtype=jnp.float32,
+                       param_dtype=jnp.float32)
+        params = meta.unbox(m.init(jax.random.key(0), x, gates, idx))
+
+        def loss(p, xv):
+            y, aux = m.apply(p, xv, gates, idx)
+            return jnp.sum(y * y), aux["dropped_fraction"]
+
+        return params, jax.jit(jax.grad(loss, has_aux=True))
+
+    def timed(fn, *a):
+        jax.block_until_ready(fn(*a))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*a))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    p_b, step_b = build("blockwise")
+    p_c, step_c = build("capacity")
+    _, dropped_frac = step_b(p_b, x)
+    dropped_tokens = float(dropped_frac) * t * k
+    _, dropped_cap = step_c(p_c, x)
+    t_b = timed(step_b, p_b, x)
+    t_c = timed(step_c, p_c, x)
+
+    # --- int8 ring overlap vs int8 monolithic on the widest ep mesh ---
+    ep = 1
+    while ep * 2 <= min(n_dev, e) and e % (ep * 2) == 0:
+        ep *= 2
+    overlap_speedup = 1.0
+    if ep > 1:
+        ps.destroy_model_parallel()
+        nxd.neuronx_distributed_config(expert_parallel_size=ep)
+        em = ps.get_expert_mesh()
+        pspec = {"params": {"gate_up": P("ep", None, None, None),
+                            "down": P("ep", None, None)}}
+
+        def run_ep(overlap):
+            m = ExpertMLPs(
+                num_experts=e, hidden_size=h, intermediate_size=inter,
+                top_k=k, dispatch_mode="blockwise", block_size=block,
+                ep_wire_dtype="int8", ep_overlap=overlap,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+            params = meta.unbox(m.init(jax.random.key(0), x, gates, idx))
+            f = jax.jit(ps.shard_map(
+                lambda p, xv, g, i: m.apply(p, xv, g, i)[0], em,
+                in_specs=(pspec, P("ep", None), P("ep", None),
+                          P("ep", None)),
+                out_specs=P("ep", None)))
+            return timed(f, params, x, gates, idx)
+
+        t_mono = run_ep(False)
+        t_ring = run_ep(True)
+        overlap_speedup = t_mono / t_ring
+
+    # --- serving: one executable across shifting expert load ---
+    from neuronx_distributed_tpu.inference.engine import (EngineConfig,
+                                                          ServingEngine)
+    from neuronx_distributed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                        tiny_moe_config)
+
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel()
+    mcfg = tiny_moe_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                           moe_dispatch="blockwise", moe_block_size=32)
+    params = meta.unbox(MixtralForCausalLM(mcfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    eng = ServingEngine(mcfg, params, EngineConfig(
+        block_size=4, num_blocks=32, max_slots=2, max_blocks_per_seq=8,
+        token_budget=8, kv_dtype=jnp.float32))
+    erng = np.random.RandomState(1)
+    # prompts drawn from disjoint vocab bands shift which experts the
+    # router lights up between submissions
+    for i, (lo, hi) in enumerate(((0, 64), (128, 192), (192, 256))):
+        eng.submit(erng.randint(lo, hi, (5 + i,)).tolist(), 4, uid=str(i))
+        eng.step()
+    eng.run()
+    compile_count = eng.compile_count()
+    ps.destroy_model_parallel()
+
+    print(f"bench: moe blockwise={t * k / t_b:,.0f} tok/s "
+          f"capacity={t * k / t_c:,.0f} tok/s dropped(blockwise)="
+          f"{dropped_tokens:.0f} dropped(capacity)="
+          f"{float(dropped_cap) * t * k:.0f} wire_ratio={ratio:.2f}x "
+          f"ep={ep} overlap_speedup={overlap_speedup:.3f} "
+          f"compile_count={compile_count}", file=sys.stderr)
+    return {
+        f"moe_blockwise_tokens_per_sec_{platform}{n_dev}": {
+            "value": round(t * k / t_b, 1), "unit": "routed_tokens/sec",
+            "vs_baseline": 1.0},
+        f"moe_capacity_tokens_per_sec_{platform}{n_dev}": {
+            "value": round(t * k / t_c, 1), "unit": "routed_tokens/sec",
+            "vs_baseline": 1.0},
+        f"moe_dropped_tokens_{platform}{n_dev}": {
+            "value": int(dropped_tokens), "unit": "tokens",
+            "vs_baseline": 0.0},
+        f"moe_ep_wire_ratio_{platform}{n_dev}": {
+            "value": round(ratio, 3), "unit": "x_fewer_bytes",
+            "vs_baseline": 1.0},
+        f"moe_overlap_speedup_{platform}{n_dev}": {
+            "value": round(overlap_speedup, 3), "unit": "x_vs_monolithic",
+            "vs_baseline": 1.0},
+        f"moe_max_compile_count_{platform}{n_dev}": {
+            "value": int(compile_count), "unit": "executables",
+            "vs_baseline": 1.0},
+    }
+
+
 def resilience_metric(platform: str, chaos_spec=None) -> dict:
     """Preemption drill: train a tiny llama with periodic checkpointing,
     deliver a real SIGTERM mid-run, catch the resumable exit, then resume
@@ -1668,6 +1839,13 @@ if __name__ == "__main__":
              "(decomposed collective-matmul vs monolithic gather+matmul at "
              "llama MLP shapes; docs/tp_overlap.md)")
     _p.add_argument(
+        "--moe", action="store_true",
+        help="also run the dropless blockwise MoE drill (blockwise vs "
+             "capacity fwd+bwd throughput, dropped-token count, EP "
+             "dispatch wire ratio, int8 ring-overlap speedup, mixtral "
+             "serving compile count under shifting expert load; "
+             "docs/moe.md)")
+    _p.add_argument(
         "--plan", action="store_true",
         help="also run the placement-planner drill (analytic search at "
              "this device count vs the hand-picked bench layout; reports "
@@ -1683,4 +1861,5 @@ if __name__ == "__main__":
     main(chaos_spec=_args.chaos, serving=_args.serving,
          overlap=_args.overlap, router=_args.router,
          prefix_heavy=_args.prefix_heavy, plan_mode=_args.plan,
-         obs_mode=_args.obs, elastic=_args.elastic, sdc=_args.sdc)
+         obs_mode=_args.obs, elastic=_args.elastic, sdc=_args.sdc,
+         moe=_args.moe)

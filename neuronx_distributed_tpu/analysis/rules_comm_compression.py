@@ -27,6 +27,17 @@ compression config in scope are untouched — plain activation
 collectives remain the model's own business. ``ops/`` is exempt like
 ``parallel/``: the decomposed primitives compose raw collectives with
 the codec by design.
+
+EP-dispatch extension (PR 13): under the same in-scope condition (now
+also armed by ``moe_ep_wire_dtype``/``ep_wire_dtype``/``ep_dispatch``
+references), raw ``lax.all_to_all``/``lax.ppermute`` calls on
+dispatch-named variables (``dispatch*``/``chunks``/``routed*``/
+``payload*``/``send``/``recv``) also fire: token dispatch payloads are
+exactly what ``parallel.ep_dispatch.gather_token_chunks`` /
+``combine_token_chunks(..., wire=wire_config(...))`` quantize and
+overlap, so a full-precision monolithic exchange next to an EP wire
+config ships 4x the configured bytes and serializes the ring
+(docs/moe.md).
 """
 
 from __future__ import annotations
@@ -61,9 +72,20 @@ def _in_ops_package(path: str) -> bool:
 # activation collectives contradict the module's own configuration
 _COMPRESSION_IN_SCOPE = re.compile(
     r"\b(wire_codec|comm_compressed|CompressionConfig|"
-    r"tp_activation_comm_dtype|activation_comm_dtype)\b")
+    r"tp_activation_comm_dtype|activation_comm_dtype|"
+    r"moe_ep_wire_dtype|ep_wire_dtype|ep_dispatch)\b")
 
 _ACT_COLLECTIVES = ("pmean", "psum", "all_gather")
+
+# identifier looks like an EP dispatch payload: the token chunks shipped
+# between expert shards ('dispatch_buf', 'chunks', 'routed_tokens',
+# 'payload', 'send'/'recv' buffers) — activation/loss/param names must
+# NOT match so plain shuffles stay the model's own business
+_DISPATCH_NAME = re.compile(
+    r"dispatch|(^|_)chunks?(_|$)|routed|payload|(^|_)(send|recv)(buf)?(_|$)",
+    re.IGNORECASE)
+
+_DISPATCH_COLLECTIVES = ("all_to_all", "ppermute")
 
 
 def _gradient_named(node: ast.AST) -> bool:
@@ -78,6 +100,13 @@ def _activation_named(node: ast.AST) -> bool:
     if name is None and isinstance(node, ast.Name):
         name = node.id
     return bool(name and _ACT_NAME.search(name))
+
+
+def _dispatch_named(node: ast.AST) -> bool:
+    name = astutil.tail_name(node)
+    if name is None and isinstance(node, ast.Name):
+        name = node.id
+    return bool(name and _DISPATCH_NAME.search(name))
 
 
 @register(
@@ -115,4 +144,16 @@ def check(ctx: LintContext) -> Iterator[Finding]:
                 "quantize; route it through the parallel layers or "
                 "ops.collective_matmul(..., wire=wire_config(...)) "
                 "(docs/comm_compression.md)"))
+            continue
+        if act_scope and tail in _DISPATCH_COLLECTIVES and node.args \
+                and _dispatch_named(node.args[0]):
+            findings.append(Finding(
+                ctx.path, node.lineno, node.col_offset, "comm-compression",
+                f"full-precision lax.{tail} on an EP dispatch payload in a "
+                "module with a wire-codec config in scope — the monolithic "
+                "exchange ships the fp32 wire the config promises to "
+                "quantize and serializes against the expert compute; use "
+                "parallel.ep_dispatch.gather_token_chunks / "
+                "combine_token_chunks(..., wire=wire_config(...)) "
+                "(docs/moe.md)"))
     yield from findings

@@ -43,6 +43,10 @@ def configure_model(cfg: "NxDConfig", model_cfg: Any) -> Any:
     if "activation_sync_fraction" in fields:
         updates["activation_sync_fraction"] = \
             cfg.parallel.tp_activation_sync_fraction
+    if "moe_ep_wire_dtype" in fields:
+        updates["moe_ep_wire_dtype"] = cfg.parallel.moe_ep_wire_dtype
+    if "moe_overlap_dispatch" in fields:
+        updates["moe_overlap_dispatch"] = cfg.parallel.moe_overlap_dispatch
     model_cfg = dataclasses.replace(model_cfg, **updates)
     if "num_experts" in fields:
         # incoherent MoE knobs fail here with actionable errors instead of
@@ -131,6 +135,15 @@ class ParallelConfig:
     # residual resync (PAPERS.md "Partially Synchronized Activations").
     # < 1.0 requires scan_layers=False models without sequence_parallel.
     tp_activation_sync_fraction: float = 1.0
+    # MoE EP-dispatch wire (docs/moe.md): dtype for the expert-parallel
+    # token gather/combine payloads — "fp32" (off), "int8" or "fp8"
+    # (blockwise quantized + per-block fp32 scales). Blockwise dispatch
+    # only (validate_moe_config enforces).
+    moe_ep_wire_dtype: str = "fp32"
+    # Decomposed (ppermute-ring) EP dispatch overlapping per-chunk expert
+    # compute with later hops: None = auto (engage at ep >= 4), True =
+    # engage whenever ep > 1, False = monolithic collectives.
+    moe_overlap_dispatch: Optional[bool] = None
 
     def __post_init__(self) -> None:
         for f in ("tensor_parallel_size", "pipeline_parallel_size",
@@ -154,6 +167,21 @@ class ParallelConfig:
             raise ValueError(
                 f"tp_activation_comm_dtype must be one of {_WIRE_DTYPES}, "
                 f"got {self.tp_activation_comm_dtype!r}")
+        if self.moe_ep_wire_dtype not in _WIRE_DTYPES:
+            raise ValueError(
+                f"moe_ep_wire_dtype must be one of {_WIRE_DTYPES}, "
+                f"got {self.moe_ep_wire_dtype!r}")
+        if self.moe_overlap_dispatch not in (None, True, False):
+            raise ValueError(
+                "moe_overlap_dispatch must be None (auto), True, or False, "
+                f"got {self.moe_overlap_dispatch!r}")
+        if (self.moe_overlap_dispatch is True
+                and self.expert_parallel_size <= 1):
+            raise ValueError(
+                "moe_overlap_dispatch=True requires expert_parallel_size > "
+                f"1 (got ep={self.expert_parallel_size}): with a single EP "
+                "rank there is no dispatch to decompose — use None (auto) "
+                "or raise expert_parallel_size")
         f = self.tp_activation_sync_fraction
         if not (isinstance(f, (int, float)) and 0.0 < f <= 1.0):
             raise ValueError(
@@ -285,6 +313,8 @@ class NxDConfig:
             tp_activation_comm_dtype=self.parallel.tp_activation_comm_dtype,
             tp_activation_sync_fraction=(
                 self.parallel.tp_activation_sync_fraction),
+            moe_ep_wire_dtype=self.parallel.moe_ep_wire_dtype,
+            moe_overlap_dispatch=self.parallel.moe_overlap_dispatch,
             optimizer_config=self.optimizer,
             mixed_precision_config=self.mixed_precision,
             activation_checkpoint_config=self.activation_checkpoint,
@@ -313,6 +343,8 @@ def neuronx_distributed_config(
     tp_overlap_comm: Optional[bool] = None,
     tp_activation_comm_dtype: str = "fp32",
     tp_activation_sync_fraction: float = 1.0,
+    moe_ep_wire_dtype: str = "fp32",
+    moe_overlap_dispatch: Optional[bool] = None,
 ) -> NxDConfig:
     """Build an :class:`NxDConfig` and (by default) initialise the global mesh.
 
@@ -330,6 +362,8 @@ def neuronx_distributed_config(
             tp_overlap_comm=tp_overlap_comm,
             tp_activation_comm_dtype=tp_activation_comm_dtype,
             tp_activation_sync_fraction=tp_activation_sync_fraction,
+            moe_ep_wire_dtype=moe_ep_wire_dtype,
+            moe_overlap_dispatch=moe_overlap_dispatch,
         ),
         optimizer=optimizer_config or OptimizerConfig(),
         mixed_precision=mixed_precision_config or MixedPrecisionConfig(),

@@ -278,10 +278,24 @@ class ServingEngine:
                  rng: Optional[jax.Array] = None,
                  clock: Optional[Callable[[], float]] = None,
                  aot_cache: Optional[AotExecutableCache] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 forward_fn: Optional[Callable] = None):
         self.model_cfg = model_cfg
         self.params = params
         self.ecfg = engine_cfg
+        # model-family forward: any callable with the
+        # llama_forward_with_cache paged signature ``(cfg, params, tokens,
+        # positions, cache, slot_ids=...) -> (logits, cache)``. None
+        # auto-selects by config type — a MixtralConfig serves through
+        # mixtral_forward_with_cache (MoE decode over the same paged pool).
+        if forward_fn is None:
+            from ..models.mixtral import (MixtralConfig,
+                                          mixtral_forward_with_cache)
+
+            forward_fn = (mixtral_forward_with_cache
+                          if isinstance(model_cfg, MixtralConfig)
+                          else llama_forward_with_cache)
+        self._forward_fn = forward_fn
         # elastic-fleet hooks: an AOT cache makes worker construction
         # load-or-compile (replicas after the first spin up without
         # compiling); a name scopes this engine's obs compile-tracker
@@ -368,9 +382,10 @@ class ServingEngine:
 
     def _build_step(self):
         model_cfg, sampling = self.model_cfg, self.ecfg.sampling
+        forward = self._forward_fn
 
         def step_fn(params, cache, tokens, positions, slot_ids, rng):
-            logits, cache = llama_forward_with_cache(
+            logits, cache = forward(
                 model_cfg, params, tokens, positions, cache,
                 slot_ids=slot_ids)
             toks = sample(logits[0], rng, sampling)
@@ -411,7 +426,7 @@ class ServingEngine:
         return (repr(self.model_cfg), e.block_size, e.num_blocks,
                 e.max_slots, e.max_blocks_per_seq, e.quantized,
                 str(e.kv_dtype), repr(e.sampling),
-                source_fingerprint(llama_forward_with_cache, sample),
+                source_fingerprint(self._forward_fn, sample),
                 params_spec)
 
     def _example_args(self, width: int):

@@ -38,6 +38,13 @@ class MixtralConfig(LlamaConfig):
     # serving path enables this via dataclasses.replace — see
     # mixtral_forward_with_cache)
     moe_sentinel_empty: bool = False
+    # EP dispatch wire dtype ("fp32" | "int8" | "fp8"): quantizes the token
+    # gather/combine payloads over ep (blockwise dispatch only; see
+    # parallel/ep_dispatch.py)
+    moe_ep_wire_dtype: str = "fp32"
+    # decomposed (ppermute-ring) EP dispatch overlapping per-chunk expert
+    # compute with later hops; None = auto-engage at ep >= 4
+    moe_overlap_dispatch: Optional[bool] = None
     # expert bank implementation: "float" | "mx_fp4" | "mx_fp8" (packed
     # microscaling decode weights; convert with mx_pack_expert_params)
     moe_expert_impl: str = "float"
@@ -109,6 +116,8 @@ class MixtralDecoderLayer(nn.Module):
             dispatch_mode=cfg.moe_dispatch,
             block_size=cfg.moe_block_size,
             sentinel_empty=cfg.moe_sentinel_empty,
+            ep_wire_dtype=cfg.moe_ep_wire_dtype,
+            ep_overlap=cfg.moe_overlap_dispatch,
             expert_impl=cfg.moe_expert_impl,
             router_type=cfg.router_type,
             shared_expert_intermediate=cfg.shared_expert_intermediate,
@@ -153,6 +162,35 @@ class _MoEDecodeScanBody(nn.Module):
             x, cos, sin, positions, cache=(k_l, v_l, slot_pos),
             cache_index=cache_index)
         return x, new_cache
+
+
+class _MoEPagedScanBody(nn.Module):
+    """nn.scan body for paged MoE decode — the mixtral analogue of llama's
+    ``_PagedScanBody`` (same ``layer`` scope as :class:`_MoEDecodeScanBody`,
+    so one checkpoint serves both cache protocols). The attention sublayer
+    already understands :class:`..inference.paging.PagedCacheView`; the MoE
+    sublayer is cache-free, so only the view plumbing differs."""
+
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, cache_kv, pool_pos, tables, write_idx, cos, sin,
+                 positions):
+        from ..inference.paging import PagedCacheView
+
+        if len(cache_kv) == 4:
+            k_l, v_l, ks_l, vs_l = cache_kv
+        else:
+            (k_l, v_l), ks_l, vs_l = cache_kv, None, None
+        view = PagedCacheView(k=k_l, v=v_l, k_scale=ks_l, v_scale=vs_l,
+                              pos=pool_pos, tables=tables,
+                              write_idx=write_idx)
+        x, _, new_view = MixtralDecoderLayer(self.cfg, name="layer")(
+            x, cos, sin, positions, cache=view, cache_index=None)
+        if len(cache_kv) == 4:
+            return x, (new_view.k, new_view.v, new_view.k_scale,
+                       new_view.v_scale)
+        return x, (new_view.k, new_view.v)
 
 
 class MixtralModel(nn.Module):
@@ -255,7 +293,8 @@ class MixtralForCausalLM(nn.Module):
 
 def mixtral_forward_with_cache(cfg: MixtralConfig, params,
                                input_ids: jax.Array,
-                               positions: jax.Array, kv_cache):
+                               positions: jax.Array, kv_cache,
+                               slot_ids=None):
     """KV-cached forward for MoE serving ("context_encoding" /
     "token_generation" keys) — the mixtral analogue of
     :func:`.llama.llama_forward_with_cache` (the reference serves mixtral
@@ -269,13 +308,27 @@ def mixtral_forward_with_cache(cfg: MixtralConfig, params,
     bandwidth-side equivalent of the reference's fused token-gen MoE
     kernel (``moe_fused_tkg.py:85``; forward-only, so the training-side dW
     constraint does not apply).
+
+    Paged protocol (llama parity): pass a
+    :class:`..inference.paging.PagedKVCache` plus ``slot_ids [T]`` mapping
+    each packed token (``input_ids [1, T]``) to its cache slot; K/V land in
+    the slot's block-table blocks. Contiguous callers are untouched.
     """
     import dataclasses
 
     from ..inference.kv_cache import KVCache
+    from ..inference.paging import PagedKVCache, QuantizedPagedKVCache
 
     if not cfg.scan_layers:
         raise ValueError("cached decode requires scan_layers=True")
+    paged = isinstance(kv_cache, (PagedKVCache, QuantizedPagedKVCache))
+    if paged:
+        if slot_ids is None:
+            raise ValueError("paged cache forward requires slot_ids [T]")
+        if input_ids.shape[0] != 1:
+            raise ValueError(
+                "paged decode packs requests into one row batch [1, T]; "
+                f"got batch {input_ids.shape[0]}")
     # token-generation-sized calls only: at prefill (large batch*seq) most
     # experts are hit anyway and the decode kernel's partial-sum layout
     # would cost O(num_ib * tokens * H) HBM for nothing (measured crossover
@@ -297,22 +350,50 @@ def mixtral_forward_with_cache(cfg: MixtralConfig, params,
         cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta,
         use_scaled=cfg.rope_scaling)
 
-    slot_pos = jax.lax.dynamic_update_slice_in_dim(
-        kv_cache.pos, positions, kv_cache.index, axis=1)
     rope_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
 
-    scanned = nn.scan(
-        _MoEDecodeScanBody,
-        variable_axes={"params": 0},
-        split_rngs={"params": True},
-        in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast,
-                 nn.broadcast),
-        out_axes=0,
-        length=cfg.num_layers,
-    )(cfg)
-    x, (new_k, new_v) = scanned.apply(
-        {"params": p["model"]["layers"]}, x, (kv_cache.k, kv_cache.v),
-        slot_pos, cos, sin, rope_pos, kv_cache.index)
+    if paged:
+        from ..inference import paging as _paging
+
+        slot_ids = jnp.asarray(slot_ids, jnp.int32)
+        # per-token routing (see llama_forward_with_cache paged branch):
+        # each packed token carries its slot's block-table row and a flat
+        # pool index for this step's K/V write
+        tok_tables = kv_cache.block_tables[
+            jnp.clip(slot_ids, 0, kv_cache.max_slots - 1)]
+        write_idx = _paging.flat_write_indices(
+            tok_tables, positions[0], kv_cache.block_size,
+            kv_cache.capacity)
+        slot_pos = _paging.write_pool_positions(kv_cache.pos, positions[0],
+                                                write_idx)
+        scanned = nn.scan(
+            _MoEPagedScanBody,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
+                     nn.broadcast, nn.broadcast, nn.broadcast),
+            out_axes=0,
+            length=cfg.num_layers,
+        )(cfg)
+        x, (new_k, new_v) = scanned.apply(
+            {"params": p["model"]["layers"]}, x,
+            (kv_cache.k, kv_cache.v), slot_pos, tok_tables, write_idx,
+            cos, sin, rope_pos)
+    else:
+        slot_pos = jax.lax.dynamic_update_slice_in_dim(
+            kv_cache.pos, positions, kv_cache.index, axis=1)
+        scanned = nn.scan(
+            _MoEDecodeScanBody,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
+                     nn.broadcast, nn.broadcast),
+            out_axes=0,
+            length=cfg.num_layers,
+        )(cfg)
+        x, (new_k, new_v) = scanned.apply(
+            {"params": p["model"]["layers"]}, x, (kv_cache.k, kv_cache.v),
+            slot_pos, cos, sin, rope_pos, kv_cache.index)
 
     x = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype).apply(
         {"params": p["model"]["norm"]}, x)
@@ -321,6 +402,9 @@ def mixtral_forward_with_cache(cfg: MixtralConfig, params,
         overlap_comm=cfg.overlap_comm, **_act_kw(cfg),
         dtype=cfg.dtype, param_dtype=cfg.param_dtype)
     logits = head.apply({"params": p["lm_head"]}, x)
-    new_cache = KVCache(k=new_k, v=new_v, pos=slot_pos,
-                        index=kv_cache.index + s)
+    if paged:
+        new_cache = kv_cache.replace(k=new_k, v=new_v, pos=slot_pos)
+    else:
+        new_cache = KVCache(k=new_k, v=new_v, pos=slot_pos,
+                            index=kv_cache.index + s)
     return logits, new_cache

@@ -1,4 +1,4 @@
-"""Dropless (blockwise) MoE expert computation.
+"""Dropless (blockwise) MoE routing metadata.
 
 Analogue of the reference's blockwise NKI path
 (``modules/moe/expert_mlps_v2.py:691`` ``forward_blockwise``,
@@ -7,22 +7,15 @@ tokens are sorted by expert and processed in fixed-size blocks by a
 block-sparse grouped matmul, so compute scales with the *actual* tokens per
 expert instead of a capacity bound.
 
-TPU-native design (the megablox/ragged-gmm pattern):
-
-* routing metadata is computed in XLA (sort by expert, per-expert counts,
-  block-aligned padding; all static shapes — the worst case is
-  ``T·K + E·B`` padded slots);
-* the grouped matmul is a Pallas kernel over a grid of token blocks whose
-  expert index arrives via scalar prefetch
-  (``pltpu.PrefetchScalarGridSpec``): the weight BlockSpec's index_map reads
-  ``block_expert[b]`` so each block streams exactly its expert's weights
-  from HBM — consecutive blocks of the same expert elide the re-fetch;
-* the backward is the same pattern transposed: dx is a grouped matmul with
-  the transposed weights, dW accumulates per-expert by *output revisiting*
-  (consecutive blocks of one expert map to the same output block, which
-  Mosaic keeps in VMEM and flushes once — no atomics needed);
-* the capacity-factor path (:mod:`.expert_mlps`) is the golden reference:
-  with capacity >= T·K both paths drop nothing and must agree exactly.
+This module owns the XLA side of the path: routing metadata (sort by
+expert, per-expert counts, block-aligned padding — all static shapes, the
+worst case is ``T·K + E·B`` padded slots) and the scatter/combine between
+token order and the block-padded layout. The grouped-GLU matmul itself
+lives in :mod:`...ops.blockwise_moe` (Pallas kernel + bit-exact jnp
+reference + auto-dispatch), re-exported here for callers of the original
+layout; the capacity-factor path (:mod:`.expert_mlps`) is the golden
+fallback: with capacity >= T·K both paths drop nothing and must agree
+exactly.
 
 The kernel operates on the *local* shard of the expert weights — under
 shard_map the ep/tp axes are bound and ``E_local``/``I_local`` arrive
@@ -31,14 +24,16 @@ pre-sliced; under GSPMD (single-program) the global sizes are used.
 
 from __future__ import annotations
 
-import functools
-from typing import Optional, Tuple
-
 import jax
 import jax.numpy as jnp
-from jax import lax
 
-from ...ops.pallas_utils import compiler_params as _compiler_params
+# kernel family hosted in ops/ (PR 13); re-exported for compatibility
+from ...ops.blockwise_moe import (grouped_glu, grouped_glu_decode,  # noqa: F401
+                                  grouped_glu_reference, use_pallas)
+
+__all__ = ["round_up", "compute_block_metadata", "scatter_to_blocks",
+           "combine_from_blocks", "grouped_glu", "grouped_glu_decode",
+           "grouped_glu_reference", "use_pallas"]
 
 
 def round_up(x: int, m: int) -> int:
@@ -123,334 +118,3 @@ def combine_from_blocks(ys: jax.Array, gates: jax.Array, order: jax.Array,
     pair_gate = gates.reshape(-1)[order]              # gate of sorted pair
     return jnp.zeros((num_tokens, ys.shape[-1]), ys.dtype).at[src].add(
         rows * pair_gate[:, None].astype(ys.dtype))
-
-
-# ---------------------------------------------------------------------------
-# Pallas grouped GLU kernels. xs [P, H] is the block-padded sorted token
-# layout; each grid block b computes silu(x@Wg)·(x@Wu) @ Wd with the weights
-# of expert block_expert[b] (scalar-prefetched so the BlockSpec index_maps
-# can select the expert's weight tiles). The intermediate dim is tiled
-# (grid dim ib) so weight tiles fit VMEM at 7B/70B sizes.
-# ---------------------------------------------------------------------------
-
-def _silu(x):
-    return x * jax.nn.sigmoid(x)
-
-
-def _dsilu(x):
-    s = jax.nn.sigmoid(x)
-    return s * (1 + x * (1 - s))
-
-
-def _glu_fwd_kernel(be_ref, x_ref, gu_ref, dn_ref, y_ref, *, num_ib: int,
-                    num_real: int):
-    from jax.experimental import pallas as pl
-
-    b = pl.program_id(0)
-    ib = pl.program_id(1)
-
-    @pl.when(ib == 0)
-    def _init():
-        # unconditional: sentinel blocks' outputs must be ZERO (their
-        # combine gates are zero, but 0 * uninitialized-HBM could be NaN)
-        y_ref[...] = jnp.zeros_like(y_ref)
-
-    @pl.when(be_ref[b] < num_real)
-    def _compute():
-        x = x_ref[...].astype(jnp.float32)            # [B, H]
-        gu = gu_ref[0].astype(jnp.float32)            # [H, 2, bI]
-        g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        a = _silu(g) * u                              # [B, bI]
-        y_ref[...] = y_ref[...] + jax.lax.dot_general(
-            a, dn_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(y_ref.dtype)
-
-
-def _glu_dx_kernel(be_ref, x_ref, gu_ref, dn_ref, dy_ref, dx_ref, *,
-                   num_ib: int, num_real: int):
-    from jax.experimental import pallas as pl
-
-    b = pl.program_id(0)
-    ib = pl.program_id(1)
-
-    @pl.when(ib == 0)
-    def _init():
-        dx_ref[...] = jnp.zeros_like(dx_ref)
-
-    @pl.when(be_ref[b] < num_real)
-    def _compute():
-        x = x_ref[...].astype(jnp.float32)
-        dy = dy_ref[...].astype(jnp.float32)
-        gu = gu_ref[0].astype(jnp.float32)            # [H, 2, bI]
-        dn = dn_ref[0].astype(jnp.float32)            # [bI, H]
-        g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        da = jax.lax.dot_general(dy, dn, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        dg = da * u * _dsilu(g)
-        du = da * _silu(g)
-        dx = jax.lax.dot_general(dg, gu[:, 0], (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        dx = dx + jax.lax.dot_general(du, gu[:, 1], (((1,), (1,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        dx_ref[...] = dx_ref[...] + dx.astype(dx_ref.dtype)
-
-
-def _glu_dw_kernel(be_ref, x_ref, gu_ref, dn_ref, dy_ref, dgu_ref, ddn_ref,
-                   *, num_ib: int, num_real: int):
-    """Grid (ib, b): consecutive b of one expert revisit the same dW output
-    block, accumulating in VMEM; zero it on the expert's first block."""
-    from jax.experimental import pallas as pl
-
-    b = pl.program_id(1)
-    # boundaries on the CLAMPED expert id (what the out index_map uses):
-    # sentinel blocks share the last real expert's tile, so the real->
-    # sentinel transition must NOT re-zero that expert's accumulated dW
-    cur = jnp.minimum(be_ref[b], num_real - 1)
-    prev = jnp.minimum(be_ref[jnp.maximum(b, 1) - 1], num_real - 1)
-    first_of_expert = jnp.logical_or(b == 0, prev != cur)
-
-    @pl.when(first_of_expert)
-    def _init():
-        dgu_ref[...] = jnp.zeros_like(dgu_ref)
-        ddn_ref[...] = jnp.zeros_like(ddn_ref)
-
-    @pl.when(be_ref[b] < num_real)
-    def _compute():
-        x = x_ref[...].astype(jnp.float32)
-        dy = dy_ref[...].astype(jnp.float32)
-        gu = gu_ref[0].astype(jnp.float32)
-        dn = dn_ref[0].astype(jnp.float32)
-        g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        a = _silu(g) * u
-        da = jax.lax.dot_general(dy, dn, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        dg = da * u * _dsilu(g)
-        du = da * _silu(g)
-        # ddown[e, ib] += a^T @ dy ; dgu[e, :, 0/1, ib] += x^T @ dg/du
-        ddn_ref[0] = ddn_ref[0] + jax.lax.dot_general(
-            a, dy, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(ddn_ref.dtype)
-        dgw = jax.lax.dot_general(x, dg, (((0,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        duw = jax.lax.dot_general(x, du, (((0,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        dgu_ref[0] = dgu_ref[0] + jnp.stack([dgw, duw], axis=1).astype(
-            dgu_ref.dtype)
-
-
-def _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
-                        block_i, interpret, num_real):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    p, h = xs.shape
-    e, _, _, i = gate_up.shape
-    nb = p // block_size
-    num_ib = i // block_i
-    # sentinel blocks (be >= num_real) borrow the LAST real expert's weight
-    # tiles via this clamp — the DMA is elided across a run of sentinel
-    # blocks and the kernels' pl.when guards skip their compute entirely.
-    # Grid order (b, ib): the y block accumulates over consecutive ib steps
-    # in VMEM (a non-consecutive revisit would not re-fetch); weight tiles
-    # are refetched per block — the layout that favours training, where
-    # nb ~ E. Decode uses :func:`_grouped_glu_pallas_decode` instead.
-    we = functools.partial(jnp.minimum, num_real - 1)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(nb, num_ib),
-        in_specs=[
-            pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
-            pl.BlockSpec((1, h, 2, block_i),
-                         lambda b, ib, be: (we(be[b]), 0, 0, ib)),
-            pl.BlockSpec((1, block_i, h),
-                         lambda b, ib, be: (we(be[b]), ib, 0)),
-        ],
-        out_specs=pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
-    )
-    return pl.pallas_call(
-        functools.partial(_glu_fwd_kernel, num_ib=num_ib,
-                          num_real=num_real),
-        out_shape=jax.ShapeDtypeStruct((p, h), xs.dtype),
-        grid_spec=grid_spec,
-        interpret=interpret,
-        compiler_params=None if interpret else _compiler_params(),
-    )(block_expert, xs, gate_up, down)
-
-
-def _glu_fwd_decode_kernel(be_ref, x_ref, gu_ref, dn_ref, y_ref, *,
-                           num_real: int):
-    from jax.experimental import pallas as pl
-
-    b = pl.program_id(1)
-
-    # each (ib, b) output block is written exactly once — no revisits
-    y_ref[...] = jnp.zeros_like(y_ref)
-
-    @pl.when(be_ref[b] < num_real)
-    def _compute():
-        x = x_ref[...].astype(jnp.float32)            # [B, H]
-        gu = gu_ref[0].astype(jnp.float32)            # [H, 2, bI]
-        g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        a = _silu(g) * u                              # [B, bI]
-        y_ref[...] = jax.lax.dot_general(
-            a, dn_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(y_ref.dtype)[None]
-
-
-def grouped_glu_decode(xs, gate_up, down, block_expert, block_size,
-                       block_i, interpret):
-    """Forward-only grouped GLU tuned for decode HBM traffic.
-
-    Grid order (ib, b) — token blocks INNERMOST — so consecutive blocks of
-    one (clamped) expert keep an identical weight-tile index and Pallas
-    elides the refetch: total weight traffic is (#hit experts) x weights
-    instead of (#blocks) x weights. With ``sentinel_empty`` metadata all
-    empty experts clamp into one shared sentinel run, so a T-token decode
-    step reads only the experts those tokens hit — the bandwidth property
-    the reference's fused token-gen kernel exists for
-    (``moe_fused_tkg.py:85``). Each (ib, b) output block is written exactly
-    once into a partial layout [num_ib, P, H] summed by XLA (an in-kernel
-    accumulation would need non-consecutive output revisits, which do not
-    re-fetch). The extra partial-sum traffic is O(num_ib·P·H) — trivial at
-    decode's tiny P, which is why training keeps :func:`grouped_glu`.
-    """
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    p, h = xs.shape
-    e, _, _, i = gate_up.shape
-    num_real = e
-    nb = p // block_size
-    num_ib = i // block_i
-    we = functools.partial(jnp.minimum, num_real - 1)
-    partial = pl.pallas_call(
-        functools.partial(_glu_fwd_decode_kernel, num_real=num_real),
-        # fp32 partials: the per-ib contributions are summed below, and a
-        # bf16 round-trip through HBM before that sum loses mantissa bits
-        # the kernel already paid fp32 accumulation for (advisor r3)
-        out_shape=jax.ShapeDtypeStruct((num_ib, p, h), jnp.float32),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(num_ib, nb),
-            in_specs=[
-                pl.BlockSpec((block_size, h), lambda ib, b, be: (b, 0)),
-                pl.BlockSpec((1, h, 2, block_i),
-                             lambda ib, b, be: (we(be[b]), 0, 0, ib)),
-                pl.BlockSpec((1, block_i, h),
-                             lambda ib, b, be: (we(be[b]), ib, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, block_size, h),
-                                   lambda ib, b, be: (ib, b, 0)),
-        ),
-        interpret=interpret,
-        compiler_params=None if interpret else _compiler_params(),
-    )(block_expert, xs, gate_up, down)
-    return jnp.sum(partial, axis=0).astype(xs.dtype)
-
-
-def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,
-                            block_i, interpret, num_real):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    p, h = xs.shape
-    e, _, _, i = gate_up.shape
-    nb = p // block_size
-    num_ib = i // block_i
-    we = functools.partial(jnp.minimum, num_real - 1)
-
-    dx = pl.pallas_call(
-        functools.partial(_glu_dx_kernel, num_ib=num_ib,
-                          num_real=num_real),
-        out_shape=jax.ShapeDtypeStruct((p, h), xs.dtype),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(nb, num_ib),
-            in_specs=[
-                pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
-                pl.BlockSpec((1, h, 2, block_i),
-                             lambda b, ib, be: (we(be[b]), 0, 0, ib)),
-                pl.BlockSpec((1, block_i, h),
-                             lambda b, ib, be: (we(be[b]), ib, 0)),
-                pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
-            ],
-            out_specs=pl.BlockSpec((block_size, h),
-                                   lambda b, ib, be: (b, 0)),
-        ),
-        interpret=interpret,
-        compiler_params=None if interpret else _compiler_params(),
-    )(block_expert, xs, gate_up, down, dy)
-
-    dgu, ddn = pl.pallas_call(
-        functools.partial(_glu_dw_kernel, num_ib=num_ib,
-                          num_real=num_real),
-        out_shape=[jax.ShapeDtypeStruct(gate_up.shape, jnp.float32),
-                   jax.ShapeDtypeStruct(down.shape, jnp.float32)],
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(num_ib, nb),
-            in_specs=[
-                pl.BlockSpec((block_size, h), lambda ib, b, be: (b, 0)),
-                pl.BlockSpec((1, h, 2, block_i),
-                             lambda ib, b, be: (we(be[b]), 0, 0, ib)),
-                pl.BlockSpec((1, block_i, h),
-                             lambda ib, b, be: (we(be[b]), ib, 0)),
-                pl.BlockSpec((block_size, h), lambda ib, b, be: (b, 0)),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, h, 2, block_i),
-                             lambda ib, b, be: (we(be[b]), 0, 0, ib)),
-                pl.BlockSpec((1, block_i, h),
-                             lambda ib, b, be: (we(be[b]), ib, 0)),
-            ],
-        ),
-        interpret=interpret,
-        compiler_params=None if interpret else _compiler_params(),
-    )(block_expert, xs, gate_up, down, dy)
-    return dx, dgu.astype(gate_up.dtype), ddn.astype(down.dtype)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def grouped_glu(xs, gate_up, down, block_expert, block_size, block_i,
-                interpret):
-    """Block-sparse grouped GLU: ``ys[b] = silu(x_b@Wg_e)·(x_b@Wu_e) @ Wd_e``
-    with ``e = block_expert[b]`` (the dropless expert matmul).
-
-    Blocks whose ``block_expert[b] >= E`` (the weight arrays' expert count)
-    are *sentinels* (bound-EP non-local pairs): their compute is skipped
-    in-kernel and their output rows are zero. Deriving the sentinel
-    threshold from the array shape (rather than a parameter) guarantees
-    every real expert owns >= 1 block, so no dW tile is left unwritten."""
-    return _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
-                               block_i, interpret, gate_up.shape[0])
-
-
-def _grouped_glu_fwd(xs, gate_up, down, block_expert, block_size, block_i,
-                     interpret):
-    ys = _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
-                             block_i, interpret, gate_up.shape[0])
-    return ys, (xs, gate_up, down, block_expert)
-
-
-def _grouped_glu_bwd(block_size, block_i, interpret, res, dy):
-    xs, gate_up, down, block_expert = res
-    dx, dgu, ddn = _grouped_glu_pallas_bwd(
-        xs, gate_up, down, block_expert, dy, block_size, block_i, interpret,
-        gate_up.shape[0])
-    dbe = jnp.zeros(block_expert.shape, jax.dtypes.float0)
-    return dx, dgu, ddn, dbe
-
-
-grouped_glu.defvjp(_grouped_glu_fwd, _grouped_glu_bwd)

@@ -80,6 +80,42 @@ def validate_moe_config(model_cfg: Any, parallel_cfg: Optional[Any] = None):
                 "moe_sentinel_empty (decode weight-DMA elision) only "
                 "applies to moe_dispatch='blockwise'")
 
+    wire = getattr(model_cfg, "moe_ep_wire_dtype", "fp32")
+    from ...parallel.wire_codec import _WIRE_DTYPES
+
+    if wire not in _WIRE_DTYPES:
+        raise ValueError(
+            f"moe_ep_wire_dtype must be one of {_WIRE_DTYPES}, got "
+            f"{wire!r}. Please adjust your configuration.")
+    overlap = getattr(model_cfg, "moe_overlap_dispatch", None)
+    if overlap not in (None, True, False):
+        raise ValueError(
+            "moe_overlap_dispatch must be None (auto), True, or False, "
+            f"got {overlap!r}")
+    if dispatch != "blockwise":
+        # the quantized/overlapped dispatch lives on the blockwise-EP
+        # token gather/combine; on the capacity path these knobs would be
+        # silently inert — fail loudly instead (reference validator style)
+        if wire != "fp32":
+            raise ValueError(
+                f"moe_ep_wire_dtype={wire!r} requires "
+                "moe_dispatch='blockwise' (the quantized EP wire rides the "
+                f"dropless token dispatch); got moe_dispatch={dispatch!r}. "
+                "Please adjust your configuration.")
+        if overlap is True:
+            raise ValueError(
+                "moe_overlap_dispatch=True requires "
+                "moe_dispatch='blockwise' (the ppermute-ring dispatch is "
+                f"the blockwise-EP token gather); got "
+                f"moe_dispatch={dispatch!r}")
+    if overlap is True and parallel_cfg is not None:
+        ep = parallel_cfg.parallel.expert_parallel_size
+        if ep <= 1:
+            raise ValueError(
+                "moe_overlap_dispatch=True requires expert_parallel_size "
+                f"> 1 (got ep={ep}): a single EP rank has no dispatch to "
+                "decompose. Use None (auto) or raise expert_parallel_size.")
+
     impl = getattr(model_cfg, "moe_expert_impl", "float")
     if impl not in _EXPERT_IMPLS:
         raise ValueError(

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ...parallel import comm, mappings
+from ...parallel import comm, ep_dispatch, mappings
 from ...parallel import layers as pl
 from ...parallel import mesh as ps
 
@@ -80,6 +80,12 @@ class ExpertMLPs(nn.Module):
     # decode: skip + DMA-elide blocks of experts no token hit (forward-only;
     # see blockwise.compute_block_metadata)
     sentinel_empty: bool = False
+    # EP dispatch wire dtype ("fp32" | "int8" | "fp8"): quantizes the token
+    # gather + output combine payloads over ep (parallel/ep_dispatch.py)
+    ep_wire_dtype: str = "fp32"
+    # decomposed (ppermute-ring) EP dispatch overlapping per-chunk expert
+    # compute with later hops; None = auto (ep >= MIN_AUTO_AXIS_SIZE)
+    ep_overlap: Optional[bool] = None
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     tp_axis: str = ps.TP_AXIS
@@ -158,12 +164,12 @@ class ExpertMLPs(nn.Module):
         bi = min(self.block_i, i_local)
         if i_local % bi != 0:
             bi = i_local
-        interpret = jax.default_backend() == "cpu"
         kernel = (bw.grouped_glu_decode if self.sentinel_empty
                   else bw.grouped_glu)
+        # force_pallas=None: Pallas on TPU, the bit-exact jnp reference on
+        # CPU (ops.blockwise_moe auto-dispatch)
         return kernel(xs, gate_up.astype(self.dtype),
-                      down.astype(self.dtype), be, self.block_size, bi,
-                      interpret)
+                      down.astype(self.dtype), be, self.block_size, bi)
 
     def _forward_blockwise(self, x, gates, idx, gate_up, down, i_local):
         """Dropless path: sort-by-expert + Pallas block-sparse grouped GLU
@@ -190,42 +196,20 @@ class ExpertMLPs(nn.Module):
         aux = {"dropped_fraction": jnp.zeros((), jnp.float32)}
         return y.astype(self.dtype), aux
 
-    def _forward_blockwise_ep(self, x, gates, idx, gate_up, down, i_local,
-                              e_local):
-        """Dropless blockwise under a *bound* ep axis (shard_map).
-
-        Reference-style (``expert_mlps_v2.py:779-817``): there is no
-        dispatch all-to-all — every EP rank sees every token (all-gather
-        over ep) and masks the routing to its LOCAL experts. Non-local
-        (token, k) pairs map to a *sentinel* expert sorted last, whose
-        gates are zeroed: the sentinel blocks borrow the last local
-        expert's weights, compute finite garbage, and contribute nothing —
-        forward (gate 0), backward dW/dx (their ``dy`` cotangent is 0).
-        Per-rank partial outputs reduce-scatter back to the token shards.
-
-        Collective cost per rank: all-gather [T_local, H] + reduce-scatter
-        [T_g, H] over ep — vs capacity-EP's two all-to-alls of the capacity
-        buffer. The gather rides ICI and is the standard TPU EP-dropless
-        layout (tokens replicated over the expert group).
-        """
+    def _local_expert_partial(self, x_in, gates_in, idx_in, gate_up, down,
+                              i_local, e_local, off):
+        """Partial expert output of ``x_in``'s tokens through THIS rank's
+        local experts: non-local (token, k) pairs map to a *sentinel*
+        expert sorted last, whose gates are zeroed — the sentinel blocks
+        borrow the last local expert's weights, compute finite garbage, and
+        contribute nothing, forward (gate 0) and backward dW/dx (their
+        ``dy`` cotangent is 0). Shared by the monolithic (whole gathered
+        batch) and per-chunk (one token shard at a time) EP paths."""
         from . import blockwise as bw
 
-        r = jax.lax.axis_index(self.ep_axis)
-        # gather with REDUCE-SCATTER backward (to_model_parallel=True): each
-        # rank produces partial cotangents for EVERY token (its experts'
-        # contributions), which must be summed across ranks then re-sharded —
-        # a slice-only gather backward would drop the off-rank contributions
-        x_g = mappings.gather_from_sequence_parallel_region(
-            x, self.ep_axis, seq_dim=0, to_model_parallel=True)
-        gates_g = mappings.gather_from_sequence_parallel_region(
-            gates, self.ep_axis, seq_dim=0, to_model_parallel=True)
-        idx_g = comm.all_gather(idx, self.ep_axis, dim=0)  # int: no grads
-        t_g = x_g.shape[0]
-
-        off = r * e_local
-        local = (idx_g >= off) & (idx_g < off + e_local)
-        idx_local = jnp.where(local, idx_g - off, e_local)  # sentinel last
-        gates_local = jnp.where(local, gates_g, 0.0).astype(gates_g.dtype)
+        local = (idx_in >= off) & (idx_in < off + e_local)
+        idx_local = jnp.where(local, idx_in - off, e_local)  # sentinel last
+        gates_local = jnp.where(local, gates_in, 0.0).astype(gates_in.dtype)
 
         # decode (sentinel_empty): additionally sentinel the blocks of
         # LOCAL experts no token hit — both sentinel classes land >= e_local
@@ -235,7 +219,7 @@ class ExpertMLPs(nn.Module):
             idx_local, e_local + 1, self.block_size,
             sentinel_empty=self.sentinel_empty)
 
-        xin = mappings.copy_to_tensor_parallel_region(x_g, self.tp_axis)
+        xin = mappings.copy_to_tensor_parallel_region(x_in, self.tp_axis)
         xs = bw.scatter_to_blocks(xin.astype(self.dtype), src, dest, padded)
         # sentinel (block_expert >= E_local) blocks are compute-skipped
         # in-kernel, so per-rank MXU work tracks the LOCAL routed load —
@@ -244,10 +228,79 @@ class ExpertMLPs(nn.Module):
         # router-grad placement: see _forward_blockwise
         gates_local = mappings.copy_to_tensor_parallel_region(
             gates_local, self.tp_axis)
-        y = bw.combine_from_blocks(ys, gates_local, order, src, dest, t_g)
-        y = mappings.reduce_from_tensor_parallel_region(y, self.tp_axis)
-        # sum partial expert outputs over ep AND return to token shards
-        y = mappings.reduce_scatter_to_sequence_parallel_region(
-            y, self.ep_axis, seq_dim=0)
+        y = bw.combine_from_blocks(ys, gates_local, order, src, dest,
+                                   x_in.shape[0])
+        return mappings.reduce_from_tensor_parallel_region(y, self.tp_axis)
+
+    def _forward_blockwise_ep(self, x, gates, idx, gate_up, down, i_local,
+                              e_local):
+        """Dropless blockwise under a *bound* ep axis (shard_map).
+
+        Reference-style (``expert_mlps_v2.py:779-817``): there is no
+        dispatch all-to-all — every EP rank sees every token (all-gather
+        over ep) and masks the routing to its LOCAL experts; per-rank
+        partial outputs reduce back to the token shards.
+
+        Two dispatch programs (:mod:`...parallel.ep_dispatch`):
+
+        * **monolithic** (``ep_wire_dtype="fp32"`` and overlap off): one
+          all-gather of [T_local, H] + one reduce-scatter of [T_g, H] over
+          ep — the baseline layout, bitwise preserved;
+        * **per-chunk** (quantized wire and/or ring overlap): the gather
+          exposes each source rank's chunk separately (optionally arriving
+          hop-by-hop over a ppermute ring, payloads int8/fp8 on the wire),
+          the local-expert blockwise matmul runs per chunk — so chunk
+          ``t``'s compute overlaps hop ``t+1`` — and the per-destination
+          partials ride the dual combine back. The fp32 ring is bitwise
+          identical to the monolithic collectives (``_ordered_sum``
+          materialization; tested), and quantized ring == quantized
+          monolithic bitwise, fwd + bwd.
+        """
+        r = jax.lax.axis_index(self.ep_axis)
+        off = r * e_local
+        wire = ep_dispatch.wire_config(self.ep_wire_dtype)
+        overlap = ep_dispatch.overlap_engaged(self.ep_overlap, self.ep_axis)
         aux = {"dropped_fraction": jnp.zeros((), jnp.float32)}
+
+        if wire is None and not overlap:
+            # gather with REDUCE-SCATTER backward (to_model_parallel=True):
+            # each rank produces partial cotangents for EVERY token (its
+            # experts' contributions), which must be summed across ranks
+            # then re-sharded — a slice-only gather backward would drop the
+            # off-rank contributions
+            x_g = mappings.gather_from_sequence_parallel_region(
+                x, self.ep_axis, seq_dim=0, to_model_parallel=True)
+            gates_g = mappings.gather_from_sequence_parallel_region(
+                gates, self.ep_axis, seq_dim=0, to_model_parallel=True)
+            idx_g = comm.all_gather(idx, self.ep_axis, dim=0)  # int: no grad
+            y = self._local_expert_partial(x_g, gates_g, idx_g, gate_up,
+                                           down, i_local, e_local, off)
+            # sum partial expert outputs over ep AND return to token shards
+            y = mappings.reduce_scatter_to_sequence_parallel_region(
+                y, self.ep_axis, seq_dim=0)
+            return y.astype(self.dtype), aux
+
+        # per-chunk: tokens ride the (quantized, optionally decomposed)
+        # dispatch; the tiny [T, K] routing metadata stays full-precision
+        # on a monolithic gather (negligible bytes, and the gates keep
+        # their reduce-scatter backward for the router gradient)
+        n = comm._axis_size(self.ep_axis)
+        t_local = x.shape[0]
+        gates_g = mappings.gather_from_sequence_parallel_region(
+            gates, self.ep_axis, seq_dim=0, to_model_parallel=True)
+        idx_g = comm.all_gather(idx, self.ep_axis, dim=0)
+        chunks = ep_dispatch.gather_token_chunks(
+            x, self.ep_axis, wire=wire, overlap=overlap)
+        ys = []
+        for ti in range(n):
+            src = (r + ti) % n          # chunk ti's source rank (hop order)
+            start = src * t_local
+            g_t = jax.lax.dynamic_slice_in_dim(gates_g, start, t_local, 0)
+            i_t = jax.lax.dynamic_slice_in_dim(idx_g, start, t_local, 0)
+            ys.append(self._local_expert_partial(
+                chunks[ti], g_t, i_t, gate_up, down, i_local, e_local, off))
+        # dual combine: ys[ti] returns to rank (r + ti) % n and sums over
+        # source ranks in ascending-rank (psum_scatter) order
+        y = ep_dispatch.combine_token_chunks(
+            tuple(ys), self.ep_axis, wire=wire, overlap=overlap)
         return y.astype(self.dtype), aux

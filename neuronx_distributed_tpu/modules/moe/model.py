@@ -63,6 +63,10 @@ class MoE(nn.Module):
     dispatch_mode: str = "capacity"  # or "blockwise" (dropless)
     block_size: int = 512
     sentinel_empty: bool = False  # decode: DMA-elide unhit experts
+    # EP dispatch wire ("fp32" | "int8" | "fp8") + ring overlap (None =
+    # auto); blockwise-EP only — see parallel/ep_dispatch.py
+    ep_wire_dtype: str = "fp32"
+    ep_overlap: Optional[bool] = None
     # expert bank implementation: "float" (ExpertMLPs), "mx_fp4"/"mx_fp8"
     # (packed microscaling weights, quantization.mx_layers.MXExpertMLPs)
     expert_impl: str = "float"
@@ -113,6 +117,8 @@ class MoE(nn.Module):
                 dispatch_mode=self.dispatch_mode,
                 block_size=self.block_size,
                 sentinel_empty=self.sentinel_empty,
+                ep_wire_dtype=self.ep_wire_dtype,
+                ep_overlap=self.ep_overlap,
                 dtype=self.dtype, param_dtype=self.param_dtype,
                 name="experts")
         y, eaux = experts(flat, gates, idx)

@@ -7,12 +7,15 @@ shard's experts, then invert after the MoE block.
 
 TPU-native: the permutation is a seeded on-device ``jax.random.permutation``
 plus an all-to-all over the shuffle axis (dp_exp in the expert mesh view);
-the inverse uses the same seed.
+the inverse uses the same seed. Passing the training step to
+:func:`token_shuffle` makes the permutation deterministic per (seed, step)
+— replaying a step (checkpoint resume, SDC rewind) reproduces the exact
+shuffle instead of consuming a stateful key stream.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -23,10 +26,19 @@ from ...parallel import random as prandom
 
 
 def token_shuffle(x: jax.Array, key: jax.Array,
-                  axis: str = ps.EXP_DP_AXIS) -> Tuple[jax.Array, jax.Array]:
+                  axis: str = ps.EXP_DP_AXIS,
+                  step: Optional[jax.Array] = None,
+                  ) -> Tuple[jax.Array, jax.Array]:
     """Shuffle tokens [T, H] across the shuffle axis; returns
-    ``(shuffled, perm)`` where ``perm`` inverts the local permutation."""
+    ``(shuffled, perm)`` where ``perm`` inverts the local permutation.
+
+    ``step`` (int or traced scalar): folds the step counter into the key
+    so a fixed base seed yields a *deterministic-per-step* permutation —
+    step ``s`` always shuffles the same way (resume/replay-safe), while
+    distinct steps stay decorrelated."""
     t = x.shape[0]
+    if step is not None:
+        key = jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))
     # decorrelate the local permutation per shard — identical permutations
     # on every shard would degenerate cross-shard mixing to the fixed
     # block all-to-all

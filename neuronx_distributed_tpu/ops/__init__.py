@@ -5,12 +5,15 @@ reference implementation for CPU/interpret-mode testing, mirroring the
 reference's torch golden fallbacks (``moe/blockwise.py:326``).
 """
 
+from . import blockwise_moe
 from . import collective_matmul
 from . import flash_attention
 from . import flash_decoding
 from . import operators
 from . import ring_attention
 from . import ulysses
+from .blockwise_moe import (grouped_glu, grouped_glu_decode,
+                            grouped_glu_reference)
 from .collective_matmul import (all_gather_matmul, copy_matmul,
                                 matmul_all_reduce, matmul_reduce_scatter,
                                 overlap_engaged, shapes_tile,
@@ -21,8 +24,10 @@ from .ring_attention import ring_attention as ring_attention_fn
 from .ring_attention import ring_attention_pallas
 from .ulysses import ulysses_attention
 
-__all__ = ["collective_matmul", "flash_attention", "flash_decoding",
-           "operators", "ring_attention", "ulysses", "all_gather_matmul",
+__all__ = ["blockwise_moe", "collective_matmul", "flash_attention",
+           "flash_decoding", "operators", "ring_attention", "ulysses",
+           "grouped_glu", "grouped_glu_decode", "grouped_glu_reference",
+           "all_gather_matmul",
            "copy_matmul", "matmul_all_reduce", "matmul_reduce_scatter",
            "overlap_engaged", "shapes_tile", "will_decompose",
            "flash_attention_fn", "flash_decode_attention",

@@ -1,0 +1,645 @@
+"""Blockwise (dropless) MoE expert kernels: Pallas grouped GLU + reference.
+
+The expert matmul of the dropless MoE path (reference NKI kernel family
+``modules/moe/blockwise.py:856``): tokens arrive sorted by expert in
+fixed-size blocks (``modules/moe/blockwise.py`` computes the metadata), and
+each block runs ``silu(x@Wg_e)·(x@Wu_e) @ Wd_e`` with the weights of the
+expert that owns it. TPU-native design, following the
+:mod:`.paged_attention` pattern:
+
+* the block→expert table is **scalar-prefetched** into SMEM
+  (``pltpu.PrefetchScalarGridSpec``), so the weight BlockSpec index_maps
+  read ``block_expert[b]`` and each block streams exactly its expert's
+  weight tiles from HBM — consecutive blocks of one expert elide the
+  re-fetch (one expert-weight DMA per block *run*, not per block);
+* the intermediate dim is tiled (grid dim ``ib``) so weight tiles fit VMEM
+  at 7B/70B sizes; the backward is the same pattern transposed — dx is a
+  grouped matmul against the transposed weights, dW accumulates per expert
+  by *output revisiting* (consecutive blocks of one expert map to the same
+  output tile, which Mosaic keeps in VMEM and flushes once);
+* a **pure-jnp reference** mirrors the kernel's arithmetic exactly — same
+  per-``(b, ib)`` ``dot_general`` shapes, same fp32 accumulation order, same
+  sentinel skips — so interpret-mode parity is *bitwise*, and the reference
+  doubles as the silent CPU fallback (auto-dispatch below);
+* **auto-dispatch**: ``force_pallas=None`` runs the Pallas kernel on
+  TPU-like backends and the jnp reference elsewhere; ``True`` forces the
+  kernel (interpret mode off-TPU — the parity-test hook); ``False`` forces
+  the reference.
+
+Weight layouts are the stacked expert banks of
+:class:`...modules.moe.expert_mlps.ExpertMLPs`: ``gate_up [E, H, 2, I]``,
+``down [E, I, H]``. Blocks whose ``block_expert[b] >= E`` are *sentinels*
+(padding or non-local EP pairs): their compute is skipped and their output
+rows are zero; their weight-tile index clamps to the last real expert so a
+sentinel run costs no extra DMA.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .pallas_utils import compiler_params as _compiler_params
+
+__all__ = ["grouped_glu", "grouped_glu_decode", "grouped_glu_reference",
+           "use_pallas"]
+
+
+def _silu(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def _dsilu(x):
+    s = jax.nn.sigmoid(x)
+    return s * (1 + x * (1 - s))
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels (training fwd/bwd + decode fwd)
+# ---------------------------------------------------------------------------
+
+def _glu_fwd_kernel(be_ref, x_ref, gu_ref, dn_ref, y_ref, *, num_ib: int,
+                    num_real: int):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    ib = pl.program_id(1)
+
+    @pl.when(ib == 0)
+    def _init():
+        # unconditional: sentinel blocks' outputs must be ZERO (their
+        # combine gates are zero, but 0 * uninitialized-HBM could be NaN)
+        y_ref[...] = jnp.zeros_like(y_ref)
+
+    @pl.when(be_ref[b] < num_real)
+    def _compute():
+        x = x_ref[...].astype(jnp.float32)            # [B, H]
+        gu = gu_ref[0].astype(jnp.float32)            # [H, 2, bI]
+        g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        a = _silu(g) * u                              # [B, bI]
+        y_ref[...] = y_ref[...] + jax.lax.dot_general(
+            a, dn_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(y_ref.dtype)
+
+
+def _glu_dx_kernel(be_ref, x_ref, gu_ref, dn_ref, dy_ref, dx_ref, *,
+                   num_ib: int, num_real: int):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    ib = pl.program_id(1)
+
+    @pl.when(ib == 0)
+    def _init():
+        dx_ref[...] = jnp.zeros_like(dx_ref)
+
+    @pl.when(be_ref[b] < num_real)
+    def _compute():
+        x = x_ref[...].astype(jnp.float32)
+        dy = dy_ref[...].astype(jnp.float32)
+        gu = gu_ref[0].astype(jnp.float32)            # [H, 2, bI]
+        dn = dn_ref[0].astype(jnp.float32)            # [bI, H]
+        g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        da = jax.lax.dot_general(dy, dn, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dg = da * u * _dsilu(g)
+        du = da * _silu(g)
+        dx = jax.lax.dot_general(dg, gu[:, 0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dx = dx + jax.lax.dot_general(du, gu[:, 1], (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dx_ref[...] = dx_ref[...] + dx.astype(dx_ref.dtype)
+
+
+def _glu_dw_kernel(be_ref, x_ref, gu_ref, dn_ref, dy_ref, dgu_ref, ddn_ref,
+                   *, num_ib: int, num_real: int):
+    """Grid (ib, b): consecutive b of one expert revisit the same dW output
+    block, accumulating in VMEM; zero it on the expert's first block."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(1)
+    # boundaries on the CLAMPED expert id (what the out index_map uses):
+    # sentinel blocks share the last real expert's tile, so the real->
+    # sentinel transition must NOT re-zero that expert's accumulated dW
+    cur = jnp.minimum(be_ref[b], num_real - 1)
+    prev = jnp.minimum(be_ref[jnp.maximum(b, 1) - 1], num_real - 1)
+    first_of_expert = jnp.logical_or(b == 0, prev != cur)
+
+    @pl.when(first_of_expert)
+    def _init():
+        dgu_ref[...] = jnp.zeros_like(dgu_ref)
+        ddn_ref[...] = jnp.zeros_like(ddn_ref)
+
+    @pl.when(be_ref[b] < num_real)
+    def _compute():
+        x = x_ref[...].astype(jnp.float32)
+        dy = dy_ref[...].astype(jnp.float32)
+        gu = gu_ref[0].astype(jnp.float32)
+        dn = dn_ref[0].astype(jnp.float32)
+        g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        a = _silu(g) * u
+        da = jax.lax.dot_general(dy, dn, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dg = da * u * _dsilu(g)
+        du = da * _silu(g)
+        # ddown[e, ib] += a^T @ dy ; dgu[e, :, 0/1, ib] += x^T @ dg/du
+        ddn_ref[0] = ddn_ref[0] + jax.lax.dot_general(
+            a, dy, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(ddn_ref.dtype)
+        dgw = jax.lax.dot_general(x, dg, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        duw = jax.lax.dot_general(x, du, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dgu_ref[0] = dgu_ref[0] + jnp.stack([dgw, duw], axis=1).astype(
+            dgu_ref.dtype)
+
+
+def _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
+                        block_i, interpret, num_real):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p, h = xs.shape
+    e, _, _, i = gate_up.shape
+    nb = p // block_size
+    num_ib = i // block_i
+    # sentinel blocks (be >= num_real) borrow the LAST real expert's weight
+    # tiles via this clamp — the DMA is elided across a run of sentinel
+    # blocks and the kernels' pl.when guards skip their compute entirely.
+    # Grid order (b, ib): the y block accumulates over consecutive ib steps
+    # in VMEM (a non-consecutive revisit would not re-fetch); weight tiles
+    # are refetched per block — the layout that favours training, where
+    # nb ~ E. Decode uses the (ib, b) grid of :func:`grouped_glu_decode`.
+    we = functools.partial(jnp.minimum, num_real - 1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb, num_ib),
+        in_specs=[
+            pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
+            pl.BlockSpec((1, h, 2, block_i),
+                         lambda b, ib, be: (we(be[b]), 0, 0, ib)),
+            pl.BlockSpec((1, block_i, h),
+                         lambda b, ib, be: (we(be[b]), ib, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
+    )
+    return pl.pallas_call(
+        functools.partial(_glu_fwd_kernel, num_ib=num_ib,
+                          num_real=num_real),
+        out_shape=jax.ShapeDtypeStruct((p, h), xs.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
+    )(block_expert, xs, gate_up, down)
+
+
+def _glu_fwd_decode_kernel(be_ref, x_ref, gu_ref, dn_ref, y_ref, *,
+                           num_real: int):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(1)
+
+    # each (ib, b) output block is written exactly once — no revisits
+    y_ref[...] = jnp.zeros_like(y_ref)
+
+    @pl.when(be_ref[b] < num_real)
+    def _compute():
+        x = x_ref[...].astype(jnp.float32)            # [B, H]
+        gu = gu_ref[0].astype(jnp.float32)            # [H, 2, bI]
+        g = jax.lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        a = _silu(g) * u                              # [B, bI]
+        y_ref[...] = jax.lax.dot_general(
+            a, dn_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(y_ref.dtype)[None]
+
+
+def _grouped_glu_decode_pallas(xs, gate_up, down, block_expert, block_size,
+                               block_i, interpret):
+    """Forward-only grouped GLU tuned for decode HBM traffic.
+
+    Grid order (ib, b) — token blocks INNERMOST — so consecutive blocks of
+    one (clamped) expert keep an identical weight-tile index and Pallas
+    elides the refetch: total weight traffic is (#hit experts) x weights
+    instead of (#blocks) x weights. With ``sentinel_empty`` metadata all
+    empty experts clamp into one shared sentinel run, so a T-token decode
+    step reads only the experts those tokens hit — the bandwidth property
+    the reference's fused token-gen kernel exists for
+    (``moe_fused_tkg.py:85``). Each (ib, b) output block is written exactly
+    once into a partial layout [num_ib, P, H] summed by XLA (an in-kernel
+    accumulation would need non-consecutive output revisits, which do not
+    re-fetch). The extra partial-sum traffic is O(num_ib·P·H) — trivial at
+    decode's tiny P, which is why training keeps :func:`grouped_glu`.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p, h = xs.shape
+    e, _, _, i = gate_up.shape
+    num_real = e
+    nb = p // block_size
+    num_ib = i // block_i
+    we = functools.partial(jnp.minimum, num_real - 1)
+    partial = pl.pallas_call(
+        functools.partial(_glu_fwd_decode_kernel, num_real=num_real),
+        # fp32 partials: the per-ib contributions are summed below, and a
+        # bf16 round-trip through HBM before that sum loses mantissa bits
+        # the kernel already paid fp32 accumulation for (advisor r3)
+        out_shape=jax.ShapeDtypeStruct((num_ib, p, h), jnp.float32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(num_ib, nb),
+            in_specs=[
+                pl.BlockSpec((block_size, h), lambda ib, b, be: (b, 0)),
+                pl.BlockSpec((1, h, 2, block_i),
+                             lambda ib, b, be: (we(be[b]), 0, 0, ib)),
+                pl.BlockSpec((1, block_i, h),
+                             lambda ib, b, be: (we(be[b]), ib, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_size, h),
+                                   lambda ib, b, be: (ib, b, 0)),
+        ),
+        interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
+    )(block_expert, xs, gate_up, down)
+    return jnp.sum(partial, axis=0).astype(xs.dtype)
+
+
+def _grouped_glu_pallas_bwd(xs, gate_up, down, block_expert, dy, block_size,
+                            block_i, interpret, num_real):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    p, h = xs.shape
+    e, _, _, i = gate_up.shape
+    nb = p // block_size
+    num_ib = i // block_i
+    we = functools.partial(jnp.minimum, num_real - 1)
+
+    dx = pl.pallas_call(
+        functools.partial(_glu_dx_kernel, num_ib=num_ib,
+                          num_real=num_real),
+        out_shape=jax.ShapeDtypeStruct((p, h), xs.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nb, num_ib),
+            in_specs=[
+                pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
+                pl.BlockSpec((1, h, 2, block_i),
+                             lambda b, ib, be: (we(be[b]), 0, 0, ib)),
+                pl.BlockSpec((1, block_i, h),
+                             lambda b, ib, be: (we(be[b]), ib, 0)),
+                pl.BlockSpec((block_size, h), lambda b, ib, be: (b, 0)),
+            ],
+            out_specs=pl.BlockSpec((block_size, h),
+                                   lambda b, ib, be: (b, 0)),
+        ),
+        interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
+    )(block_expert, xs, gate_up, down, dy)
+
+    dgu, ddn = pl.pallas_call(
+        functools.partial(_glu_dw_kernel, num_ib=num_ib,
+                          num_real=num_real),
+        out_shape=[jax.ShapeDtypeStruct(gate_up.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(down.shape, jnp.float32)],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(num_ib, nb),
+            in_specs=[
+                pl.BlockSpec((block_size, h), lambda ib, b, be: (b, 0)),
+                pl.BlockSpec((1, h, 2, block_i),
+                             lambda ib, b, be: (we(be[b]), 0, 0, ib)),
+                pl.BlockSpec((1, block_i, h),
+                             lambda ib, b, be: (we(be[b]), ib, 0)),
+                pl.BlockSpec((block_size, h), lambda ib, b, be: (b, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, h, 2, block_i),
+                             lambda ib, b, be: (we(be[b]), 0, 0, ib)),
+                pl.BlockSpec((1, block_i, h),
+                             lambda ib, b, be: (we(be[b]), ib, 0)),
+            ],
+        ),
+        interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
+    )(block_expert, xs, gate_up, down, dy)
+    return dx, dgu.astype(gate_up.dtype), ddn.astype(down.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pure-jnp reference (bit-exact vs the kernels in interpret mode)
+#
+# Every dot below uses the SAME lax.dot_general dimension numbers, operand
+# shapes and fp32 accumulation order as the kernel body executes them per
+# (b, ib) grid step, so CPU parity is bitwise, not approximate: a scan over
+# blocks is the grid's b loop, the unrolled num_ib loop is the grid's ib
+# loop, and sentinel blocks contribute exactly nothing (lax.cond / where,
+# never a masked add that could flip a -0.0).
+# ---------------------------------------------------------------------------
+
+def _ref_block_fwd(x_blk, gu_e, dn_e, live, block_i, num_ib, out_dtype):
+    """One token block through the GLU with its (clamped) expert weights:
+    the per-``ib`` fp32 partials accumulate in ``out_dtype`` exactly like
+    ``y_ref[...] = y_ref[...] + partial.astype(y_ref.dtype)``."""
+    x = x_blk.astype(jnp.float32)
+    y = jnp.zeros((x.shape[0], dn_e.shape[-1]), out_dtype)
+    for ib in range(num_ib):
+        gu = lax.dynamic_slice_in_dim(gu_e, ib * block_i, block_i, axis=2)
+        dn = lax.dynamic_slice_in_dim(dn_e, ib * block_i, block_i, axis=0)
+        gu = gu.astype(jnp.float32)
+        dn = dn.astype(jnp.float32)
+        g = lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        u = lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        a = _silu(g) * u
+        y = y + lax.dot_general(
+            a, dn, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(out_dtype)
+    return jnp.where(live, y, jnp.zeros_like(y))
+
+
+def _ref_fwd(xs, gate_up, down, block_expert, block_size, block_i,
+             num_real):
+    p, h = xs.shape
+    i = gate_up.shape[-1]
+    nb = p // block_size
+    num_ib = i // block_i
+    xb = xs.reshape(nb, block_size, h)
+
+    def step(_, inp):
+        x_blk, be_b = inp
+        we = jnp.minimum(be_b, num_real - 1)
+        gu_e = lax.dynamic_index_in_dim(gate_up, we, 0, keepdims=False)
+        dn_e = lax.dynamic_index_in_dim(down, we, 0, keepdims=False)
+        y = _ref_block_fwd(x_blk, gu_e, dn_e, be_b < num_real, block_i,
+                           num_ib, xs.dtype)
+        return None, y
+
+    _, ys = lax.scan(step, None, (xb, block_expert))
+    return ys.reshape(p, h)
+
+
+def _ref_decode_fwd(xs, gate_up, down, block_expert, block_size, block_i):
+    """Decode reference: per-(ib, b) partials land in a [num_ib, P, H]
+    fp32 layout summed at the end — the same ``jnp.sum(partial, axis=0)``
+    the Pallas decode path performs outside the kernel."""
+    p, h = xs.shape
+    i = gate_up.shape[-1]
+    num_real = gate_up.shape[0]
+    nb = p // block_size
+    num_ib = i // block_i
+    xb = xs.reshape(nb, block_size, h)
+
+    def step(_, inp):
+        x_blk, be_b = inp
+        we = jnp.minimum(be_b, num_real - 1)
+        gu_e = lax.dynamic_index_in_dim(gate_up, we, 0, keepdims=False)
+        dn_e = lax.dynamic_index_in_dim(down, we, 0, keepdims=False)
+        x = x_blk.astype(jnp.float32)
+        parts = []
+        for ib in range(num_ib):
+            gu = lax.dynamic_slice_in_dim(
+                gu_e, ib * block_i, block_i, axis=2).astype(jnp.float32)
+            dn = lax.dynamic_slice_in_dim(
+                dn_e, ib * block_i, block_i, axis=0).astype(jnp.float32)
+            g = lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            u = lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            a = _silu(g) * u
+            y = lax.dot_general(a, dn, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            parts.append(jnp.where(be_b < num_real, y, jnp.zeros_like(y)))
+        return None, jnp.stack(parts)                 # [num_ib, B, H]
+
+    _, parts = lax.scan(step, None, (xb, block_expert))
+    partial = jnp.moveaxis(parts, 1, 0).reshape(num_ib, p, h)
+    return jnp.sum(partial, axis=0).astype(xs.dtype)
+
+
+def _ref_dx(xs, gate_up, down, block_expert, dy, block_size, block_i,
+            num_real):
+    p, h = xs.shape
+    i = gate_up.shape[-1]
+    nb = p // block_size
+    num_ib = i // block_i
+    xb = xs.reshape(nb, block_size, h)
+    dyb = dy.reshape(nb, block_size, h)
+
+    def step(_, inp):
+        x_blk, dy_blk, be_b = inp
+        we = jnp.minimum(be_b, num_real - 1)
+        gu_e = lax.dynamic_index_in_dim(gate_up, we, 0, keepdims=False)
+        dn_e = lax.dynamic_index_in_dim(down, we, 0, keepdims=False)
+        x = x_blk.astype(jnp.float32)
+        dyf = dy_blk.astype(jnp.float32)
+        dx = jnp.zeros((block_size, h), xs.dtype)
+        for ib in range(num_ib):
+            gu = lax.dynamic_slice_in_dim(
+                gu_e, ib * block_i, block_i, axis=2).astype(jnp.float32)
+            dn = lax.dynamic_slice_in_dim(
+                dn_e, ib * block_i, block_i, axis=0).astype(jnp.float32)
+            g = lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            u = lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            da = lax.dot_general(dyf, dn, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            dg = da * u * _dsilu(g)
+            du = da * _silu(g)
+            d = lax.dot_general(dg, gu[:, 0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            d = d + lax.dot_general(du, gu[:, 1], (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            dx = dx + d.astype(xs.dtype)
+        return None, jnp.where(be_b < num_real, dx, jnp.zeros_like(dx))
+
+    _, dxs = lax.scan(step, None, (xb, dyb, block_expert))
+    return dxs.reshape(p, h)
+
+
+def _ref_dw(xs, gate_up, down, block_expert, dy, block_size, block_i,
+            num_real):
+    """dW reference: fp32 accumulators updated block-by-block in ascending
+    ``b`` order (the kernel's grid (ib, b) VMEM accumulation per expert
+    tile is exactly this sequence of fp32 adds); sentinel blocks are
+    skipped via ``lax.cond`` so they contribute no add at all."""
+    p, h = xs.shape
+    i = gate_up.shape[-1]
+    nb = p // block_size
+    num_ib = i // block_i
+    xb = xs.reshape(nb, block_size, h)
+    dyb = dy.reshape(nb, block_size, h)
+
+    def step(carry, inp):
+        dgu, ddn = carry
+        x_blk, dy_blk, be_b = inp
+        we = jnp.minimum(be_b, num_real - 1)
+        gu_e = lax.dynamic_index_in_dim(gate_up, we, 0, keepdims=False)
+        dn_e = lax.dynamic_index_in_dim(down, we, 0, keepdims=False)
+        x = x_blk.astype(jnp.float32)
+        dyf = dy_blk.astype(jnp.float32)
+
+        def upd(c):
+            dgu, ddn = c
+            for ib in range(num_ib):
+                gu = lax.dynamic_slice_in_dim(
+                    gu_e, ib * block_i, block_i, axis=2).astype(jnp.float32)
+                dn = lax.dynamic_slice_in_dim(
+                    dn_e, ib * block_i, block_i, axis=0).astype(jnp.float32)
+                g = lax.dot_general(x, gu[:, 0], (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+                u = lax.dot_general(x, gu[:, 1], (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+                a = _silu(g) * u
+                da = lax.dot_general(dyf, dn, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+                dg = da * u * _dsilu(g)
+                du = da * _silu(g)
+                ddn_c = lax.dot_general(a, dyf, (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+                dgw = lax.dot_general(x, dg, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+                duw = lax.dot_general(x, du, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+                dgu_c = jnp.stack([dgw, duw], axis=1)     # [H, 2, bI]
+                tile = lax.dynamic_slice(
+                    dgu, (we, 0, 0, ib * block_i), (1, h, 2, block_i))
+                dgu = lax.dynamic_update_slice(
+                    dgu, tile + dgu_c[None], (we, 0, 0, ib * block_i))
+                tile = lax.dynamic_slice(
+                    ddn, (we, ib * block_i, 0), (1, block_i, h))
+                ddn = lax.dynamic_update_slice(
+                    ddn, tile + ddn_c[None], (we, ib * block_i, 0))
+            return dgu, ddn
+
+        carry = lax.cond(be_b < num_real, upd, lambda c: c, (dgu, ddn))
+        return carry, None
+
+    init = (jnp.zeros(gate_up.shape, jnp.float32),
+            jnp.zeros(down.shape, jnp.float32))
+    (dgu, ddn), _ = lax.scan(step, init, (xb, dyb, block_expert))
+    return dgu.astype(gate_up.dtype), ddn.astype(down.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrappers: one pallas-backed, one reference-backed, identical
+# signatures, so autodiff works through whichever path auto-dispatch picks
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _grouped_glu_kernel(xs, gate_up, down, block_expert, block_size,
+                        block_i, interpret):
+    return _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
+                               block_i, interpret, gate_up.shape[0])
+
+
+def _kernel_fwd(xs, gate_up, down, block_expert, block_size, block_i,
+                interpret):
+    ys = _grouped_glu_pallas(xs, gate_up, down, block_expert, block_size,
+                             block_i, interpret, gate_up.shape[0])
+    return ys, (xs, gate_up, down, block_expert)
+
+
+def _kernel_bwd(block_size, block_i, interpret, res, dy):
+    xs, gate_up, down, block_expert = res
+    dx, dgu, ddn = _grouped_glu_pallas_bwd(
+        xs, gate_up, down, block_expert, dy, block_size, block_i, interpret,
+        gate_up.shape[0])
+    dbe = jnp.zeros(block_expert.shape, jax.dtypes.float0)
+    return dx, dgu, ddn, dbe
+
+
+_grouped_glu_kernel.defvjp(_kernel_fwd, _kernel_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def grouped_glu_reference(xs, gate_up, down, block_expert, block_size,
+                          block_i):
+    """Pure-jnp grouped GLU, arithmetic-identical to the Pallas kernel
+    (the golden reference of the interpret-mode parity gate, and the
+    silent CPU fallback of :func:`grouped_glu`)."""
+    return _ref_fwd(xs, gate_up, down, block_expert, block_size, block_i,
+                    gate_up.shape[0])
+
+
+def _ref_vjp_fwd(xs, gate_up, down, block_expert, block_size, block_i):
+    ys = _ref_fwd(xs, gate_up, down, block_expert, block_size, block_i,
+                  gate_up.shape[0])
+    return ys, (xs, gate_up, down, block_expert)
+
+
+def _ref_vjp_bwd(block_size, block_i, res, dy):
+    xs, gate_up, down, block_expert = res
+    num_real = gate_up.shape[0]
+    dx = _ref_dx(xs, gate_up, down, block_expert, dy, block_size, block_i,
+                 num_real)
+    dgu, ddn = _ref_dw(xs, gate_up, down, block_expert, dy, block_size,
+                       block_i, num_real)
+    dbe = jnp.zeros(block_expert.shape, jax.dtypes.float0)
+    return dx, dgu, ddn, dbe
+
+
+grouped_glu_reference.defvjp(_ref_vjp_fwd, _ref_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# auto-dispatch (the ops/paged_attention.py idiom)
+# ---------------------------------------------------------------------------
+
+def use_pallas(force_pallas=None) -> bool:
+    """Resolve the dispatch knob: ``None`` (auto) → Pallas only on
+    TPU-like backends, silent jnp reference elsewhere; ``True`` → always
+    the kernel (interpret mode off-TPU — the bit-exactness test hook);
+    ``False`` → always the reference."""
+    if force_pallas is None:
+        return jax.default_backend() in ("tpu", "axon")
+    return bool(force_pallas)
+
+
+def grouped_glu(xs, gate_up, down, block_expert, block_size, block_i,
+                force_pallas=None):
+    """Block-sparse grouped GLU: ``ys[b] = silu(x_b@Wg_e)·(x_b@Wu_e) @ Wd_e``
+    with ``e = block_expert[b]`` (the dropless expert matmul; training
+    fwd+bwd).
+
+    Blocks whose ``block_expert[b] >= E`` (the weight arrays' expert count)
+    are *sentinels* (padding / bound-EP non-local pairs): their compute is
+    skipped and their output rows are zero. Deriving the sentinel threshold
+    from the array shape (rather than a parameter) guarantees every real
+    expert owns >= 1 block, so no dW tile is left unwritten."""
+    if use_pallas(force_pallas):
+        interpret = jax.default_backend() not in ("tpu", "axon")
+        return _grouped_glu_kernel(xs, gate_up, down, block_expert,
+                                   block_size, block_i, interpret)
+    return grouped_glu_reference(xs, gate_up, down, block_expert,
+                                 block_size, block_i)
+
+
+def grouped_glu_decode(xs, gate_up, down, block_expert, block_size,
+                       block_i, force_pallas=None):
+    """Forward-only grouped GLU tuned for decode HBM traffic (token blocks
+    innermost so one expert's weight DMA serves its whole block run; pair
+    with ``sentinel_empty`` metadata so only hit experts are read)."""
+    if use_pallas(force_pallas):
+        interpret = jax.default_backend() not in ("tpu", "axon")
+        return _grouped_glu_decode_pallas(xs, gate_up, down, block_expert,
+                                          block_size, block_i, interpret)
+    return _ref_decode_fwd(xs, gate_up, down, block_expert, block_size,
+                           block_i)

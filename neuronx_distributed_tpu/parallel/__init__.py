@@ -3,6 +3,7 @@
 from . import mesh
 from . import comm
 from . import comm_compressed
+from . import ep_dispatch
 from . import mappings
 from . import grads
 from . import layers
@@ -39,6 +40,7 @@ __all__ = [
     "comm",
     "comm_compressed",
     "CompressionConfig",
+    "ep_dispatch",
     "mappings",
     "initialize_distributed",
     "initialize_model_parallel",

@@ -1,0 +1,316 @@
+"""Expert-parallel token dispatch: overlapped, quantized EP collectives.
+
+Token dispatch is the largest activation collective of the dropless MoE
+path: every EP rank gathers every token shard over ``ep`` (the
+reference-style no-a2a layout of ``ExpertMLPs._forward_blockwise_ep``) and
+reduce-scatters per-rank partial expert outputs back. This module gives
+that pair the two treatments the codebase already proved on the TP
+collectives (PR 5 / PR 9, :mod:`..ops.collective_matmul`):
+
+* **decomposed rings**: the gather/combine run as ``ppermute`` rings inside
+  shard_map, exposing each arriving token chunk as its own array so the
+  expert compute for chunk ``t`` overlaps the ``t+1``-th hop through XLA's
+  latency-hiding scheduler (no barrier between hops and compute);
+* **wire quantization**: dispatch/combine payloads ride the shared
+  :mod:`.wire_codec` (int8/fp8 values + per-block fp32 scales, the EQuARX
+  recipe) — ~3.9x fewer dispatch bytes at int8's default 256-element
+  blocks.
+
+Parity contracts (tested in ``tests/test_moe.py``):
+
+* fp32 wire: the ring is pure payload movement, bitwise identical to the
+  monolithic ``all_gather``; the ring combine materializes contributions
+  into a source-rank-indexed buffer and sums them with
+  :func:`_ordered_sum` — the ascending-rank order ``psum_scatter``
+  implements — so the fp32 fallback is bitwise identical to the
+  unoverlapped collective;
+* quantized wire: every chunk crosses the codec exactly once in either
+  impl (``DQ(Q(chunk))``), and both impls sum through the same
+  DUS-materialized buffer, so ring == monolithic bitwise for dispatch and
+  combine, forward and backward.
+
+The two collectives are exact ``custom_vjp`` duals: the backward of the
+chunked gather is the chunked combine of the cotangents (and vice versa),
+riding the same wire config — quantized dispatch quantizes its backward
+too, which is what keeps the wire ratio symmetric in training.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import comm
+from .wire_codec import (CompressionConfig, decode_payload, encode_payload,
+                         payload_wire_bytes)
+
+__all__ = ["wire_config", "overlap_engaged", "gather_token_chunks",
+           "combine_token_chunks", "MIN_AUTO_AXIS_SIZE"]
+
+#: auto mode (``overlap=None``) engages the ring only at axis sizes where
+#: it has enough hops to pipeline (same threshold as the TP rings in
+#: ``ops/collective_matmul.py``).
+MIN_AUTO_AXIS_SIZE = 4
+
+
+def wire_config(dtype: Optional[str],
+                block_size: int = 256) -> Optional[CompressionConfig]:
+    """EP-wire config: None (no compression) for ``None``/``"fp32"``, else
+    a hashable :class:`CompressionConfig` safe for ``custom_vjp``
+    nondiff_argnums (mirrors ``ops.collective_matmul.wire_config`` — kept
+    local so ``parallel`` does not import ``ops``)."""
+    if not dtype or dtype == "fp32":
+        return None
+    return CompressionConfig(dtype=dtype, block_size=int(block_size),
+                             hierarchical=False, error_feedback=False)
+
+
+def _norm_wire(wire: Optional[CompressionConfig]
+               ) -> Optional[CompressionConfig]:
+    return wire if (wire is not None and wire.quantized) else None
+
+
+def overlap_engaged(overlap: Optional[bool], axis) -> bool:
+    """Layer-level engagement of the decomposed (ring) dispatch.
+
+    ``None`` (auto): on when the axis is bound with size ≥
+    ``MIN_AUTO_AXIS_SIZE``; ``True``: on whenever the axis is bound with
+    size > 1 (never an error — size-1 axes are identity); ``False``: off.
+    """
+    if overlap is False:
+        return False
+    n = comm._axis_size(axis)
+    if n is None or n <= 1:
+        return False
+    if overlap is None:
+        return n >= MIN_AUTO_AXIS_SIZE
+    return True
+
+
+# ---------------------------------------------------------------------------
+# ring plumbing (the decomposed-collective idiom of ops/collective_matmul)
+# ---------------------------------------------------------------------------
+
+def _shift_perm(n: int, shift: int):
+    """ppermute pairs moving every shard ``shift`` ranks forward."""
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def _ship(pair, axis, perm):
+    """ppermute a ``(q, scales)`` wire pair one ring step; scales are
+    absent (None) on the fp path, which then matches the uncompressed ring
+    byte-for-byte."""
+    q, s = pair
+    q = comm.ppermute(q, axis, perm)
+    if s is not None:
+        s = comm.ppermute(s, axis, perm)
+    return q, s
+
+
+def _open(pair, wire, dtype):
+    q, s = pair
+    return decode_payload(q, s, wire, dtype)
+
+
+def _ordered_sum(buf, n: int):
+    """Left-to-right ascending-source-rank summation of a ``[n, ...]``
+    contribution buffer (see ``ops.collective_matmul._ordered_sum``: the
+    DUS-materialized buffer keeps the dequantization multiply out of the
+    accumulation adds, so the sum is bitwise identical whichever program —
+    ring or monolithic all-to-all — produced the buffer, and matches
+    ``psum_scatter``'s ascending-rank accumulation on the fp32 path)."""
+    buf = lax.optimization_barrier(buf)
+    acc = buf[0]
+    for r in range(1, n):
+        acc = acc + buf[r]
+    return acc
+
+
+def _rank(axis):
+    return comm.combined_axis_index(axis)
+
+
+# ---------------------------------------------------------------------------
+# gather: token shards -> per-source chunks (hop order)
+# ---------------------------------------------------------------------------
+
+def _gather_impl(x, axis, wire, decomposed) -> Tuple[jax.Array, ...]:
+    """All-gather ``x`` over ``axis`` as a TUPLE of per-source chunks in
+    *hop order*: element ``t`` is rank ``(me + t) % n``'s shard (element 0
+    is the caller's own, round-tripped through the codec like every other
+    chunk). Exposing chunks as separate arrays — instead of one
+    concatenated buffer — is what lets per-chunk consumer compute overlap
+    the remaining hops: chunk ``t`` depends on ``t`` ppermutes only."""
+    n = comm._axis_size(axis)
+    if n is None or n <= 1:
+        return (x,)
+    if decomposed:
+        pair = encode_payload(x, wire)
+        chunks = [_open(pair, wire, x.dtype)]
+        perm = _shift_perm(n, -1)
+        for _ in range(1, n):
+            pair = _ship(pair, axis, perm)
+            chunks.append(_open(pair, wire, x.dtype))
+        return tuple(chunks)
+    # monolithic: encode once, all-gather the (q, scales) pair, decode per
+    # chunk — each chunk is DQ(Q(shard)) exactly as the ring delivers it
+    me = _rank(axis)
+    q, s = encode_payload(x, wire)
+    qg = comm.all_gather(q, axis, dim=0).reshape((n,) + q.shape)
+    sg = (comm.all_gather(s, axis, dim=0).reshape((n,) + s.shape)
+          if s is not None else None)
+    chunks = []
+    for t in range(n):
+        src = (me + t) % n
+        qt = lax.dynamic_index_in_dim(qg, src, 0, keepdims=False)
+        st = (lax.dynamic_index_in_dim(sg, src, 0, keepdims=False)
+              if sg is not None else None)
+        chunks.append(decode_payload(qt, st, wire, x.dtype))
+    return tuple(chunks)
+
+
+# ---------------------------------------------------------------------------
+# combine: per-destination partial outputs -> own token shard (reduced)
+# ---------------------------------------------------------------------------
+
+def _combine_impl(ys, axis, wire, decomposed) -> jax.Array:
+    """Reduce-scatter the per-destination partials ``ys`` (tuple in hop
+    order: ``ys[t]`` is this rank's contribution to rank ``(me + t) % n``'s
+    tokens) back to the caller's own token shard, summing contributions
+    over source ranks in ascending-rank order."""
+    n = comm._axis_size(axis)
+    if n is None or n <= 1:
+        return ys[0]
+    me = _rank(axis)
+    shape = ys[0].shape
+    dtype = ys[0].dtype
+    buf = jnp.zeros((n,) + shape, dtype)
+    zeros = (0,) * len(shape)
+    if decomposed:
+        for t in range(n):
+            pair = encode_payload(ys[t], wire)
+            if t:
+                # direct delivery: shift +t lands this contribution at its
+                # destination in ONE hop (rank me receives, from rank
+                # me - t, that rank's chunk destined for me)
+                pair = _ship(pair, axis, _shift_perm(n, t))
+            contrib = _open(pair, wire, dtype)
+            src = ((me - t) % n).astype(jnp.int32)
+            buf = lax.dynamic_update_slice(buf, contrib[None],
+                                           (src,) + zeros)
+        return _ordered_sum(buf, n)
+    # monolithic: stack per-destination chunks in destination-rank order,
+    # one all-to-all of the encoded pair, then materialize the decoded
+    # contributions by source rank and ordered-sum — bitwise the ring
+    stacked = jnp.stack(ys)                            # [n, ...] hop order
+    dest_order = jnp.roll(stacked, shift=me, axis=0)   # [r] -> chunk for r
+    q, s = encode_payload(dest_order, wire)
+    qr = comm.all_to_all(q, axis, split_dim=0, concat_dim=0)
+    sr = (comm.all_to_all(s, axis, split_dim=0, concat_dim=0)
+          if s is not None else None)
+    dec = decode_payload(qr, sr, wire, dtype)          # [n, ...] by source
+    for r in range(n):
+        buf = lax.dynamic_update_slice(buf, dec[r:r + 1], (r,) + zeros)
+    return _ordered_sum(buf, n)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp duals
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _gather_chunks(x, axis, wire, decomposed):
+    return _gather_impl(x, axis, wire, decomposed)
+
+
+def _gather_fwd(x, axis, wire, decomposed):
+    return _gather_impl(x, axis, wire, decomposed), None
+
+
+def _gather_bwd(axis, wire, decomposed, _, dchunks):
+    # chunk t came from rank (me + t): its cotangent must return there and
+    # sum over all receivers — exactly the chunked combine of the
+    # cotangents, over the same wire
+    return (_combine_impl(tuple(dchunks), axis, wire, decomposed),)
+
+
+_gather_chunks.defvjp(_gather_fwd, _gather_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _combine_chunks(ys, axis, wire, decomposed):
+    return _combine_impl(ys, axis, wire, decomposed)
+
+
+def _combine_fwd(ys, axis, wire, decomposed):
+    return _combine_impl(ys, axis, wire, decomposed), None
+
+
+def _combine_bwd(axis, wire, decomposed, _, dy):
+    # ys[t] fed rank (me + t)'s output: its cotangent is that rank's dy —
+    # the chunked gather of the cotangents, over the same wire
+    return (_gather_impl(dy, axis, wire, decomposed),)
+
+
+_combine_chunks.defvjp(_combine_fwd, _combine_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public wrappers (+ traced-bytes accounting, public-wrapper-only — the
+# custom_vjp internals are traced per-chunk codec calls that would
+# double-count)
+# ---------------------------------------------------------------------------
+
+def _record_ep_wire(kind: str, shape: Tuple[int, ...],
+                    wire: Optional[CompressionConfig],
+                    passes: float) -> None:
+    from ..obs.accounting import record_wire_bytes
+    from ..obs.metrics import get_registry
+
+    if not get_registry().enabled:
+        return
+    m = 1
+    for d in shape:
+        m *= int(d)
+    wire_b = payload_wire_bytes(shape, wire) * passes
+    raw_b = 4.0 * m * passes
+    record_wire_bytes(kind, wire.dtype if wire is not None else "fp32",
+                      wire_b, raw_b)
+
+
+def gather_token_chunks(x: jax.Array, axis, *,
+                        wire: Optional[CompressionConfig] = None,
+                        overlap: bool = False) -> Tuple[jax.Array, ...]:
+    """Dispatch side of EP: gather the ``[T, ...]`` token shard over
+    ``axis`` as a tuple of per-source chunks in hop order (element ``t`` =
+    rank ``(me + t) % n``'s tokens; n==1/unbound → ``(x,)``, untouched).
+
+    ``wire``: :func:`wire_config` result — int8/fp8 quantizes every hop's
+    payload. ``overlap=True`` runs the ppermute ring (chunk ``t`` is ready
+    after ``t`` hops, so per-chunk expert compute overlaps later hops);
+    ``False`` the monolithic gather — bitwise the same chunks either way.
+    """
+    wire = _norm_wire(wire)
+    n = comm._axis_size(axis)
+    if n is not None and n > 1:
+        _record_ep_wire("ep_dispatch", tuple(x.shape), wire, n - 1)
+    return _gather_chunks(x, axis, wire, bool(overlap))
+
+
+def combine_token_chunks(ys: Tuple[jax.Array, ...], axis, *,
+                         wire: Optional[CompressionConfig] = None,
+                         overlap: bool = False) -> jax.Array:
+    """Combine side of EP: return the per-destination partial outputs
+    (``ys[t]`` → rank ``(me + t) % n``) to their token shards, summed over
+    source ranks in ascending-rank (``psum_scatter``) order. Dual of
+    :func:`gather_token_chunks` (same hop ordering, same wire)."""
+    ys = tuple(ys)
+    wire = _norm_wire(wire)
+    n = comm._axis_size(axis)
+    if n is not None and n > 1:
+        _record_ep_wire("ep_combine", tuple(ys[0].shape), wire, n - 1)
+    return _combine_chunks(ys, axis, wire, bool(overlap))

@@ -199,6 +199,12 @@ class Plan:
     # codec's wire_bytes_per_element
     tp_act_comm_dtype: str = "fp32"     # fp32 | int8 | fp8
     tp_overlap: bool = False
+    # MoE EP-dispatch wire dtype (ParallelConfig.moe_ep_wire_dtype): scales
+    # the EP token-dispatch term by the codec's wire_bytes_per_element
+    ep_wire_dtype: str = "fp32"         # fp32 | int8 | fp8
+    # decomposed (ppermute-ring) EP dispatch hiding hops behind per-chunk
+    # expert compute (ParallelConfig.moe_overlap_dispatch)
+    ep_overlap: bool = False
     sequence_parallel: bool = False
     remat: bool = True
     num_microbatches: int = 1
@@ -216,6 +222,10 @@ class Plan:
             tags.append(f"act:{self.tp_act_comm_dtype}")
         if self.tp_overlap:
             tags.append("overlap")
+        if self.ep_wire_dtype != "fp32":
+            tags.append(f"ep:{self.ep_wire_dtype}")
+        if self.ep_overlap:
+            tags.append("ep-overlap")
         if self.sequence_parallel:
             tags.append("sp")
         return " ".join(tags)
@@ -368,14 +378,40 @@ def pp_comm_s(plan: Plan, m: ModelSpec, hw: HardwareSpec) -> float:
                                   + plan.num_microbatches * hw.ici.latency)
 
 
+#: fraction of the decomposed EP-ring transfer hidden behind the per-chunk
+#: expert matmuls when ep_overlap engages (bench.py --moe reports the
+#: realized moe_overlap_speedup; docs/moe.md)
+EP_OVERLAP_HIDDEN_FRACTION = 0.6
+
+
+def ep_overlap_engagement(plan: Plan) -> bool:
+    """Would the ``moe_overlap_dispatch`` auto knob actually run the
+    ppermute-ring dispatch at this plan's ep degree? Shares
+    ``parallel.ep_dispatch``'s axis-size floor — the planner must never
+    recommend an overlap the layer would silently fall back from."""
+    if plan.ep <= 1:
+        return False
+    from ..parallel.ep_dispatch import MIN_AUTO_AXIS_SIZE
+
+    return plan.ep >= MIN_AUTO_AXIS_SIZE
+
+
 def ep_comm_s(plan: Plan, m: ModelSpec, hw: HardwareSpec) -> float:
     """MoE token dispatch: all-to-all of the routed tokens into the expert
-    groups and back, forward and backward (4 per layer)."""
+    groups and back, forward and backward (4 per layer). A quantized EP
+    wire (``ep_wire_dtype``) shrinks the payload by the codec's
+    per-element accounting; an engaged ring overlap hides
+    ``EP_OVERLAP_HIDDEN_FRACTION`` of the transfer behind the per-chunk
+    expert compute."""
     if plan.ep <= 1 or m.num_experts <= 1:
         return 0.0
     tokens_local = m.tokens_per_step / plan.dp
-    nbytes = tokens_local * m.hidden * m.act_bytes * max(1, m.top_k)
-    return m.layers * 4.0 * all_to_all_s(nbytes, plan.ep, hw.ici)
+    nbytes = (tokens_local * m.hidden * m.act_bytes * max(1, m.top_k)
+              * wire_bytes_per_element(plan.ep_wire_dtype) / 4.0)
+    total = m.layers * 4.0 * all_to_all_s(nbytes, plan.ep, hw.ici)
+    if plan.ep_overlap and ep_overlap_engagement(plan):
+        total *= 1.0 - EP_OVERLAP_HIDDEN_FRACTION
+    return total
 
 
 def compute_s(plan: Plan, m: ModelSpec, hw: HardwareSpec) -> float:

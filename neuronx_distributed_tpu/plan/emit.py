@@ -45,6 +45,12 @@ def plan_to_config_kwargs(plan: Plan) -> Dict[str, Any]:
         kwargs["tp_overlap_comm"] = True
     if plan.tp_act_comm_dtype != "fp32":
         kwargs["tp_activation_comm_dtype"] = plan.tp_act_comm_dtype
+    if plan.ep_wire_dtype != "fp32":
+        kwargs["moe_ep_wire_dtype"] = plan.ep_wire_dtype
+    if plan.ep_overlap:
+        # pinned True when the plan costs the ring discount (same
+        # reasoning as tp_overlap_comm above)
+        kwargs["moe_overlap_dispatch"] = True
     if plan.sequence_parallel:
         kwargs["sequence_parallel"] = True
     opt = OptimizerConfig(

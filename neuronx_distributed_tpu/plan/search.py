@@ -24,7 +24,8 @@ from dataclasses import dataclass, field, replace
 from typing import List, Optional
 
 from .cost import (CostBreakdown, HardwareSpec, ModelSpec, Plan,
-                   ServingSpec, step_cost, tp_overlap_engagement)
+                   ServingSpec, ep_overlap_engagement, step_cost,
+                   tp_overlap_engagement)
 
 PRUNE_INDIVISIBLE = "indivisible"
 PRUNE_OOM = "oom"
@@ -122,12 +123,21 @@ def _strategies(plan: Plan, m: ModelSpec) -> List[Plan]:
     probe = replace(plan, sequence_parallel=sp, tp_overlap=True)
     if tp_overlap_engagement(probe, m):
         overlaps.append(True)
+    # EP dispatch strategy: quantized wire wherever an ep axis exists,
+    # ring overlap only where the layer's auto knob would engage it
+    # (shared predicate — never recommend a silent fallback)
+    ep_pairs = [("fp32", False)]
+    if plan.ep > 1 and m.num_experts > 1:
+        ep_pairs.append(("int8", False))
+        if ep_overlap_engagement(plan):
+            ep_pairs += [("fp32", True), ("int8", True)]
     out = []
-    for dt, act, hi, ov, rm in itertools.product(dtypes, act_dtypes, hiers,
-                                                 overlaps, (False, True)):
+    for dt, act, hi, ov, (ew, eo), rm in itertools.product(
+            dtypes, act_dtypes, hiers, overlaps, ep_pairs, (False, True)):
         out.append(replace(plan, grad_comm_dtype=dt,
                            tp_act_comm_dtype=act,
                            grad_comm_hierarchical=hi, tp_overlap=ov,
+                           ep_wire_dtype=ew, ep_overlap=eo,
                            sequence_parallel=sp, remat=rm,
                            zero1=plan.dp > 1))
     return out
@@ -212,4 +222,5 @@ def search(m: ModelSpec, hw: HardwareSpec, devices: int, *,
 def _plan_key(p: Plan) -> tuple:
     return (p.tp, p.pp, p.dp, p.ep, p.num_microbatches,
             p.grad_comm_dtype, p.tp_act_comm_dtype,
-            p.grad_comm_hierarchical, p.tp_overlap)
+            p.grad_comm_hierarchical, p.tp_overlap,
+            p.ep_wire_dtype, p.ep_overlap)

@@ -62,6 +62,7 @@ def dict_to_config_kwargs(doc: Dict[str, Any]) -> Dict[str, Any]:
                      "dcn_data_parallel_size", "tp_overlap_comm",
                      "tp_activation_comm_dtype",
                      "tp_activation_sync_fraction",
+                     "moe_ep_wire_dtype", "moe_overlap_dispatch",
                      "sequence_parallel", "seed"):
             kwargs[key] = value
         else:
@@ -83,10 +84,12 @@ def config_to_dict(cfg) -> Dict[str, Any]:
             doc[section] = dataclasses.asdict(value)
     for key, value in kwargs.items():
         default = None if key in ("dcn_data_parallel_size",
-                                  "tp_overlap_comm") else (
+                                  "tp_overlap_comm",
+                                  "moe_overlap_dispatch") else (
             False if key == "sequence_parallel" else
             0 if key == "seed" else
-            "fp32" if key == "tp_activation_comm_dtype" else
+            "fp32" if key in ("tp_activation_comm_dtype",
+                              "moe_ep_wire_dtype") else
             1.0 if key == "tp_activation_sync_fraction" else 1)
         if value != default:
             doc[key] = value

@@ -1,0 +1,34 @@
+"""Fixture: EP-dispatch wire violations (never imported, only parsed).
+The ``moe_ep_wire_dtype`` reference below puts a wire-codec config in
+scope, so full-precision monolithic dispatch collectives contradict the
+module's own wire format."""
+
+from jax import lax
+
+EP_WIRE = "int8"  # moe_ep_wire_dtype
+
+
+def exchange_dispatch(dispatch_buf):
+    # raw all_to_all on the dispatch payload while the module configures
+    # a quantized EP wire — ships 4x the bytes and serializes the ring
+    return lax.all_to_all(dispatch_buf, "ep", split_axis=0, concat_axis=0)
+
+
+def rotate_chunks(chunks):
+    # ppermute on the token chunks counts too
+    return lax.ppermute(chunks, "ep", perm=[(0, 1), (1, 0)])
+
+
+def ship_routed(routed_tokens):
+    # any dispatch-flavoured name arms the check
+    return lax.all_to_all(routed_tokens, "ep", split_axis=0, concat_axis=0)
+
+
+def losses_are_fine(loss_parts):
+    # loss/metric exchanges are not dispatch wires: must NOT fire
+    return lax.all_to_all(loss_parts, "dp", split_axis=0, concat_axis=0)
+
+
+def weights_are_fine(kernel):
+    # parameter names don't match the dispatch convention either
+    return lax.ppermute(kernel, "ep", perm=[(0, 1), (1, 0)])

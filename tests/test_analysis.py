@@ -120,6 +120,47 @@ def test_comm_compression_activation_extension_needs_config_in_scope():
         axes=DEFAULT_AXES) == []
 
 
+def test_comm_compression_dispatch_extension_fires_on_fixture():
+    fs = _lint("bad_ep_dispatch.py")
+    assert _rules(fs) == {"comm-compression"}
+    # the three dispatch-named call sites fire; loss/param ones don't
+    assert len([f for f in fs if not f.suppressed]) == 3
+    msgs = " | ".join(f.message for f in fs)
+    assert "EP dispatch payload" in msgs
+    assert "gather_token_chunks" in msgs
+    assert "lax.all_to_all" in msgs and "lax.ppermute" in msgs
+
+
+def test_comm_compression_dispatch_extension_needs_config_in_scope():
+    # identical exchange, no wire-codec config in scope: a plain
+    # all_to_all shuffle is the model's own business
+    quiet = ("from jax import lax\n"
+             "def ship(dispatch_buf):\n"
+             "    return lax.all_to_all(dispatch_buf, 'ep',"
+             " split_axis=0, concat_axis=0)\n")
+    assert analyze_source(quiet, "mymodel/moe.py", axes=DEFAULT_AXES) == []
+    # the EP wire knob arms it
+    armed = ("from jax import lax\n"
+             "EP_WIRE = 'int8'  # moe_ep_wire_dtype\n"
+             "def ship(dispatch_buf):\n"
+             "    return lax.all_to_all(dispatch_buf, 'ep',"
+             " split_axis=0, concat_axis=0)\n")
+    flagged = analyze_source(armed, "mymodel/moe.py", axes=DEFAULT_AXES)
+    assert [f.rule for f in flagged] == ["comm-compression"]
+    # parallel/ composes the ring out of raw ppermutes by design: exempt
+    assert analyze_source(
+        armed, "neuronx_distributed_tpu/parallel/ep_dispatch.py",
+        axes=DEFAULT_AXES) == []
+
+
+def test_moe_package_comm_compression_self_gate():
+    # the MoE modules reference the EP wire knobs, so they are in scope
+    # for the dispatch extension — and must route every dispatch
+    # collective through parallel.ep_dispatch / the parallel wrappers
+    pkg = os.path.join(REPO, "neuronx_distributed_tpu", "modules", "moe")
+    assert analyze_paths([pkg], select=["comm-compression"]) == []
+
+
 def test_models_package_comm_compression_self_gate():
     # the model families reference the activation-wire knobs, so they are
     # in scope for the extension — and must route every activation

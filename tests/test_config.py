@@ -28,3 +28,49 @@ def test_yaml_converter(tmp_path):
         dict_to_config_kwargs({"nope": 1})
     with pytest.raises(ValueError, match="unknown optimizer option"):
         dict_to_config_kwargs({"optimizer": {"typo": True}})
+
+
+def test_moe_ep_dispatch_config_surface(tmp_path):
+    """The EP dispatch knobs validate at config time, round-trip through
+    YAML, and configure_model threads them onto the model config."""
+    import yaml
+
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.mixtral import tiny_moe_config
+    from neuronx_distributed_tpu.scripts.yaml_converter import (
+        config_to_dict, load_yaml_config)
+
+    cfg = nxd.neuronx_distributed_config(
+        expert_parallel_size=2, moe_ep_wire_dtype="int8",
+        moe_overlap_dispatch=True, init_mesh=False)
+    assert cfg.parallel.moe_ep_wire_dtype == "int8"
+    assert cfg.parallel.moe_overlap_dispatch is True
+
+    # validation at construction time
+    with pytest.raises(ValueError, match="moe_ep_wire_dtype"):
+        nxd.neuronx_distributed_config(moe_ep_wire_dtype="int4",
+                                       init_mesh=False)
+    with pytest.raises(ValueError, match="moe_overlap_dispatch"):
+        nxd.neuronx_distributed_config(moe_overlap_dispatch="yes",
+                                       init_mesh=False)
+    with pytest.raises(ValueError, match="expert_parallel_size"):
+        nxd.neuronx_distributed_config(moe_overlap_dispatch=True,
+                                       init_mesh=False)
+
+    # YAML round-trip (and default elision: fp32/None never emitted)
+    doc = config_to_dict(cfg)
+    assert doc["moe_ep_wire_dtype"] == "int8"
+    assert doc["moe_overlap_dispatch"] is True
+    p = tmp_path / "moe.yaml"
+    p.write_text(yaml.safe_dump(doc))
+    back = load_yaml_config(str(p))
+    assert back == cfg
+    plain = nxd.neuronx_distributed_config(init_mesh=False)
+    assert "moe_ep_wire_dtype" not in config_to_dict(plain)
+    assert "moe_overlap_dispatch" not in config_to_dict(plain)
+
+    # configure_model propagation onto the mixtral config
+    mcfg = nxd.configure_model(cfg, tiny_moe_config(
+        moe_dispatch="blockwise", moe_block_size=32))
+    assert mcfg.moe_ep_wire_dtype == "int8"
+    assert mcfg.moe_overlap_dispatch is True

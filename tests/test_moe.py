@@ -227,6 +227,37 @@ def test_token_shuffle_roundtrip():
     assert not np.allclose(np.asarray(sh), np.asarray(x))
 
 
+def test_token_shuffle_deterministic_per_step():
+    """`step=` folds the training step into the key: a fixed (seed, step)
+    always shuffles the same way — checkpoint resume or an SDC rewind
+    replays the exact permutation — while distinct steps decorrelate."""
+    from neuronx_distributed_tpu.modules.moe.token_shuffling import (
+        token_shuffle, token_unshuffle)
+
+    nxd.neuronx_distributed_config(expert_parallel_size=2)
+    em = ps.get_expert_mesh()
+    x = jax.random.normal(jax.random.key(5), (16, 4))
+
+    def run(step):
+        def f(xl):
+            sh, perm = token_shuffle(xl, jax.random.key(0), step=step)
+            return sh, token_unshuffle(sh, perm)
+        return jax.jit(ps.shard_map(
+            f, em, in_specs=P("dp_exp", None),
+            out_specs=(P("dp_exp", None), P("dp_exp", None))))(x)
+
+    sh_a, back_a = run(jnp.uint32(7))
+    sh_b, _ = run(jnp.uint32(7))
+    # replaying step 7 reproduces the exact shuffle, and it still inverts
+    np.testing.assert_array_equal(np.asarray(sh_a), np.asarray(sh_b))
+    np.testing.assert_allclose(np.asarray(back_a), np.asarray(x))
+    # a different step (and the step-less call) shuffle differently
+    sh_c, _ = run(jnp.uint32(8))
+    assert not np.array_equal(np.asarray(sh_a), np.asarray(sh_c))
+    sh_none, _ = run(None)
+    assert not np.array_equal(np.asarray(sh_a), np.asarray(sh_none))
+
+
 @pytest.mark.slow
 def test_dbrx_config_trains():
     from neuronx_distributed_tpu.models.mixtral import (DBRX,

@@ -324,6 +324,88 @@ def test_search_never_proposes_non_engaging_overlap():
                for r in search(odd, HW, 8).ranked)
 
 
+# ---------------------------------------------------------------------------
+# EP dispatch strategy dimension (shared predicate with parallel.ep_dispatch)
+# ---------------------------------------------------------------------------
+
+def test_ep_overlap_engagement_matches_runtime_floor():
+    from neuronx_distributed_tpu.parallel.ep_dispatch import (
+        MIN_AUTO_AXIS_SIZE)
+    from neuronx_distributed_tpu.plan.cost import ep_overlap_engagement
+
+    assert not ep_overlap_engagement(Plan(devices=8, dp=8, ep=1))
+    assert not ep_overlap_engagement(Plan(devices=8, dp=8, ep=2))
+    assert ep_overlap_engagement(
+        Plan(devices=8, dp=8, ep=MIN_AUTO_AXIS_SIZE))
+
+
+def test_ep_dispatch_strategies_ranked():
+    """MoE specs grow the EP dispatch strategy dimension: int8 wire
+    wherever ep > 1, ring overlap only where the runtime auto knob would
+    engage (never a silently-ignored recommendation), and ep=1 layouts
+    never grow the pointless dimension."""
+    from neuronx_distributed_tpu.plan.cost import ep_overlap_engagement
+
+    moe = dataclasses.replace(TINY, name="tiny-moe", num_experts=8,
+                              top_k=2)
+    result = search(moe, HW, 8, top_k=20)
+    assert result.n_enumerated == len(result.ranked) + len(result.rejected)
+    for r in result.ranked:
+        if r.plan.ep <= 1:
+            assert r.plan.ep_wire_dtype == "fp32"
+            assert not r.plan.ep_overlap
+        if r.plan.ep_overlap:
+            assert ep_overlap_engagement(r.plan)
+    assert any(r.plan.ep > 1 and r.plan.ep_wire_dtype == "int8"
+               for r in result.ranked)
+
+
+def test_ep_wire_and_overlap_cost_model():
+    from neuronx_distributed_tpu.plan.cost import (
+        EP_OVERLAP_HIDDEN_FRACTION, ep_comm_s)
+
+    moe = dataclasses.replace(MID, name="mid-moe", num_experts=8, top_k=2)
+    p32 = Plan(devices=8, dp=8, ep=4)
+    p8 = dataclasses.replace(p32, ep_wire_dtype="int8")
+    assert ep_comm_s(p32, moe, HW) > 0
+    # bandwidth term scales by exactly the codec ratio (latency term is
+    # payload-independent and negligible at MID's shapes)
+    ratio = wire_bytes_per_element("int8") / 4.0
+    assert ep_comm_s(p8, moe, HW) == pytest.approx(
+        ep_comm_s(p32, moe, HW) * ratio, rel=1e-2)
+    # engaged ring hides exactly EP_OVERLAP_HIDDEN_FRACTION
+    ring = dataclasses.replace(p8, ep_overlap=True)
+    assert ep_comm_s(ring, moe, HW) == pytest.approx(
+        ep_comm_s(p8, moe, HW) * (1.0 - EP_OVERLAP_HIDDEN_FRACTION))
+    # below the runtime floor the discount never applies
+    small = dataclasses.replace(p8, ep=2, ep_overlap=True)
+    assert ep_comm_s(small, moe, HW) == pytest.approx(
+        ep_comm_s(dataclasses.replace(small, ep_overlap=False), moe, HW))
+    # dense specs charge nothing
+    assert ep_comm_s(p8, MID, HW) == 0.0
+
+
+def test_emit_ep_dispatch_round_trips():
+    from neuronx_distributed_tpu import neuronx_distributed_config
+    from neuronx_distributed_tpu.scripts.yaml_converter import (
+        dict_to_config_kwargs)
+
+    plan = Plan(devices=8, dp=8, ep=4, ep_wire_dtype="int8",
+                ep_overlap=True)
+    kwargs = plan_to_config_kwargs(plan)
+    assert kwargs["moe_ep_wire_dtype"] == "int8"
+    assert kwargs["moe_overlap_dispatch"] is True
+    doc = plan_to_yaml_dict(plan)
+    assert doc["moe_ep_wire_dtype"] == "int8"
+    assert doc["moe_overlap_dispatch"] is True
+    cfg = neuronx_distributed_config(init_mesh=False,
+                                     **dict_to_config_kwargs(doc))
+    assert cfg == plan_to_config(plan)
+    assert cfg.parallel.moe_ep_wire_dtype == "int8"
+    assert cfg.parallel.moe_overlap_dispatch is True
+    assert "ep:int8" in plan.describe() and "ep-overlap" in plan.describe()
+
+
 def test_shapes_tile_matches_will_decompose(monkeypatch):
     """shapes_tile is the public pure form of will_decompose's shape
     gate: with the axis size bound, the two must agree on every shape.

@@ -398,6 +398,42 @@ def test_moe_config_validator():
                                             moe_expert_impl="mx_fp4"))
 
 
+def test_moe_config_validator_ep_dispatch_knobs():
+    """PR-13 knob coherence: the quantized/overlapped EP dispatch lives on
+    the blockwise path and needs real EP ranks — contradictions fail at
+    configure time instead of going silently inert."""
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.mixtral import tiny_moe_config
+    from neuronx_distributed_tpu.modules.moe import validate_moe_config
+
+    blockwise = dict(moe_dispatch="blockwise", moe_block_size=32)
+    # coherent combos pass
+    validate_moe_config(tiny_moe_config(moe_ep_wire_dtype="int8",
+                                        moe_overlap_dispatch=True,
+                                        **blockwise),
+                        nxd.neuronx_distributed_config(
+                            expert_parallel_size=2, init_mesh=False))
+    validate_moe_config(tiny_moe_config(moe_ep_wire_dtype="fp8",
+                                        **blockwise))
+
+    with pytest.raises(ValueError, match="moe_ep_wire_dtype"):
+        validate_moe_config(tiny_moe_config(moe_ep_wire_dtype="int4",
+                                            **blockwise))
+    # wire/overlap on the capacity path would be silently inert
+    with pytest.raises(ValueError, match="blockwise"):
+        validate_moe_config(tiny_moe_config(moe_ep_wire_dtype="int8"))
+    with pytest.raises(ValueError, match="blockwise"):
+        validate_moe_config(tiny_moe_config(moe_overlap_dispatch=True))
+    # pinned overlap needs EP ranks to decompose over
+    with pytest.raises(ValueError, match="expert_parallel_size"):
+        validate_moe_config(
+            tiny_moe_config(moe_overlap_dispatch=True, **blockwise),
+            nxd.neuronx_distributed_config(init_mesh=False))
+    with pytest.raises(ValueError, match="moe_overlap_dispatch"):
+        validate_moe_config(tiny_moe_config(moe_overlap_dispatch="yes",
+                                            **blockwise))
+
+
 def test_per_block_row_parallel_tp_parity():
     """Per-block scales must shard WITH the contraction dim: row-parallel
     at tp=2 keeps each shard's own block scales and matches the unsharded
