@@ -72,7 +72,7 @@ import jax.numpy as jnp  # noqa: E402
 
 def main(chaos_spec=None, serving=False, overlap=False, router=False,
          prefix_heavy=False, plan_mode=False, obs_mode=False,
-         elastic=False, sdc=False, moe=False):
+         elastic=False, sdc=False, moe=False, lint_mode=False):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -327,6 +327,18 @@ def main(chaos_spec=None, serving=False, overlap=False, router=False,
             traceback.print_exc()
             print(f"bench: obs metric failed: {e!r}", file=sys.stderr)
 
+    # nxdlint self-measurement (docs/analysis.md): opt-in via --lint;
+    # wall time + finding count of the three-tier static run over the
+    # whole repo and the wall time of the jaxpr entry-point audit
+    if lint_mode:
+        try:
+            aux.update(lint_metric())
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: lint metric failed: {e!r}", file=sys.stderr)
+
     # gradient-collective microbenchmark (docs/comm_compression.md): time a
     # gradient-sized all-reduce at fp32 vs blockwise int8 and report the
     # wire-byte ratio; degrades to vs_baseline 1.0 on a 1-device mesh
@@ -345,6 +357,40 @@ def main(chaos_spec=None, serving=False, overlap=False, router=False,
         "vs_baseline": round(vs_baseline, 4),
         "aux": aux,
     }), flush=True)
+
+
+def lint_metric():
+    """Static-analysis self-measurement (docs/analysis.md): wall time and
+    unsuppressed finding count of the full nxdlint run over the package +
+    tests + examples (fixture corpus excluded), plus the wall time of the
+    jaxpr-level entry-point audit. Both run as subprocess CLI invocations
+    — the auditor's entry builders construct their own meshes and must
+    not collide with the bench's parallel state. RETURNS aux entries
+    keyed by metric name — never prints a JSON line."""
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    cli = [sys.executable, "-m", "neuronx_distributed_tpu.analysis"]
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        cli + ["neuronx_distributed_tpu", "tests", "examples",
+               "--exclude", "analysis_fixtures", "--format", "json"],
+        cwd=root, capture_output=True, text=True)
+    lint_ms = (time.perf_counter() - t0) * 1000.0
+    n_findings = (len(json.loads(r.stdout)["findings"])
+                  if r.stdout.strip() else -1)
+    t1 = time.perf_counter()
+    subprocess.run(cli + ["--jaxpr"], cwd=root, capture_output=True,
+                   text=True)
+    jaxpr_ms = (time.perf_counter() - t1) * 1000.0
+    return {
+        "lint_wall_ms": {
+            "value": round(lint_ms, 1), "unit": "ms", "vs_baseline": 1.0},
+        "lint_findings": {
+            "value": n_findings, "unit": "findings", "vs_baseline": 1.0},
+        "jaxpr_audit_wall_ms": {
+            "value": round(jaxpr_ms, 1), "unit": "ms", "vs_baseline": 1.0},
+    }
 
 
 def _vs_baseline(fname: str, value: float, platform: str,
@@ -1857,9 +1903,14 @@ if __name__ == "__main__":
              "the serving path, compile events from the tracker, wire-byte "
              "counters vs the codec's predicted int8 ratio; "
              "docs/observability.md)")
+    _p.add_argument(
+        "--lint", action="store_true",
+        help="also self-measure the static-analysis toolchain (nxdlint "
+             "wall time + finding count over the repo, jaxpr entry-point "
+             "audit wall time; docs/analysis.md)")
     _args = _p.parse_args()
     main(chaos_spec=_args.chaos, serving=_args.serving,
          overlap=_args.overlap, router=_args.router,
          prefix_heavy=_args.prefix_heavy, plan_mode=_args.plan,
          obs_mode=_args.obs, elastic=_args.elastic, sdc=_args.sdc,
-         moe=_args.moe)
+         moe=_args.moe, lint_mode=_args.lint)

@@ -1,15 +1,31 @@
 """CLI for nxdlint: ``python -m neuronx_distributed_tpu.analysis [paths]``.
 
-Exit status: 0 when no unsuppressed findings, 1 when findings remain,
-2 on usage errors.
+Three tiers (see docs/analysis.md):
+
+* syntactic + dataflow (default): lint the given paths with the rule
+  set, with the def-use taint engine feeding the rules; pass
+  ``--heuristics-only`` for the name-pattern-only v1 behavior.
+* ``--jaxpr``: abstract-trace the registered entry points on the CPU
+  backend and audit the resulting jaxprs (collective scope, host
+  callbacks, donation, wire precision).
+
+The CI ratchet: ``--baseline FILE --write-baseline`` records the
+current findings; ``--baseline FILE --fail-on-new`` then fails only on
+findings not in the baseline. ``--format json|sarif`` emits
+machine-readable output (SARIF 2.1.0 for code-scanning UIs).
+
+Exit status: 0 when no unsuppressed (or un-baselined) findings, 1 when
+findings remain, 2 on usage errors.
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
+from . import baseline as baseline_mod
+from . import jaxpr_audit, output
 from .core import all_rules, analyze_paths
 
 
@@ -19,12 +35,46 @@ def _split(csv: Optional[str]) -> Optional[List[str]]:
     return [s.strip() for s in csv.split(",") if s.strip()]
 
 
+def _explain(rule_id: str) -> int:
+    rules = all_rules()
+    if rule_id in rules:
+        rule = rules[rule_id]
+        print(f"{rule_id}: {rule.description}")
+        if rule.scope:
+            print(f"  scope: {', '.join(rule.scope)}")
+        if rule.exempt:
+            print(f"  exempt: {', '.join(rule.exempt)}")
+        doc = getattr(sys.modules.get(rule.check.__module__), "__doc__",
+                      None)
+        if doc:
+            print()
+            print(doc.strip())
+        return 0
+    if rule_id in jaxpr_audit.RULES:
+        print(f"{rule_id}: {jaxpr_audit.RULES[rule_id]}")
+        if jaxpr_audit.__doc__:
+            print()
+            print(jaxpr_audit.__doc__.strip())
+        return 0
+    known = sorted(rules) + sorted(jaxpr_audit.RULES)
+    print(f"error: unknown rule {rule_id!r}; known rules: "
+          f"{', '.join(known)}", file=sys.stderr)
+    return 2
+
+
+def _rule_descriptions() -> Dict[str, str]:
+    descs = {name: rule.description
+             for name, rule in all_rules().items()}
+    descs.update(jaxpr_audit.RULES)
+    return descs
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m neuronx_distributed_tpu.analysis",
         description="nxdlint: JAX/SPMD-aware static analysis "
-                    "(mesh-axis, trace-safety, custom-vjp, "
-                    "recompile-hazard, resilience)")
+                    "(syntactic rules + def-use dataflow + optional "
+                    "jaxpr-level program audit)")
     parser.add_argument("paths", nargs="*",
                         help="files or directories to lint")
     parser.add_argument("--select", metavar="RULES",
@@ -35,8 +85,41 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="comma-separated additional canonical axis "
                              "names (also settable via [tool.nxdlint] "
                              "extra_axes in pyproject.toml)")
+    parser.add_argument("--exclude", metavar="PATTERNS", default=None,
+                        help="comma-separated path patterns to skip "
+                             "(directory/file name, or a /-joined path "
+                             "suffix)")
     parser.add_argument("--show-suppressed", action="store_true",
                         help="also print suppressed findings")
+    parser.add_argument("--heuristics-only", action="store_true",
+                        help="disable the def-use dataflow tier and fall "
+                             "back to v1 name-pattern heuristics")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text",
+                        help="output format (default: text)")
+    parser.add_argument("--baseline", metavar="FILE", default=None,
+                        help="baseline file for the CI ratchet")
+    parser.add_argument("--fail-on-new", action="store_true",
+                        help="with --baseline: report and fail only on "
+                             "findings not in the baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="with --baseline: record current findings "
+                             "as the new baseline and exit 0")
+    parser.add_argument("--jaxpr", action="store_true",
+                        help="audit registered entry points at the "
+                             "jaxpr level (abstract tracing on the CPU "
+                             "backend; no user code is executed)")
+    parser.add_argument("--register", metavar="FILE", action="append",
+                        default=None,
+                        help="with --jaxpr: execute FILE to register "
+                             "extra entry points (replaces the default "
+                             "registry for this run; repeatable)")
+    parser.add_argument("--entry", metavar="NAMES", default=None,
+                        help="with --jaxpr: comma-separated entry-point "
+                             "names to audit (default: all registered)")
+    parser.add_argument("--explain", metavar="RULE", default=None,
+                        help="print the rule's description and rationale "
+                             "and exit")
     parser.add_argument("--list-rules", action="store_true",
                         help="print registered rules and exit")
     args = parser.parse_args(argv)
@@ -44,28 +127,79 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.list_rules:
         for name, rule in sorted(all_rules().items()):
             print(f"{name}: {rule.description}")
+        for name in sorted(jaxpr_audit.RULES):
+            print(f"{name}: {jaxpr_audit.RULES[name]} [--jaxpr]")
         return 0
-    if not args.paths:
+    if args.explain:
+        return _explain(args.explain)
+    if not args.paths and not args.jaxpr:
         parser.print_usage(sys.stderr)
         print("error: no paths given", file=sys.stderr)
         return 2
-
-    try:
-        findings = analyze_paths(
-            args.paths,
-            select=_split(args.select),
-            disable=_split(args.disable) or (),
-            extra_axes=_split(args.extra_axes) or ())
-    except ValueError as e:
-        print(f"error: {e}", file=sys.stderr)
+    if (args.fail_on_new or args.write_baseline) and not args.baseline:
+        print("error: --fail-on-new/--write-baseline require --baseline",
+              file=sys.stderr)
         return 2
 
+    findings = []
+    if args.paths:
+        try:
+            findings = analyze_paths(
+                args.paths,
+                select=_split(args.select),
+                disable=_split(args.disable) or (),
+                extra_axes=_split(args.extra_axes) or (),
+                dataflow=not args.heuristics_only,
+                exclude=tuple(_split(args.exclude) or ()))
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    if args.jaxpr:
+        jaxpr_audit.ensure_cpu_backend()
+        if args.register:
+            import runpy
+            for reg in args.register:
+                runpy.run_path(reg)
+        try:
+            findings = findings + jaxpr_audit.audit_entry_points(
+                names=_split(args.entry),
+                include_defaults=not args.register)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
     active = [f for f in findings if not f.suppressed]
+
+    if args.write_baseline:
+        n = baseline_mod.write_baseline(args.baseline, active)
+        print(f"nxdlint: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} "
+              f"({len(active)} finding(s)) to {args.baseline}",
+              file=sys.stderr)
+        return 0
+
+    if args.baseline:
+        try:
+            base = baseline_mod.load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: cannot load baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        active = baseline_mod.new_findings(active, base)
+
     shown = findings if args.show_suppressed else active
-    for f in shown:
-        print(f.format())
-    n_sup = len(findings) - len(active)
-    print(f"nxdlint: {len(active)} finding(s), {n_sup} suppressed",
+    if args.format == "json":
+        print(output.findings_to_json(shown))
+    elif args.format == "sarif":
+        print(output.findings_to_sarif(shown, _rule_descriptions()))
+    else:
+        for f in shown:
+            print(f.format())
+    n_sup = len(findings) - len([f for f in findings if not f.suppressed])
+    label = "new finding(s)" if args.baseline else "finding(s)"
+    print(f"nxdlint: {len(active)} {label}, {n_sup} suppressed",
           file=sys.stderr)
     return 1 if active else 0
 

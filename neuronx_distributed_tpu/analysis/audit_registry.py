@@ -1,0 +1,121 @@
+"""Entry-point registry for the jaxpr-level program auditor.
+
+Subsystems register the functions whose *compiled form* carries
+contracts the syntactic tiers cannot see — the train step, the serving
+engine step, the disaggregated prefill/decode workers, the EP dispatch
+ring. ``python -m neuronx_distributed_tpu.analysis --jaxpr`` builds each
+registered entry point and abstract-traces it with ``jax.make_jaxpr``
+(no execution of the traced function — tracing evaluates shapes/dtypes
+only), then :mod:`.jaxpr_audit` walks the resulting ClosedJaxpr.
+
+Registration is declarative and lazy: ``register_entry_point`` stores a
+zero-argument *builder*; nothing JAX-related happens until the auditor
+asks for the entry point. The default entry points live next to the
+subsystems they audit (``trainer/trainer.py``, ``inference/engine.py``,
+``parallel/ep_dispatch.py``) and are pulled in by
+:func:`load_default_entry_points`.
+
+This module itself has no JAX imports — importing it from a subsystem
+module costs nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+#: default "large buffer" threshold for the donation check (bytes)
+DEFAULT_DONATION_MIN_BYTES = 1 << 20
+
+
+@dataclasses.dataclass
+class BuiltEntry:
+    """What a builder returns: the function to abstract-trace plus the
+    example arguments (arrays or ``jax.ShapeDtypeStruct``s — tracing
+    never reads values)."""
+
+    fn: Callable[..., Any]
+    args: Tuple[Any, ...]
+    donate_argnums: Tuple[int, ...] = ()
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    name: str
+    build: Callable[[], BuiltEntry]
+    description: str = ""
+    tags: Tuple[str, ...] = ()
+    #: when set, ring hops (ppermute/all_to_all) in this entry are
+    #: expected to ship this wire dtype — full-precision hops are flagged
+    wire_dtype: Optional[str] = None
+    #: train-style steps must donate their large input buffers
+    expects_donation: bool = False
+    #: minimum buffer size (bytes) for the donation check
+    donation_min_bytes: int = DEFAULT_DONATION_MIN_BYTES
+    #: minimum element count for the wire-precision check
+    wire_min_elems: int = 64
+    #: ``path:lineno`` of the registration site, for findings
+    source: str = ""
+
+
+_ENTRY_POINTS: Dict[str, EntryPoint] = {}
+_DEFAULTS_LOADED = False
+
+
+def register_entry_point(name: str, *,
+                         description: str = "",
+                         tags: Sequence[str] = (),
+                         wire_dtype: Optional[str] = None,
+                         expects_donation: bool = False,
+                         donation_min_bytes: int =
+                         DEFAULT_DONATION_MIN_BYTES,
+                         wire_min_elems: int = 64,
+                         ) -> Callable[[Callable[[], BuiltEntry]],
+                                       Callable[[], BuiltEntry]]:
+    """Decorator: register ``build`` as the builder for entry ``name``.
+
+    Re-registering a name replaces the previous entry (so re-importing a
+    fixture module in tests is idempotent)."""
+
+    def deco(build: Callable[[], BuiltEntry]) -> Callable[[], BuiltEntry]:
+        try:
+            src = (inspect.getsourcefile(build) or "?",
+                   build.__code__.co_firstlineno)
+            source = f"{src[0]}:{src[1]}"
+        except (TypeError, OSError):
+            source = "?"
+        _ENTRY_POINTS[name] = EntryPoint(
+            name=name, build=build, description=description,
+            tags=tuple(tags), wire_dtype=wire_dtype,
+            expects_donation=expects_donation,
+            donation_min_bytes=donation_min_bytes,
+            wire_min_elems=wire_min_elems, source=source)
+        return build
+    return deco
+
+
+def all_entry_points() -> Dict[str, EntryPoint]:
+    return dict(_ENTRY_POINTS)
+
+
+def get_entry_point(name: str) -> EntryPoint:
+    try:
+        return _ENTRY_POINTS[name]
+    except KeyError:
+        known = sorted(_ENTRY_POINTS)
+        raise KeyError(f"unknown entry point {name!r}; known: {known}")
+
+
+def load_default_entry_points() -> Dict[str, EntryPoint]:
+    """Import the subsystem modules whose module scope registers the
+    default entry points, then return the registry. The imports are the
+    package's own modules (the audited *entry functions* are still only
+    abstract-traced, never executed)."""
+    global _DEFAULTS_LOADED
+    if not _DEFAULTS_LOADED:
+        from ..trainer import trainer as _trainer  # noqa: F401
+        from ..inference import engine as _engine  # noqa: F401
+        from ..parallel import ep_dispatch as _epd  # noqa: F401
+        _DEFAULTS_LOADED = True
+    return dict(_ENTRY_POINTS)

@@ -1,0 +1,90 @@
+"""The nxdlint CI ratchet: a baseline of known findings.
+
+``--fail-on-new`` lets the self-gate extend to directories with
+pre-existing findings (``tests/``, ``examples/``) without a big-bang
+cleanup: existing findings are recorded in ``.nxdlint-baseline.json``
+once, and CI fails only on findings *not* in the baseline. Fixing a
+baselined finding never breaks the build (the baseline is a ceiling,
+not a pin); introducing a new one does.
+
+A finding's fingerprint is ``(normalized path, rule, message)`` with a
+multiplicity count — deliberately *without* line numbers, so unrelated
+edits that shift code down a file do not invalidate the baseline, while
+adding a second identical violation to the same file still fails.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Tuple
+
+from .core import Finding
+
+_VERSION = 1
+
+Fingerprint = Tuple[str, str, str]
+
+
+def _norm_path(path: str) -> str:
+    norm = path.replace("\\", "/")
+    if os.path.isabs(norm):
+        rel = os.path.relpath(norm).replace("\\", "/")
+        if not rel.startswith(".."):
+            norm = rel
+    while norm.startswith("./"):
+        norm = norm[2:]
+    return norm
+
+
+def fingerprint(f: Finding) -> Fingerprint:
+    return (_norm_path(f.path), f.rule, f.message)
+
+
+def counts(findings: Iterable[Finding]) -> Dict[Fingerprint, int]:
+    out: Dict[Fingerprint, int] = {}
+    for f in findings:
+        fp = fingerprint(f)
+        out[fp] = out.get(fp, 0) + 1
+    return out
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> int:
+    """Persist the fingerprints of ``findings``; returns the entry count."""
+    entries = [{"path": p, "rule": r, "message": m, "count": c}
+               for (p, r, m), c in sorted(counts(findings).items())]
+    doc = {"version": _VERSION, "entries": entries}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+    return len(entries)
+
+
+def load_baseline(path: str) -> Dict[Fingerprint, int]:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("version") != _VERSION:
+        raise ValueError(
+            f"unsupported baseline version {doc.get('version')!r} "
+            f"in {path} (expected {_VERSION})")
+    out: Dict[Fingerprint, int] = {}
+    for e in doc.get("entries", ()):
+        out[(e["path"], e["rule"], e["message"])] = int(e.get("count", 1))
+    return out
+
+
+def new_findings(findings: Iterable[Finding],
+                 baseline: Dict[Fingerprint, int]) -> List[Finding]:
+    """Findings beyond the baselined multiplicity of their fingerprint.
+    Within a fingerprint the earliest occurrences (by line) are treated
+    as the baselined ones."""
+    groups: Dict[Fingerprint, List[Finding]] = {}
+    for f in findings:
+        groups.setdefault(fingerprint(f), []).append(f)
+    fresh: List[Finding] = []
+    for fp, fs in groups.items():
+        allowed = baseline.get(fp, 0)
+        fs.sort(key=lambda f: (f.line, f.col))
+        fresh.extend(fs[allowed:])
+    fresh.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return fresh

@@ -49,12 +49,18 @@ class Finding:
 
 @dataclasses.dataclass
 class LintContext:
-    """Per-file state handed to every rule."""
+    """Per-file state handed to every rule.
+
+    ``dataflow`` is the tier-2 :class:`..dataflow.ModuleDataflow` taint
+    state for the file, or ``None`` when the analysis runs in
+    heuristics-only (v1) mode — rules fall back to name regexes then.
+    """
 
     path: str
     source: str
     tree: ast.Module
     axes: FrozenSet[str]
+    dataflow: Optional[object] = None
 
 
 RuleFn = Callable[[LintContext], Iterator[Finding]]
@@ -62,21 +68,65 @@ RuleFn = Callable[[LintContext], Iterator[Finding]]
 
 @dataclasses.dataclass
 class Rule:
+    """A registered rule plus its declarative path scoping.
+
+    ``scope``: run only on paths matching one of these patterns;
+    empty = everywhere. ``exempt``: skip matching paths. A pattern with a
+    ``/`` is a path suffix (``"inference/paging.py"``); one without is a
+    single path component — a directory name or a bare filename
+    (``"parallel"``, ``"aot_cache.py"``). Both are overridable per rule
+    from ``[tool.nxdlint.scope]`` / ``[tool.nxdlint.exempt]``.
+    """
+
     name: str
     description: str
     check: RuleFn
+    scope: Tuple[str, ...] = ()
+    exempt: Tuple[str, ...] = ()
 
 
 _RULES: Dict[str, Rule] = {}
 
 
-def register(name: str, description: str) -> Callable[[RuleFn], RuleFn]:
+def register(name: str, description: str, *,
+             scope: Tuple[str, ...] = (),
+             exempt: Tuple[str, ...] = ()) -> Callable[[RuleFn], RuleFn]:
     def deco(fn: RuleFn) -> RuleFn:
         if name in _RULES:
             raise ValueError(f"duplicate rule name {name!r}")
-        _RULES[name] = Rule(name, description, fn)
+        _RULES[name] = Rule(name, description, fn,
+                            scope=tuple(scope), exempt=tuple(exempt))
         return fn
     return deco
+
+
+def path_matches(path: str, patterns: Iterable[str]) -> bool:
+    """Declarative path matcher for :class:`Rule` scoping."""
+    norm = path.replace("\\", "/")
+    parts = norm.split("/")
+    for pat in patterns:
+        pat = pat.replace("\\", "/").strip("/")
+        if not pat:
+            continue
+        if "/" in pat:
+            if norm == pat or norm.endswith("/" + pat):
+                return True
+        elif pat in parts:
+            return True
+    return False
+
+
+def rule_applies(rule: Rule, path: str,
+                 scope_overrides: Optional[Dict[str, List[str]]] = None,
+                 exempt_overrides: Optional[Dict[str, List[str]]] = None,
+                 ) -> bool:
+    scope = tuple((scope_overrides or {}).get(rule.name, rule.scope))
+    exempt = tuple((exempt_overrides or {}).get(rule.name, rule.exempt))
+    if scope and not path_matches(path, scope):
+        return False
+    if exempt and path_matches(path, exempt):
+        return False
+    return True
 
 
 def all_rules() -> Dict[str, Rule]:
@@ -131,14 +181,53 @@ def parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
     return per_line, file_level
 
 
+#: compound statements whose span would cover their whole body — only the
+#: header (up to the first body statement) participates in span-based
+#: suppression, so a ``disable=`` on an ``if`` line does not silently
+#: suppress the entire block under it.
+_COMPOUND_STMTS = (ast.If, ast.While, ast.For, ast.AsyncFor, ast.With,
+                   ast.AsyncWith, ast.Try, ast.FunctionDef,
+                   ast.AsyncFunctionDef, ast.ClassDef)
+
+
+def statement_spans(tree: ast.Module) -> List[Tuple[int, int]]:
+    """Multi-line spans ``(lineno, end_lineno)`` of simple statements
+    (plus multi-line headers of compound statements). A suppression
+    comment anywhere inside a span covers findings anywhere in it — a
+    ``# nxdlint: disable=`` on the first line of a three-line call must
+    suppress a finding reported at an argument's line."""
+    spans: List[Tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        if isinstance(node, _COMPOUND_STMTS):
+            body = getattr(node, "body", None)
+            end = (min(c.lineno for c in body) - 1) if body else node.lineno
+        else:
+            end = getattr(node, "end_lineno", None) or node.lineno
+        if end > node.lineno:
+            spans.append((node.lineno, end))
+    return spans
+
+
 def _is_suppressed(f: Finding, per_line: Dict[int, Set[str]],
-                   file_level: Set[str]) -> bool:
+                   file_level: Set[str],
+                   spans: Sequence[Tuple[int, int]] = ()) -> bool:
     def hit(rules: Set[str]) -> bool:
         return f.rule in rules or "all" in rules
 
     if hit(file_level):
         return True
-    return hit(per_line.get(f.line, set()))
+    if hit(per_line.get(f.line, set())):
+        return True
+    for s, e in spans:
+        if s <= f.line <= e:
+            joint: Set[str] = set()
+            for ln in range(s, e + 1):
+                joint |= per_line.get(ln, set())
+            if hit(joint):
+                return True
+    return False
 
 
 # --------------------------------------------------------------------------
@@ -181,12 +270,17 @@ def _find_mesh_py(paths: Sequence[str]) -> Optional[str]:
     return None
 
 
-_TOML_LIST_RE = re.compile(r"^\s*(?P<key>[A-Za-z_]+)\s*=\s*\[(?P<body>[^\]]*)\]")
+_TOML_LIST_RE = re.compile(
+    r"^\s*(?P<key>[A-Za-z0-9_\-]+)\s*=\s*\[(?P<body>[^\]]*)\]")
 
 
-def load_pyproject_config(start: str) -> Dict[str, List[str]]:
-    """Minimal ``[tool.nxdlint]`` reader (py3.10: no tomllib). Supported
-    keys: ``extra_axes``, ``disable`` — both lists of strings."""
+def load_pyproject_config(start: str) -> Dict[str, object]:
+    """Minimal ``[tool.nxdlint]`` reader (py3.10: no tomllib).
+
+    ``[tool.nxdlint]`` keys ``extra_axes`` / ``disable`` are lists of
+    strings. The ``[tool.nxdlint.scope]`` / ``[tool.nxdlint.exempt]``
+    subsections map a rule name to a list of path patterns, overriding
+    the rule's declarative defaults (see :class:`Rule`)."""
     d = os.path.abspath(start if os.path.isdir(start)
                         else os.path.dirname(start) or ".")
     pyproject = None
@@ -199,24 +293,34 @@ def load_pyproject_config(start: str) -> Dict[str, List[str]]:
         if parent == d:
             break
         d = parent
-    cfg: Dict[str, List[str]] = {}
+    cfg: Dict[str, object] = {}
     if pyproject is None:
         return cfg
-    in_section = False
+    section = None
     try:
         with open(pyproject, "r", encoding="utf-8") as fh:
             for ln in fh:
                 s = ln.strip()
                 if s.startswith("["):
-                    in_section = (s == "[tool.nxdlint]")
+                    if s == "[tool.nxdlint]":
+                        section = "top"
+                    elif s == "[tool.nxdlint.scope]":
+                        section = "scope"
+                    elif s == "[tool.nxdlint.exempt]":
+                        section = "exempt"
+                    else:
+                        section = None
                     continue
-                if not in_section:
+                if section is None:
                     continue
                 m = _TOML_LIST_RE.match(ln)
-                if m:
-                    vals = re.findall(r"[\"']([^\"']+)[\"']",
-                                      m.group("body"))
+                if not m:
+                    continue
+                vals = re.findall(r"[\"']([^\"']+)[\"']", m.group("body"))
+                if section == "top":
                     cfg[m.group("key")] = vals
+                else:
+                    cfg.setdefault(section, {})[m.group("key")] = vals
     except OSError:
         pass
     return cfg
@@ -227,21 +331,40 @@ def load_pyproject_config(start: str) -> Dict[str, List[str]]:
 # --------------------------------------------------------------------------
 
 def analyze_source(source: str, path: str, axes: FrozenSet[str],
-                   rules: Optional[Iterable[str]] = None) -> List[Finding]:
+                   rules: Optional[Iterable[str]] = None, *,
+                   dataflow: bool = True,
+                   scope_overrides: Optional[Dict[str, List[str]]] = None,
+                   exempt_overrides: Optional[Dict[str, List[str]]] = None,
+                   ) -> List[Finding]:
     _ensure_rules_loaded()
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
         return [Finding(path, e.lineno or 1, e.offset or 0, "parse-error",
                         f"syntax error: {e.msg}")]
-    ctx = LintContext(path=path, source=source, tree=tree, axes=axes)
+    df = None
+    if dataflow:
+        from .dataflow import ModuleDataflow
+        try:
+            df = ModuleDataflow(tree)
+        except RecursionError:  # pathological nesting: fall back to tier 1
+            df = None
+    ctx = LintContext(path=path, source=source, tree=tree, axes=axes,
+                      dataflow=df)
     per_line, file_level = parse_suppressions(source)
+    spans: List[Tuple[int, int]] = []
+    if per_line:
+        lines = set(per_line)
+        spans = [sp for sp in statement_spans(tree)
+                 if any(sp[0] <= ln <= sp[1] for ln in lines)]
     active = (_RULES.keys() if rules is None else rules)
     findings: List[Finding] = []
     for name in active:
         rule = _RULES[name]
+        if not rule_applies(rule, path, scope_overrides, exempt_overrides):
+            continue
         for f in rule.check(ctx):
-            f.suppressed = _is_suppressed(f, per_line, file_level)
+            f.suppressed = _is_suppressed(f, per_line, file_level, spans)
             findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
@@ -265,9 +388,14 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
 def analyze_paths(paths: Sequence[str],
                   select: Optional[Iterable[str]] = None,
                   disable: Iterable[str] = (),
-                  extra_axes: Iterable[str] = ()) -> List[Finding]:
+                  extra_axes: Iterable[str] = (), *,
+                  dataflow: bool = True,
+                  exclude: Iterable[str] = ()) -> List[Finding]:
     """Lint every ``.py`` file under ``paths``. Returns ALL findings; the
-    caller decides what to do with suppressed ones."""
+    caller decides what to do with suppressed ones. ``exclude`` skips
+    files matching the given path patterns (same syntax as
+    :func:`path_matches`); ``dataflow=False`` runs in heuristics-only
+    (v1) mode."""
     _ensure_rules_loaded()
     if not paths:
         raise ValueError("no paths to analyze")
@@ -293,8 +421,13 @@ def analyze_paths(paths: Sequence[str],
         raise ValueError(f"unknown rule(s): {sorted(unknown)}; "
                          f"known: {sorted(_RULES)}")
 
+    scope_over = dict(cfg.get("scope", {}))
+    exempt_over = dict(cfg.get("exempt", {}))
+    exclude = tuple(exclude)
     findings: List[Finding] = []
     for path in iter_python_files(paths):
+        if exclude and path_matches(path, exclude):
+            continue
         try:
             with open(path, "r", encoding="utf-8") as fh:
                 src = fh.read()
@@ -303,5 +436,8 @@ def analyze_paths(paths: Sequence[str],
                                     f"cannot read file: {e}"))
             continue
         findings.extend(analyze_source(src, path, frozenset(axes),
-                                       rules=sorted(names)))
+                                       rules=sorted(names),
+                                       dataflow=dataflow,
+                                       scope_overrides=scope_over,
+                                       exempt_overrides=exempt_over))
     return findings

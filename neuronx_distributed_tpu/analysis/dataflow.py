@@ -1,0 +1,580 @@
+"""nxdlint tier 2: intraprocedural def-use dataflow (value-kind taint).
+
+The tier-1 rules key on identifier *names* ("grads", "dispatch_buf", ...),
+so a single rename defeats them.  This module tracks what a value *is*
+through the statements of each scope, so ``g2 = rename(grads)[0]`` still
+carries the GRADIENT kind and a raw ``lax.pmean(g2, "dp")`` still fires.
+
+Value kinds (the lattice is a powerset of these — union on merge):
+
+* ``GRADIENT``          — outputs of ``jax.grad`` / ``jax.value_and_grad``
+                          and gradient-named seeds.
+* ``ACTIVATION``        — layer-forward outputs (``model.apply``-style
+                          calls) and activation-named seeds.
+* ``DISPATCH_PAYLOAD``  — ``parallel.ep_dispatch.gather_token_chunks``
+                          results and dispatch-named seeds.
+* ``KV_BLOCK``          — paged-KV block handles (name seeds only).
+* ``HOST_TIME``         — ``time.time()``-family wall/CPU clock reads.
+
+Propagation is flow-insensitive within a scope (a fixpoint over the
+scope's statements): aliases, tuple unpacking, ``AugAssign``, arithmetic,
+subscripts, and calls to *local* functions via per-function summaries
+(which arguments pass through to which return elements, plus the kinds
+the body produces intrinsically).
+
+Kind-specific call policy: GRADIENT, DISPATCH_PAYLOAD and HOST_TIME flow
+through arbitrary call sites (a clipped gradient is still a gradient);
+ACTIVATION and KV_BLOCK only flow through identity-ish constructs
+(aliasing, tuple unpack, subscripts, summary passthrough) — ``f(x)`` of
+an activation is usually a loss/score/norm, and ``x`` is far too common
+a name to union through every call.
+
+Provenance: :meth:`ModuleDataflow.provenance` classifies a node as
+``"traced"`` (inside a JAX-traced function per the trace-safety
+analysis) or ``"host"``.
+
+Everything here is stdlib-``ast`` only — the analyzed file is never
+imported or executed.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Union
+
+from . import astutil
+
+# ---------------------------------------------------------------------------
+# Kinds
+# ---------------------------------------------------------------------------
+
+GRADIENT = "gradient"
+ACTIVATION = "activation"
+DISPATCH_PAYLOAD = "dispatch-payload"
+KV_BLOCK = "kv-block"
+HOST_TIME = "host-time"
+
+#: the real (externally visible) kinds
+KINDS: FrozenSet[str] = frozenset(
+    {GRADIENT, ACTIVATION, DISPATCH_PAYLOAD, KV_BLOCK, HOST_TIME})
+
+TRACED = "traced"
+HOST = "host"
+
+# internal pseudo-kinds — never escape through expr_kinds()
+_GRAD_FN = "pseudo:grad-fn"        # gfn = jax.grad(f)
+_VAG_FN = "pseudo:vag-fn"          # vfn = jax.value_and_grad(f)
+_VAG_RESULT = "pseudo:vag-result"  # pair = vfn(params)  ->  (value, grads)
+_ARG = "pseudo:a"                  # summary marker: identity flow of arg i
+_ARGC = "pseudo:c"                 # summary marker: call-filtered flow of arg i
+
+#: *weak* kinds are name-seeded ("grads" is probably a gradient); they
+#: flow through identity-ish constructs only (aliasing, tuple unpack,
+#: subscripts, summary passthrough) — flowing a guess through every call
+#: argument would let a loop counter named ``g`` taint whole functions.
+#: Structural seeds (actual ``jax.grad`` outputs, ``gather_token_chunks``
+#: results, clock reads) are certain and survive call boundaries.
+_WEAK = "weak:"
+
+#: structurally-seeded kinds that survive an arbitrary call boundary
+_CALL_TRANSPARENT: FrozenSet[str] = frozenset(
+    {GRADIENT, DISPATCH_PAYLOAD, HOST_TIME})
+
+
+def _promote(kinds: Set[str]) -> Set[str]:
+    """Weak kinds become real at the query boundary."""
+    out = set()
+    for k in kinds:
+        out.add(k[len(_WEAK):] if k.startswith(_WEAK) else k)
+    return out
+
+# ---------------------------------------------------------------------------
+# Name seeds (the tier-1 heuristics, now feeding the taint lattice)
+# ---------------------------------------------------------------------------
+
+#: identifier looks like a gradient: 'grad', 'grads', 'gradients', 'g_acc',
+#: 'clipped_grads', ... — substring 'grad' or the g/gacc/gsum convention
+#: with a separator
+GRAD_NAME = re.compile(r"(^|_)grads?(_|$)|gradient|(^|_)g(acc|sum)?(_|$)",
+                       re.IGNORECASE)
+
+#: activation-flavoured identifiers: the single-letter conventions (x, h,
+#: y) plus the spelled-out ones; gradient/weight names must NOT match so
+#: gradient psums stay the comm-compression rule's business
+ACT_NAME = re.compile(
+    r"^(x|h|y|xs|hs|out|attn_out|mlp_out)$|hidden|activation|(^|_)acts?(_|$)",
+    re.IGNORECASE)
+
+#: identifier looks like an EP dispatch payload: the token chunks shipped
+#: between expert shards — activation/loss/param names must NOT match
+DISPATCH_NAME = re.compile(
+    r"dispatch|(^|_)chunks?(_|$)|routed|payload|(^|_)(send|recv)(buf)?(_|$)",
+    re.IGNORECASE)
+
+#: paged-KV block handles / tables
+KV_NAME = re.compile(
+    r"(^|_)kv(_|$)|kv_cache|(^|_)blocks?(_|$)|block_tables?|block_ids",
+    re.IGNORECASE)
+
+#: zero-arg wall/CPU clock reads (``time.*`` or bare-imported forms)
+CLOCK_TAILS = frozenset({
+    "time", "time_ns", "perf_counter", "perf_counter_ns",
+    "monotonic", "monotonic_ns", "process_time", "process_time_ns",
+})
+
+#: call tails whose result is a layer-forward activation
+_FORWARD_TAILS = frozenset({"apply", "forward"})
+
+
+def name_kinds(name: Optional[str]) -> Set[str]:
+    """Weak kinds an identifier is seeded with purely by its name."""
+    if not name:
+        return set()
+    out: Set[str] = set()
+    if GRAD_NAME.search(name):
+        out.add(_WEAK + GRADIENT)
+    if ACT_NAME.search(name):
+        out.add(_WEAK + ACTIVATION)
+    if DISPATCH_NAME.search(name):
+        out.add(_WEAK + DISPATCH_PAYLOAD)
+    if KV_NAME.search(name):
+        out.add(_WEAK + KV_BLOCK)
+    return out
+
+
+def _is_clock_call(call: ast.Call) -> bool:
+    tail = astutil.tail_name(call.func)
+    if tail not in CLOCK_TAILS:
+        return False
+    root = astutil.root_name(call.func)
+    return root == "time" or root == tail
+
+
+# ---------------------------------------------------------------------------
+# Function summaries
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FunctionSummary:
+    """What a local function returns, as kinds plus per-argument markers.
+
+    ``flat`` describes "the return value" as one blob; ``elts`` is the
+    per-element view when every tuple-shaped return agrees on arity (so
+    ``loss, g = helper(...)`` can unpack without cross-contamination).
+    """
+
+    flat: Set[str]
+    elts: Optional[List[Set[str]]]
+
+    @staticmethod
+    def _resolve(kinds: Set[str], argk: Sequence[Set[str]]) -> Set[str]:
+        out: Set[str] = set()
+        for k in kinds:
+            if k.startswith(_ARG + ":"):
+                i = int(k.rsplit(":", 1)[1])
+                if i < len(argk):
+                    out |= argk[i]
+            elif k.startswith(_ARGC + ":"):
+                i = int(k.rsplit(":", 1)[1])
+                if i < len(argk):
+                    out |= {x for x in argk[i]
+                            if x in _CALL_TRANSPARENT or x == _VAG_RESULT}
+            else:
+                out.add(k)
+        return out
+
+    def flat_result(self, argk: Sequence[Set[str]]) -> Set[str]:
+        return self._resolve(self.flat, argk)
+
+    def elt_results(self, n: int,
+                    argk: Sequence[Set[str]]) -> Optional[List[Set[str]]]:
+        if self.elts is None or len(self.elts) != n:
+            return None
+        return [self._resolve(e, argk) for e in self.elts]
+
+    def intrinsic(self) -> FrozenSet[str]:
+        """Real kinds the function produces regardless of its arguments."""
+        return frozenset(k for k in self.flat if k in KINDS)
+
+
+_ScopeKey = Union[str, int]
+_MODULE: _ScopeKey = "module"
+_MAX_FIXPOINT_ROUNDS = 10
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+class ModuleDataflow:
+    """Per-module taint state: one environment per scope (module plus each
+    function/lambda, inheriting the enclosing scope's bindings), computed
+    once and queried by rules via :meth:`expr_kinds`."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self._tree = tree
+        self._defs: Dict[str, ast.AST] = {}
+        self._scope_of: Dict[int, _ScopeKey] = {}
+        self._scope_parent: Dict[_ScopeKey, _ScopeKey] = {}
+        self._envs: Dict[_ScopeKey, Dict[str, Set[str]]] = {}
+        self._summaries: Dict[int, Optional[FunctionSummary]] = {}
+        self._traced: Optional[Set[int]] = None
+
+        order: List[ast.AST] = []  # function nodes, pre-order (outer first)
+
+        def visit(node: ast.AST, key: _ScopeKey) -> None:
+            for child in ast.iter_child_nodes(node):
+                self._scope_of[id(child)] = key
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        self._defs[child.name] = child
+                    order.append(child)
+                    self._scope_parent[id(child)] = key
+                    visit(child, id(child))
+                else:
+                    visit(child, key)
+
+        self._scope_of[id(tree)] = _MODULE
+        visit(tree, _MODULE)
+
+        env: Dict[str, Set[str]] = {}
+        self._fixpoint(tree.body, env, ns=True)
+        self._envs[_MODULE] = env
+        for fn in order:
+            parent = self._scope_parent[id(fn)]
+            fenv = {k: set(v) for k, v in self._envs[parent].items()}
+            for i, a in enumerate(self._params(fn)):
+                fenv[a] = set(name_kinds(a))
+            if isinstance(fn, ast.Lambda):
+                pass  # a lambda body has no statements to execute
+            else:
+                self._fixpoint(fn.body, fenv, ns=True)
+            self._envs[id(fn)] = fenv
+
+    # -- public API --------------------------------------------------------
+
+    def expr_kinds(self, expr: ast.AST) -> FrozenSet[str]:
+        """The real kinds of an expression, evaluated in its scope's env."""
+        key = self._scope_of.get(id(expr), _MODULE)
+        env = self._envs.get(key) or self._envs[_MODULE]
+        kinds = _promote(self._eval(expr, env, ns=True))
+        if _VAG_RESULT in kinds:
+            kinds = (kinds - {_VAG_RESULT}) | {GRADIENT}
+        return frozenset(k for k in kinds if k in KINDS)
+
+    def call_intrinsic(self, call: ast.Call) -> FrozenSet[str]:
+        """Kinds a call to a *local* function produces regardless of its
+        arguments (e.g. a helper whose body reads ``time.perf_counter()``
+        has intrinsic HOST_TIME). Empty for non-local callees."""
+        if isinstance(call.func, ast.Name) and call.func.id in self._defs:
+            s = self._summary(self._defs[call.func.id])
+            if s is not None:
+                return s.intrinsic()
+        return frozenset()
+
+    def provenance(self, node: ast.AST) -> str:
+        """``TRACED`` when the node sits inside a JAX-traced function
+        (per the trace-safety analysis), else ``HOST``."""
+        if self._traced is None:
+            from .rules_trace_safety import _traced_function_nodes
+            self._traced = set(_traced_function_nodes(self._tree).keys())
+        key = self._scope_of.get(id(node), _MODULE)
+        while key != _MODULE:
+            if key in self._traced:
+                return TRACED
+            key = self._scope_parent.get(key, _MODULE)
+        return HOST
+
+    # -- scope execution ---------------------------------------------------
+
+    @staticmethod
+    def _params(fn: ast.AST) -> List[str]:
+        a = fn.args
+        names = [x.arg for x in list(a.posonlyargs) + list(a.args)]
+        names += [x.arg for x in a.kwonlyargs]
+        if a.vararg is not None:
+            names.append(a.vararg.arg)
+        if a.kwarg is not None:
+            names.append(a.kwarg.arg)
+        return names
+
+    def _fixpoint(self, stmts: Sequence[ast.stmt],
+                  env: Dict[str, Set[str]], ns: bool) -> None:
+        for _ in range(_MAX_FIXPOINT_ROUNDS):
+            changed = False
+            for s in stmts:
+                changed |= self._exec(s, env, ns)
+            if not changed:
+                return
+
+    def _exec(self, stmt: ast.AST, env: Dict[str, Set[str]],
+              ns: bool) -> bool:
+        """Execute one statement's bindings into ``env`` (descending into
+        compound-statement bodies but not into nested function scopes)."""
+        changed = False
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return False
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                changed |= self._bind(tgt, stmt.value, env, ns)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            changed |= self._bind(stmt.target, stmt.value, env, ns)
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                changed |= self._update(
+                    env, stmt.target.id, self._eval(stmt.value, env, ns))
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            # iterating a tainted collection yields tainted items
+            changed |= self._bind_kinds(
+                stmt.target, self._eval(stmt.iter, env, ns), env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    changed |= self._bind_kinds(
+                        item.optional_vars,
+                        self._eval(item.context_expr, env, ns), env)
+        # walrus bindings anywhere in the statement's expressions
+        for sub in astutil.walk_stop_at_functions(stmt):
+            if isinstance(sub, ast.NamedExpr) and \
+                    isinstance(sub.target, ast.Name):
+                changed |= self._update(
+                    env, sub.target.id, self._eval(sub.value, env, ns))
+        # recurse into compound bodies
+        for field in ("body", "orelse", "finalbody"):
+            for child in getattr(stmt, field, ()) or ():
+                if isinstance(child, ast.AST):
+                    changed |= self._exec(child, env, ns)
+        for handler in getattr(stmt, "handlers", ()) or ():
+            for child in handler.body:
+                changed |= self._exec(child, env, ns)
+        return changed
+
+    @staticmethod
+    def _update(env: Dict[str, Set[str]], name: str,
+                kinds: Set[str]) -> bool:
+        cur = env.setdefault(name, set())
+        before = len(cur)
+        cur |= kinds
+        return len(cur) != before
+
+    def _bind(self, target: ast.AST, value: ast.AST,
+              env: Dict[str, Set[str]], ns: bool) -> bool:
+        if isinstance(target, ast.Name):
+            return self._update(env, target.id, self._eval(value, env, ns))
+        if isinstance(target, (ast.Tuple, ast.List)):
+            elts = self._value_elements(value, len(target.elts), env, ns)
+            changed = False
+            for t, ek in zip(target.elts, elts):
+                changed |= self._bind_kinds(t, ek, env)
+            return changed
+        if isinstance(target, ast.Starred):
+            return self._bind_kinds(target.value,
+                                    self._eval(value, env, ns), env)
+        return False  # Subscript/Attribute targets: container taint is out
+        # of scope for an intraprocedural engine
+
+    def _bind_kinds(self, target: ast.AST, kinds: Set[str],
+                    env: Dict[str, Set[str]]) -> bool:
+        kinds = {k for k in kinds if k != _VAG_RESULT} | (
+            {GRADIENT} if _VAG_RESULT in kinds else set())
+        if isinstance(target, ast.Name):
+            return self._update(env, target.id, kinds)
+        if isinstance(target, ast.Starred):
+            return self._bind_kinds(target.value, kinds, env)
+        if isinstance(target, (ast.Tuple, ast.List)):
+            changed = False
+            for t in target.elts:  # no structure left: all get the union
+                changed |= self._bind_kinds(t, kinds, env)
+            return changed
+        return False
+
+    def _value_elements(self, value: ast.AST, n: int,
+                        env: Dict[str, Set[str]],
+                        ns: bool) -> List[Set[str]]:
+        """Per-element kinds for unpacking ``value`` into ``n`` targets."""
+        if isinstance(value, (ast.Tuple, ast.List)) and \
+                len(value.elts) == n:
+            return [self._eval(e, env, ns) for e in value.elts]
+        kinds = self._eval(value, env, ns)
+        if _VAG_RESULT in kinds:
+            rest = kinds - {_VAG_RESULT}
+            if n == 2:  # (value, grads)
+                return [set(rest), rest | {GRADIENT}]
+            return [rest | {GRADIENT} for _ in range(n)]
+        if isinstance(value, ast.Call) and \
+                isinstance(value.func, ast.Name) and \
+                value.func.id in self._defs:
+            s = self._summary(self._defs[value.func.id])
+            if s is not None:
+                argk = [self._eval(a, env, ns) for a in value.args]
+                per = s.elt_results(n, argk)
+                if per is not None:
+                    return per
+        return [set(kinds) for _ in range(n)]
+
+    # -- expression evaluation --------------------------------------------
+
+    def _eval(self, e: ast.AST, env: Dict[str, Set[str]],
+              ns: bool) -> Set[str]:
+        if isinstance(e, ast.Name):
+            out = set(env.get(e.id, ()))
+            if ns:
+                out |= name_kinds(e.id)
+            return out
+        if isinstance(e, ast.Attribute):
+            return name_kinds(e.attr) if ns else set()
+        if isinstance(e, ast.Call):
+            return self._eval_call(e, env, ns)
+        if isinstance(e, ast.Subscript):
+            base = self._eval(e.value, env, ns)
+            if _VAG_RESULT in base:
+                idx = e.slice
+                rest = base - {_VAG_RESULT}
+                if isinstance(idx, ast.Constant) and \
+                        isinstance(idx.value, int) and idx.value == 0:
+                    return rest
+                return rest | {GRADIENT}
+            return base
+        if isinstance(e, ast.Starred):
+            return self._eval(e.value, env, ns)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            out: Set[str] = set()
+            for x in e.elts:
+                out |= self._eval(x, env, ns)
+            return out
+        if isinstance(e, ast.BinOp):
+            return self._eval(e.left, env, ns) | \
+                self._eval(e.right, env, ns)
+        if isinstance(e, ast.UnaryOp):
+            return self._eval(e.operand, env, ns)
+        if isinstance(e, ast.IfExp):
+            return self._eval(e.body, env, ns) | \
+                self._eval(e.orelse, env, ns)
+        if isinstance(e, ast.NamedExpr):
+            return self._eval(e.value, env, ns)
+        if isinstance(e, ast.Await):
+            return self._eval(e.value, env, ns)
+        if isinstance(e, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            cenv = {k: set(v) for k, v in env.items()}
+            for gen in e.generators:
+                self._bind_kinds(gen.target,
+                                 self._eval(gen.iter, env, ns), cenv)
+            return self._eval(e.elt, cenv, ns)
+        return set()
+
+    def _eval_call(self, call: ast.Call, env: Dict[str, Set[str]],
+                   ns: bool) -> Set[str]:
+        func = call.func
+        tail = astutil.tail_name(func)
+        # time.* / bare-imported clock reads
+        if _is_clock_call(call):
+            return {HOST_TIME}
+        # jax.grad(f)(x) / jax.value_and_grad(f)(x) called directly
+        if isinstance(func, ast.Call):
+            inner_tail = astutil.tail_name(func.func)
+            if inner_tail == "grad":
+                return {GRADIENT}
+            if inner_tail == "value_and_grad":
+                return {_VAG_RESULT}
+        if tail == "grad":
+            return {_GRAD_FN}
+        if tail == "value_and_grad":
+            return {_VAG_FN}
+        if tail == "gather_token_chunks":
+            return {DISPATCH_PAYLOAD}
+        if tail in _FORWARD_TAILS:
+            return {ACTIVATION}
+        # call through a name bound to a grad/value_and_grad transform
+        if isinstance(func, ast.Name):
+            fk = env.get(func.id, ())
+            if _GRAD_FN in fk:
+                return {GRADIENT}
+            if _VAG_FN in fk:
+                return {_VAG_RESULT}
+            # local function: apply its summary
+            if func.id in self._defs:
+                s = self._summary(self._defs[func.id])
+                if s is not None:
+                    argk = [self._eval(a, env, ns) for a in call.args]
+                    return s.flat_result(argk)
+        # default: only call-transparent kinds flow through
+        out: Set[str] = set()
+        for a in call.args:
+            out |= self._eval(a, env, ns)
+        for kw in call.keywords:
+            out |= self._eval(kw.value, env, ns)
+        if isinstance(func, ast.Attribute):
+            out |= self._eval(func.value, env, ns)  # method on tainted obj
+        res: Set[str] = set()
+        for k in out:
+            if k in _CALL_TRANSPARENT:
+                res.add(k)
+            elif k == _VAG_RESULT:
+                res.add(GRADIENT)
+            elif k.startswith(_ARG + ":"):
+                res.add(_ARGC + ":" + k.rsplit(":", 1)[1])
+            elif k.startswith(_ARGC + ":"):
+                res.add(k)
+        return res
+
+    # -- summaries ---------------------------------------------------------
+
+    def _summary(self, fn: ast.AST) -> Optional[FunctionSummary]:
+        key = id(fn)
+        if key in self._summaries:
+            return self._summaries[key]
+        self._summaries[key] = None  # recursion guard
+        if isinstance(fn, ast.Lambda):
+            self._summaries[key] = None
+            return None
+        env: Dict[str, Set[str]] = {}
+        pos = [x.arg for x in list(fn.args.posonlyargs) + list(fn.args.args)]
+        for i, a in enumerate(pos):
+            env[a] = {_ARG + ":" + str(i)}
+        for a in fn.args.kwonlyargs:
+            env[a.arg] = set()
+        self._fixpoint(fn.body, env, ns=False)
+
+        flat: Set[str] = set()
+        tuple_returns: List[List[Set[str]]] = []
+        shapeless: Set[str] = set()  # kinds of returns with unknown arity
+        for node in astutil.walk_stop_at_functions(fn):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            v = node.value
+            ek: Optional[List[Set[str]]] = None
+            if isinstance(v, ast.Tuple):
+                ek = [self._eval(x, env, False) for x in v.elts]
+            elif isinstance(v, ast.Call) and \
+                    isinstance(v.func, ast.Name) and v.func.id in self._defs \
+                    and self._defs[v.func.id] is not fn:
+                sub = self._summary(self._defs[v.func.id])
+                if sub is not None and sub.elts is not None:
+                    argk = [self._eval(a, env, False) for a in v.args]
+                    ek = [FunctionSummary._resolve(e, argk)
+                          for e in sub.elts]
+            if ek is not None:
+                for part in ek:
+                    flat |= part
+                tuple_returns.append(ek)
+            else:
+                kinds = self._eval(v, env, False)
+                flat |= kinds
+                shapeless |= kinds
+
+        elts: Optional[List[Set[str]]] = None
+        arities = {len(ek) for ek in tuple_returns}
+        if len(arities) == 1:
+            n = arities.pop()
+            elts = [set(shapeless) for _ in range(n)]
+            for ek in tuple_returns:
+                for i in range(n):
+                    elts[i] |= ek[i]
+        s = FunctionSummary(flat=flat, elts=elts)
+        self._summaries[key] = s
+        return s

@@ -1,0 +1,67 @@
+"""Machine-readable emitters for nxdlint findings: JSON and SARIF 2.1.0.
+
+The SARIF output follows the 2.1.0 schema shape consumed by code-scanning
+UIs: ``runs[0].tool.driver`` carries the rule catalog (stable rule IDs +
+short descriptions), each result carries ``ruleId``, ``level``,
+``message.text`` and a ``physicalLocation`` with 1-based line/column.
+Suppressed findings are emitted with an ``inSource`` suppression so
+downstream tooling can audit them without failing on them.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List
+
+from .core import Finding
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def findings_to_json(findings: Iterable[Finding]) -> str:
+    rows: List[Dict[str, object]] = [
+        {"path": f.path, "line": f.line, "col": f.col, "rule": f.rule,
+         "message": f.message, "suppressed": f.suppressed}
+        for f in findings]
+    return json.dumps({"findings": rows}, indent=2, sort_keys=False)
+
+
+def findings_to_sarif(findings: Iterable[Finding],
+                      rule_descriptions: Dict[str, str]) -> str:
+    findings = list(findings)
+    used = sorted({f.rule for f in findings})
+    rules = [{"id": rid,
+              "shortDescription": {
+                  "text": rule_descriptions.get(rid, rid)}}
+             for rid in used]
+    results = []
+    for f in findings:
+        result: Dict[str, object] = {
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/")},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": max(1, f.col + 1)},
+                },
+            }],
+        }
+        if f.suppressed:
+            result["suppressions"] = [{"kind": "inSource"}]
+        results.append(result)
+    doc = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "nxdlint",
+                                "informationUri":
+                                    "docs/analysis.md",
+                                "rules": rules}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)

@@ -9,10 +9,14 @@ format, hierarchical fast/slow staging, and the error-feedback residue
 ``grad_comm_*`` config fields are supposed to control — a model whose
 gradients are pmean'd inline stays fp32 no matter what the config says.
 
-The rule fires on ``lax.pmean``/``lax.psum`` calls whose first argument is
-a gradient-named variable (``grad``/``grads``/``g_``.../``*_grad*``)
-outside ``parallel/`` — inside the package the wrappers themselves (and
-the compressed collectives) legitimately issue raw collectives.
+The rule fires on ``lax.pmean``/``lax.psum`` calls whose first argument
+carries the GRADIENT value kind (PR 14: the tier-2 dataflow engine —
+``jax.grad``/``value_and_grad`` outputs tracked through renames, tuple
+unpacking and helper calls; gradient-*named* variables seed the same
+lattice, so every v1 finding is preserved) outside ``parallel/`` and
+``pipeline/`` — the wrappers themselves (and the pipeline stage rings,
+which own their collectives by contract) legitimately issue raw
+collectives. In heuristics-only mode the name regexes alone decide.
 
 Activation extension (PR 9): when a compression config is in scope —
 the module imports ``wire_codec``/``comm_compressed`` or references
@@ -46,20 +50,13 @@ import ast
 import re
 from typing import Iterator, List
 
-from . import astutil
+from . import astutil, dataflow
 from .core import Finding, LintContext, register
-from .rules_tp_overlap import _ACT_NAME
 
-# identifier looks like a gradient: 'grad', 'grads', 'gradients', 'dw',
-# 'g_acc', 'clipped_grads', ... — substring 'grad' or the g/dgrad naming
-# convention with a separator
-_GRAD_NAME = re.compile(r"(^|_)grads?(_|$)|gradient|(^|_)g(acc|sum)?(_|$)",
-                        re.IGNORECASE)
-
-
-def _in_parallel_package(path: str) -> bool:
-    norm = path.replace("\\", "/")
-    return "/parallel/" in norm or norm.startswith("parallel/")
+# name heuristics live in dataflow.py now (they seed the taint lattice);
+# kept as module aliases for the heuristics-only (v1) fallback path
+_GRAD_NAME = dataflow.GRAD_NAME
+_ACT_NAME = dataflow.ACT_NAME
 
 
 def _in_ops_package(path: str) -> bool:
@@ -77,13 +74,7 @@ _COMPRESSION_IN_SCOPE = re.compile(
 
 _ACT_COLLECTIVES = ("pmean", "psum", "all_gather")
 
-# identifier looks like an EP dispatch payload: the token chunks shipped
-# between expert shards ('dispatch_buf', 'chunks', 'routed_tokens',
-# 'payload', 'send'/'recv' buffers) — activation/loss/param names must
-# NOT match so plain shuffles stay the model's own business
-_DISPATCH_NAME = re.compile(
-    r"dispatch|(^|_)chunks?(_|$)|routed|payload|(^|_)(send|recv)(buf)?(_|$)",
-    re.IGNORECASE)
+_DISPATCH_NAME = dataflow.DISPATCH_NAME
 
 _DISPATCH_COLLECTIVES = ("all_to_all", "ppermute")
 
@@ -111,21 +102,33 @@ def _dispatch_named(node: ast.AST) -> bool:
 
 @register(
     "comm-compression",
-    "raw lax.pmean/lax.psum on gradient-named variables outside parallel/ "
-    "— use parallel.grads.allreduce_gradients so spec-aware skipping, "
-    "quantization and error feedback apply")
+    "raw lax.pmean/lax.psum on gradient-valued variables outside "
+    "parallel/ — use parallel.grads.allreduce_gradients so spec-aware "
+    "skipping, quantization and error feedback apply",
+    exempt=("parallel", "pipeline"))
 def check(ctx: LintContext) -> Iterator[Finding]:
-    if _in_parallel_package(ctx.path):
-        return
+    # declarative exempt: parallel/ (the wrappers themselves issue raw
+    # collectives) and pipeline/ (stage grad rings own their collectives
+    # and stay uncompressed by design — see make_train_step's contract)
     act_scope = (not _in_ops_package(ctx.path)
                  and _COMPRESSION_IN_SCOPE.search(ctx.source) is not None)
+    df = ctx.dataflow
+
+    def has_kind(node: ast.AST, kind: str, named) -> bool:
+        # tier-2 taint subsumes the name heuristic (names seed the
+        # lattice); heuristics-only mode falls back to the regex
+        if df is not None:
+            return kind in df.expr_kinds(node)
+        return named(node)
+
     findings: List[Finding] = []
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
         tail = astutil.tail_name(node.func)
         if tail in ("pmean", "psum") and node.args \
-                and _gradient_named(node.args[0]):
+                and has_kind(node.args[0], dataflow.GRADIENT,
+                             _gradient_named):
             findings.append(Finding(
                 ctx.path, node.lineno, node.col_offset, "comm-compression",
                 f"raw lax.{tail} on a gradient — use "
@@ -135,7 +138,8 @@ def check(ctx: LintContext) -> Iterator[Finding]:
                 "(docs/comm_compression.md)"))
             continue
         if act_scope and tail in _ACT_COLLECTIVES and node.args \
-                and _activation_named(node.args[0]):
+                and has_kind(node.args[0], dataflow.ACTIVATION,
+                             _activation_named):
             findings.append(Finding(
                 ctx.path, node.lineno, node.col_offset, "comm-compression",
                 f"full-precision lax.{tail} on an activation in a module "
@@ -146,7 +150,8 @@ def check(ctx: LintContext) -> Iterator[Finding]:
                 "(docs/comm_compression.md)"))
             continue
         if act_scope and tail in _DISPATCH_COLLECTIVES and node.args \
-                and _dispatch_named(node.args[0]):
+                and has_kind(node.args[0], dataflow.DISPATCH_PAYLOAD,
+                             _dispatch_named):
             findings.append(Finding(
                 ctx.path, node.lineno, node.col_offset, "comm-compression",
                 f"full-precision lax.{tail} on an EP dispatch payload in a "

@@ -24,22 +24,12 @@ with an explicit uncached fallback) are exempt by filename.
 from __future__ import annotations
 
 import ast
-import pathlib
 from typing import Iterator
 
 from . import astutil
 from .core import Finding, LintContext, register
 
-_EXEMPT_FILES = ("aot_cache.py", "model_builder.py")
 _ENGINE_CTORS = ("ServingEngine",)
-
-
-def _in_inference(path: str) -> bool:
-    return "inference" in pathlib.PurePath(path).parts
-
-
-def _is_exempt(path: str) -> bool:
-    return pathlib.PurePath(path).name in _EXEMPT_FILES
 
 
 def _is_lower_compile(node: ast.Call) -> bool:
@@ -57,10 +47,10 @@ def _is_lower_compile(node: ast.Call) -> bool:
     "elasticity",
     "serving engine/worker construction in inference/ that bypasses the "
     "AOT executable cache (ServingEngine without aot_cache=, raw "
-    ".lower().compile() chains) — reintroduces compile-on-scale")
+    ".lower().compile() chains) — reintroduces compile-on-scale",
+    scope=("inference",),
+    exempt=("aot_cache.py", "model_builder.py"))
 def check(ctx: LintContext) -> Iterator[Finding]:
-    if not _in_inference(ctx.path) or _is_exempt(ctx.path):
-        return
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue

@@ -32,7 +32,7 @@ import ast
 import os
 from typing import Iterator, List
 
-from . import astutil
+from . import astutil, dataflow
 from .core import Finding, LintContext, register
 from .rules_trace_safety import _traced_function_nodes
 
@@ -120,6 +120,20 @@ def check(ctx: LintContext) -> Iterator[Finding]:
                         "records once per trace, not per execution — and "
                         "is a host callback in compiled code; move the "
                         "metric/span to the host side around the call"))
+                elif ctx.dataflow is not None and dataflow.HOST_TIME \
+                        in ctx.dataflow.call_intrinsic(sub):
+                    # tier-2 taint: a local helper whose body reads the
+                    # host clock — the indirection hides the same
+                    # trace-time constant from the name-level check
+                    seen.add(id(sub))
+                    findings.append(Finding(
+                        ctx.path, sub.lineno, sub.col_offset,
+                        "observability",
+                        "call to a local helper that reads the host "
+                        "clock, inside a JAX-traced function — the clock "
+                        "read still happens once at trace time; time the "
+                        "compiled call from the host (obs tracer span) "
+                        "instead"))
 
     if not _print_exempt(ctx.path):
         for node in ast.walk(ctx.tree):

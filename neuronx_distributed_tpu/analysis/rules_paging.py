@@ -22,7 +22,6 @@ fed from the engine's host tables.
 from __future__ import annotations
 
 import ast
-import pathlib
 from typing import Iterator, List
 
 from .core import Finding, LintContext, register
@@ -32,11 +31,6 @@ _MUTATORS = ("append", "pop", "remove", "extend", "insert", "clear",
              "update", "discard", "add", "setdefault", "popitem")
 _AT_WRITES = ("set", "add", "multiply", "mul", "divide", "div", "power",
               "min", "max", "apply")
-
-
-def _is_paging_module(path: str) -> bool:
-    parts = pathlib.PurePath(path).parts
-    return parts[-2:] == ("inference", "paging.py")
 
 
 def _attr_named(node, names) -> bool:
@@ -62,10 +56,9 @@ def _targets(node) -> List[ast.expr]:
     "paging-refcount",
     "direct free-list/refcount (_free/_allocated/_refs) or block_tables "
     "mutation outside inference/paging.py (bypasses the refcounted "
-    "allocator + COW invariants and can cross-contaminate shared KV)")
+    "allocator + COW invariants and can cross-contaminate shared KV)",
+    exempt=("inference/paging.py",))
 def check(ctx: LintContext) -> Iterator[Finding]:
-    if _is_paging_module(ctx.path):
-        return
     findings: List[Finding] = []
 
     def flag(node, what: str) -> None:

@@ -44,11 +44,6 @@ _NESTED = {"optimizer_config": "OptimizerConfig",
            "activation_checkpoint_config": "ActivationCheckpointConfig"}
 
 
-def _exempt(path: str) -> bool:
-    norm = path.replace("\\", "/")
-    return "/plan/" in norm or norm.startswith("plan/")
-
-
 def _literal(node: ast.AST) -> Optional[Any]:
     try:
         return ast.literal_eval(node)
@@ -133,10 +128,9 @@ def _implied_plan(info: Dict[str, Any], world: int, dcn: int):
     "plan",
     "hand-rolled neuronx_distributed_config(...) layout that the "
     "placement planner strictly dominates at the same device count — "
-    "run python -m neuronx_distributed_tpu.plan")
+    "run python -m neuronx_distributed_tpu.plan",
+    exempt=("plan",))
 def check(ctx: LintContext) -> Iterator[Finding]:
-    if _exempt(ctx.path):
-        return
     for node in ast.walk(ctx.tree):
         if not (isinstance(node, ast.Call)
                 and astutil.tail_name(node.func)

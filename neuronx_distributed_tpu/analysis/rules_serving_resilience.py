@@ -23,7 +23,6 @@ Two anti-patterns silently void that contract:
 from __future__ import annotations
 
 import ast
-import pathlib
 from typing import Iterator, List
 
 from . import astutil
@@ -32,10 +31,6 @@ from .core import Finding, LintContext, register
 _ENGINE_CALLS = ("step", "submit")
 _BROAD = ("Exception", "BaseException")
 _PACING = ("sleep", "backoff", "wait", "delay")
-
-
-def _in_inference(path: str) -> bool:
-    return "inference" in pathlib.PurePath(path).parts
 
 
 def _engine_call_in(body) -> ast.Call:
@@ -81,10 +76,9 @@ def _is_while_true(loop: ast.While) -> bool:
     "serving-resilience",
     "bare except swallowing around engine.step/submit call sites and "
     "unbounded retry loops without backoff inside inference/ (voids the "
-    "typed-failure + bounded-failover contract)")
+    "typed-failure + bounded-failover contract)",
+    scope=("inference",))
 def check(ctx: LintContext) -> Iterator[Finding]:
-    if not _in_inference(ctx.path):
-        return
     findings: List[Finding] = []
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.Try):

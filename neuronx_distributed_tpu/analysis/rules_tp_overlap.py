@@ -22,27 +22,17 @@ primitives themselves legitimately compose raw collectives with matmuls.
 from __future__ import annotations
 
 import ast
-import re
 from typing import Dict, Iterator, List
 
-from . import astutil
+from . import astutil, dataflow
 from .core import Finding, LintContext, register
 
-# activation-flavoured identifiers: the single-letter conventions (x, h,
-# y) plus the spelled-out ones; gradient/weight names must NOT match so
-# gradient psums stay the comm-compression rule's business
-_ACT_NAME = re.compile(
-    r"^(x|h|y|xs|hs|out|attn_out|mlp_out)$|hidden|activation|(^|_)acts?(_|$)",
-    re.IGNORECASE)
+# the activation-name heuristic lives in dataflow.py now (it seeds the
+# taint lattice); kept as a module alias for heuristics-only mode
+_ACT_NAME = dataflow.ACT_NAME
 
 _COLLECTIVES = ("all_gather", "psum")
 _MATMULS = ("einsum", "dot", "matmul", "tensordot")
-
-
-def _exempt(path: str) -> bool:
-    norm = path.replace("\\", "/")
-    return any(f"/{pkg}/" in norm or norm.startswith(f"{pkg}/")
-               for pkg in ("parallel", "ops"))
 
 
 def _collective_tail(node: ast.AST):
@@ -68,10 +58,22 @@ def _name_operands(node: ast.AST) -> Iterator[str]:
     "tp-overlap",
     "blocking all_gather/psum followed by a matmul on the gathered "
     "activations — use ops.collective_matmul so the transfer overlaps "
-    "the per-shard partial matmuls")
+    "the per-shard partial matmuls",
+    exempt=("parallel", "ops"))
 def check(ctx: LintContext) -> Iterator[Finding]:
-    if _exempt(ctx.path):
-        return
+    df = ctx.dataflow
+
+    def _is_activation(name: str, value: ast.Call) -> bool:
+        # the gathered value is an activation when the target is
+        # activation-named (v1 heuristic) or — with the tier-2 engine —
+        # when the collective's operand carries the ACTIVATION kind
+        # through renames/unpacking the regex can't see
+        if _ACT_NAME.search(name):
+            return True
+        if df is not None and value.args:
+            return dataflow.ACTIVATION in df.expr_kinds(value.args[0])
+        return False
+
     findings: List[Finding] = []
     for func in ast.walk(ctx.tree):
         if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -97,7 +99,7 @@ def check(ctx: LintContext) -> Iterator[Finding]:
                 # reassignment clears it (the gathered value was replaced)
                 name = node.targets[0].id
                 tail = _collective_tail(node.value)
-                if tail and _ACT_NAME.search(name):
+                if tail and _is_activation(name, node.value):
                     gathered[name] = tail
                 else:
                     gathered.pop(name, None)

@@ -1127,3 +1127,39 @@ class ServingEngine:
             ttft_s=(req.first_token_time - req.arrival_time
                     if req.first_token_time is not None else None),
             finish_s=now)
+
+
+# -- nxdlint jaxpr-audit entry point ---------------------------------------
+
+from ..analysis.audit_registry import BuiltEntry, register_entry_point
+
+
+@register_entry_point(
+    "engine-step",
+    description="packed continuous-batching serving step (paged KV), "
+                "same construction path as the engine tests",
+    tags=("serve",),
+)
+def _audit_engine_step() -> BuiltEntry:
+    """Builder for ``analysis --jaxpr``: the packed serving step on a
+    tiny model. No donation expectation — the engine only donates the
+    pool on tpu/axon backends — and no wire dtype; the audit's value
+    here is the host-callback and collective-scope contracts."""
+    from flax.core import meta
+
+    from ..models.llama import LlamaForCausalLM, tiny_config
+    from ..parallel import mesh as ps
+
+    if ps.model_parallel_is_initialized():
+        ps.destroy_model_parallel()
+    ps.initialize_model_parallel()
+    cfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                      num_layers=2)
+    params = meta.unbox(LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    ecfg = EngineConfig(block_size=4, num_blocks=16, max_slots=2,
+                        max_blocks_per_seq=8, token_budget=8,
+                        kv_dtype=jnp.float32)
+    eng = ServingEngine(cfg, params, ecfg, aot_cache=None)
+    return BuiltEntry(fn=eng._step_fn,
+                      args=eng._example_args(ecfg.token_budget))

@@ -314,3 +314,40 @@ def combine_token_chunks(ys: Tuple[jax.Array, ...], axis, *,
     if n is not None and n > 1:
         _record_ep_wire("ep_combine", tuple(ys[0].shape), wire, n - 1)
     return _combine_chunks(ys, axis, wire, bool(overlap))
+
+
+# -- nxdlint jaxpr-audit entry point ---------------------------------------
+
+from ..analysis.audit_registry import BuiltEntry, register_entry_point
+
+
+@register_entry_point(
+    "ep-dispatch-ring",
+    description="quantized EP dispatch ring: gather + combine of token "
+                "chunks under shard_map on the expert mesh",
+    tags=("train", "serve"),
+    wire_dtype="int8",
+)
+def _audit_ep_dispatch_ring() -> BuiltEntry:
+    """Builder for ``analysis --jaxpr``: the int8-wire dispatch ring on
+    a 4-way expert mesh. Every ``ppermute`` hop must ship the encoded
+    payload — a full-precision hop is a wire-precision violation."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..config import neuronx_distributed_config
+    from . import mesh as ps
+
+    if ps.model_parallel_is_initialized():
+        ps.destroy_model_parallel()
+    neuronx_distributed_config(expert_parallel_size=4)
+    em = ps.get_expert_mesh()
+    wire = wire_config("int8")
+
+    def ring(x):
+        chunks = gather_token_chunks(x, "ep", wire=wire, overlap=True)
+        return combine_token_chunks(chunks, "ep", wire=wire, overlap=True)
+
+    fn = jax.jit(ps.shard_map(ring, em, in_specs=P("ep", None),
+                              out_specs=P("ep", None)))
+    x = jnp.zeros((4 * 8, 64), jnp.float32)
+    return BuiltEntry(fn=fn, args=(x,))

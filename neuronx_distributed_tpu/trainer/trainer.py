@@ -566,3 +566,37 @@ def make_train_step(
         out_shardings=(state_shardings, None),
         donate_argnums=(0,) if donate else (),
     )
+
+
+# -- nxdlint jaxpr-audit entry point ---------------------------------------
+
+from ..analysis.audit_registry import BuiltEntry, register_entry_point
+
+
+@register_entry_point(
+    "train-step",
+    description="tiny-Llama SPMD train step (donating jit), same "
+                "construction path as the e2e training tests",
+    tags=("train",),
+    expects_donation=True,
+    donation_min_bytes=1 << 14,
+)
+def _audit_train_step() -> BuiltEntry:
+    """Builder for ``analysis --jaxpr``: the smallest real train step,
+    sized for the virtual CPU mesh. The returned step is only
+    abstract-traced by the auditor, never executed."""
+    from ..config import neuronx_distributed_config
+    from ..models.llama import LlamaForCausalLM, tiny_config
+
+    if ps.model_parallel_is_initialized():
+        ps.destroy_model_parallel()
+    cfg = neuronx_distributed_config(tensor_parallel_size=1)
+    mcfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32)
+    model = LlamaForCausalLM(mcfg)
+    ids = jnp.zeros((8, 16), jnp.int32)
+    pm, params = initialize_parallel_model(
+        cfg, model, jax.random.key(0), ids)
+    tx, state, state_shardings = initialize_parallel_optimizer(pm, params)
+    step = make_train_step(pm, tx, state_shardings)
+    batch = {"input_ids": ids, "labels": ids}
+    return BuiltEntry(fn=step, args=(state, batch), donate_argnums=(0,))

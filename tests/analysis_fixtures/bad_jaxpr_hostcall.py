@@ -1,0 +1,52 @@
+"""Fixture: jaxpr-audit violations, registered via ``--register`` and
+abstract-traced by the auditor — never executed.
+
+The toy entry point seeds one violation per jaxpr rule: a
+``pure_callback`` (host round-trip inside the compiled step), a
+``vmap(axis_name=...)`` psum outside any shard_map, a full-precision
+shard_map'd ppermute in an entry registered with an int8 wire codec,
+and a large undonated input on an entry that expects donation. The
+*static* tiers must find nothing here — every violation only exists in
+the traced program."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.analysis.audit_registry import (BuiltEntry,
+                                                             register_entry_point)
+
+
+def _host_norm(v):
+    return np.linalg.norm(v).astype(np.float32)
+
+
+@register_entry_point(
+    "fixture-bad-step",
+    description="toy step seeding one violation per jaxpr rule",
+    tags=("fixture",),
+    wire_dtype="int8",
+    expects_donation=True,
+)
+def _build():
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("ep",))
+    ring = shard_map(
+        lambda r: jax.lax.ppermute(r, "ep", [(0, 1), (1, 0)]),
+        mesh=mesh, in_specs=PartitionSpec("ep"),
+        out_specs=PartitionSpec("ep"))
+
+    def step(params, batch):
+        y = jnp.tanh(batch @ params)
+        norm = jax.pure_callback(
+            _host_norm, jax.ShapeDtypeStruct((), jnp.float32), y)
+        summed = jax.vmap(lambda r: jax.lax.psum(r, "ep"),
+                          axis_name="ep")(y)
+        hopped = ring(summed)  # fp32 hop in an int8-wire entry
+        return hopped * norm, params
+
+    weights = jnp.zeros((512, 512), jnp.float32)  # 1 MiB, never donated
+    batch = jnp.zeros((2, 8, 512), jnp.float32)
+    return BuiltEntry(fn=jax.jit(step), args=(weights, batch))

@@ -1,0 +1,27 @@
+"""Fixture: EP dispatch payloads exchanged at full precision behind
+renames (never imported, only parsed).
+
+The module references ``ep_dispatch`` so the wire-codec config is in
+scope, but no variable matches the v1 dispatch naming patterns —
+heuristics-only mode must find nothing. The dataflow engine tracks the
+``gather_token_chunks`` payload through a helper call and subscripts and
+must flag both raw exchanges."""
+
+from jax import lax
+
+from neuronx_distributed_tpu.parallel import ep_dispatch
+
+
+def reorder(parts):
+    return tuple(reversed(parts))
+
+
+def exchange(x, wire):
+    parts = ep_dispatch.gather_token_chunks(x, "ep", wire=wire)
+    first = reorder(parts)[0]
+    return lax.ppermute(first, "ep", [(0, 1)])  # dataflow-only finding
+
+
+def monolithic(x, wire):
+    staged = ep_dispatch.gather_token_chunks(x, "ep", wire=wire)[0]
+    return lax.all_to_all(staged, "ep", 0, 0)  # dataflow-only finding

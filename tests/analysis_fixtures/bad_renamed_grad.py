@@ -1,0 +1,33 @@
+"""Fixture: gradient collectives hidden behind renames (never imported,
+only parsed).
+
+No variable here matches the v1 gradient naming patterns — heuristics-only
+mode must find nothing. The tier-2 dataflow engine tracks the taint from
+the ``jax.grad``/``value_and_grad`` sources through tuple unpacking and a
+helper call, and must flag both collectives."""
+
+import jax
+from jax import lax
+
+
+def smooth(tree):
+    return jax.tree_util.tree_map(lambda t: t * 0.5, tree)
+
+
+def renamed_direct(loss_fn, params, batch):
+    update = jax.grad(loss_fn)(params, batch)
+    return lax.pmean(update, "dp")  # dataflow-only finding
+
+
+def renamed_through_unpack_and_helper(loss_fn, params, batch):
+    loss, update = jax.value_and_grad(loss_fn)(params, batch)
+    smoothed = smooth(update)
+    total = lax.psum(smoothed, ("dp", "cp"))  # dataflow-only finding
+    return loss, total
+
+
+def loss_stays_clean(loss_fn, params, batch):
+    # the non-gradient element of the value_and_grad pair must NOT be
+    # tainted — a loss pmean is the model's own business
+    loss, _ = jax.value_and_grad(loss_fn)(params, batch)
+    return lax.pmean(loss, "dp")

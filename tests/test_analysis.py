@@ -510,3 +510,198 @@ def test_cli_zero_on_clean_file():
 
 def test_cli_usage_error_without_paths():
     assert _cli().returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# tier 2: def-use dataflow (PR 14)
+# ---------------------------------------------------------------------------
+
+def test_renamed_grad_fixture_needs_dataflow():
+    fs = _lint("bad_renamed_grad.py")
+    assert _rules(fs) == {"comm-compression"}
+    assert {f.line for f in fs} == {19, 25}
+    # the v1 name heuristics see nothing: no variable is gradient-named
+    assert _lint("bad_renamed_grad.py", dataflow=False) == []
+
+
+def test_renamed_dispatch_fixture_needs_dataflow():
+    fs = _lint("bad_renamed_dispatch.py")
+    assert _rules(fs) == {"comm-compression"}
+    assert len(fs) == 2
+    assert _lint("bad_renamed_dispatch.py", dataflow=False) == []
+
+
+def test_value_and_grad_loss_element_stays_clean():
+    # the pmean on the loss element of the (loss, grads) pair (fixture
+    # line 34) must NOT be flagged: only element 1 carries the taint
+    fs = _lint("bad_renamed_grad.py")
+    assert all(f.line < 30 for f in fs)
+
+
+def test_tp_overlap_sees_taint_through_renamed_gather():
+    src = (
+        "import jax\n"
+        "def block(att, w):\n"
+        "    act_shard = att\n"
+        "    gathered = jax.lax.all_gather(act_shard, 'tp')\n"
+        "    return gathered @ w\n")
+    fs = analyze_source(src, "models/m.py", DEFAULT_AXES)
+    assert any(f.rule == "tp-overlap" and f.line == 5 for f in fs)
+    fs_h = analyze_source(src, "models/m.py", DEFAULT_AXES, dataflow=False)
+    assert not any(f.rule == "tp-overlap" for f in fs_h)
+
+
+def test_observability_flags_helper_clock_read_in_traced_fn():
+    src = (
+        "import jax\n"
+        "import time\n"
+        "def stamp():\n"
+        "    return time.perf_counter()\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    t0 = stamp()\n"
+        "    return x * t0\n")
+    fs = analyze_source(src, "trainer/m.py", DEFAULT_AXES)
+    assert any(f.rule == "observability" and "local helper" in f.message
+               for f in fs)
+    fs_h = analyze_source(src, "trainer/m.py", DEFAULT_AXES, dataflow=False)
+    assert not any("local helper" in f.message for f in fs_h)
+
+
+# ---------------------------------------------------------------------------
+# suppression spans over multi-line statements
+# ---------------------------------------------------------------------------
+
+def test_suppression_on_first_line_covers_whole_statement():
+    src = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "spec = P(  # nxdlint: disable=mesh-axis\n"
+        "    'tpp')\n")
+    fs = analyze_source(src, "m.py", DEFAULT_AXES)
+    assert fs, "mesh-axis should still analyze the statement"
+    assert all(f.suppressed for f in fs)
+    # control: without the comment the finding (on line 3) is live
+    fs2 = analyze_source(src.replace("  # nxdlint: disable=mesh-axis", ""),
+                         "m.py", DEFAULT_AXES)
+    assert any(f.rule == "mesh-axis" and not f.suppressed for f in fs2)
+
+
+# ---------------------------------------------------------------------------
+# declarative rule scoping
+# ---------------------------------------------------------------------------
+
+def test_rule_exempt_paths_are_declarative():
+    src = ("from jax import lax\n"
+           "def f(grads):\n"
+           "    return lax.pmean(grads, 'dp')\n")
+    assert any(f.rule == "comm-compression"
+               for f in analyze_source(src, "models/m.py", DEFAULT_AXES))
+    # default exempt: parallel/ and pipeline/ own their collectives
+    for exempt_dir in ("parallel", "pipeline"):
+        assert not any(
+            f.rule == "comm-compression"
+            for f in analyze_source(src, f"{exempt_dir}/m.py",
+                                    DEFAULT_AXES))
+
+
+def test_scope_and_exempt_overrides():
+    src = ("from jax import lax\n"
+           "def f(grads):\n"
+           "    return lax.pmean(grads, 'dp')\n")
+    fs = analyze_source(
+        src, "models/m.py", DEFAULT_AXES,
+        exempt_overrides={"comm-compression": ["models"]})
+    assert not any(f.rule == "comm-compression" for f in fs)
+    fs2 = analyze_source(
+        src, "models/m.py", DEFAULT_AXES,
+        scope_overrides={"comm-compression": ["inference"]})
+    assert not any(f.rule == "comm-compression" for f in fs2)
+    # an override can also re-enable a default-exempt path
+    fs3 = analyze_source(
+        src, "parallel/m.py", DEFAULT_AXES,
+        exempt_overrides={"comm-compression": []})
+    assert any(f.rule == "comm-compression" for f in fs3)
+
+
+# ---------------------------------------------------------------------------
+# machine-readable output + the baseline ratchet
+# ---------------------------------------------------------------------------
+
+def test_json_output_shape():
+    import json
+    r = _cli(os.path.join(FIXTURES, "bad_renamed_grad.py"),
+             "--format", "json")
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert len(doc["findings"]) == 2
+    for row in doc["findings"]:
+        assert set(row) == {"path", "line", "col", "rule", "message",
+                            "suppressed"}
+        assert row["rule"] == "comm-compression"
+
+
+def test_sarif_output_is_2_1_0_shaped():
+    import json
+    r = _cli(os.path.join(FIXTURES, "bad_renamed_grad.py"),
+             "--format", "sarif")
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "nxdlint"
+    assert {rr["id"] for rr in driver["rules"]} == {"comm-compression"}
+    assert all(rr["shortDescription"]["text"] for rr in driver["rules"])
+    assert len(run["results"]) == 2
+    for res in run["results"]:
+        assert res["ruleId"] == "comm-compression"
+        assert res["level"] == "warning"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(
+            "bad_renamed_grad.py")
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1  # SARIF is 1-based
+
+
+def test_baseline_roundtrip_and_ratchet(tmp_path):
+    import dataclasses
+    from neuronx_distributed_tpu.analysis import baseline as bl
+    fs = _lint("bad_renamed_grad.py")
+    path = str(tmp_path / "base.json")
+    assert bl.write_baseline(path, fs) == 2
+    loaded = bl.load_baseline(path)
+    # the baseline swallows exactly the recorded findings ...
+    assert bl.new_findings(fs, loaded) == []
+    # ... still swallows them when unrelated edits shift the lines ...
+    shifted = [dataclasses.replace(f, line=f.line + 40) for f in fs]
+    assert bl.new_findings(shifted, loaded) == []
+    # ... but a SECOND identical violation in the same file is new
+    extra = fs + [dataclasses.replace(fs[0], line=99)]
+    fresh = bl.new_findings(extra, loaded)
+    assert [f.line for f in fresh] == [99]
+
+
+def test_cli_baseline_write_then_fail_on_new(tmp_path):
+    base = str(tmp_path / "b.json")
+    target = os.path.join(FIXTURES, "bad_renamed_grad.py")
+    r = _cli(target, "--baseline", base, "--write-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+    r2 = _cli(target, "--baseline", base, "--fail-on-new")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "0 new finding(s)" in r2.stderr
+    # without the baseline the same file still fails
+    assert _cli(target).returncode == 1
+
+
+def test_cli_explain_and_list_rules_cover_jaxpr_tier():
+    r = _cli("--explain", "comm-compression")
+    assert r.returncode == 0
+    assert "comm-compression" in r.stdout
+    r2 = _cli("--explain", "jaxpr-host-callback")
+    assert r2.returncode == 0
+    assert "host" in r2.stdout
+    assert _cli("--explain", "no-such-rule").returncode == 2
+    r3 = _cli("--list-rules")
+    assert "jaxpr-collective-scope" in r3.stdout
+    assert "jaxpr-wire-precision" in r3.stdout

@@ -1,0 +1,94 @@
+"""Tier-3 jaxpr auditor tests: the registered production entry points
+(train step, serving engine step, EP dispatch ring) audit clean on the
+virtual CPU mesh, the seeded fixture entry flags every jaxpr rule, the
+auditor reports builder failures as findings instead of crashing, and it
+never executes the audited function (abstract tracing only)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from neuronx_distributed_tpu.analysis import jaxpr_audit
+from neuronx_distributed_tpu.analysis.audit_registry import (
+    BuiltEntry, get_entry_point, load_default_entry_points,
+    register_entry_point)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "analysis_fixtures",
+                       "bad_jaxpr_hostcall.py")
+
+JAXPR_RULES = {"jaxpr-host-callback", "jaxpr-collective-scope",
+               "jaxpr-undonated-buffer", "jaxpr-wire-precision"}
+
+
+def test_default_entry_points_registered():
+    eps = load_default_entry_points()
+    assert {"train-step", "engine-step", "ep-dispatch-ring"} <= set(eps)
+    assert eps["train-step"].expects_donation
+    assert not eps["engine-step"].expects_donation  # CPU never donates
+    assert eps["ep-dispatch-ring"].wire_dtype == "int8"
+    for ep in eps.values():
+        assert ":" in ep.source  # findings anchor at the builder
+
+
+@pytest.mark.parametrize("name",
+                         ["train-step", "engine-step", "ep-dispatch-ring"])
+def test_production_entry_points_audit_clean(name):
+    ep = load_default_entry_points()[name]
+    fs = jaxpr_audit.audit_entry_point(ep)
+    assert fs == [], "\n".join(f.format() for f in fs)
+
+
+def test_fixture_entry_flags_every_jaxpr_rule():
+    import runpy
+    runpy.run_path(FIXTURE)
+    fs = jaxpr_audit.audit_entry_point(get_entry_point("fixture-bad-step"))
+    assert {f.rule for f in fs} == JAXPR_RULES
+    # findings anchor at the fixture's registration site
+    assert all(f.path.endswith("bad_jaxpr_hostcall.py") for f in fs)
+    assert all(f.line > 1 for f in fs)
+
+
+def test_audit_never_executes_the_entry():
+    """Abstract tracing runs the Python body with tracers but never the
+    computation: a callback whose host side would blow up still audits
+    (and is flagged) without executing."""
+    def boom(_):  # pragma: no cover - must never run
+        raise AssertionError("host callback executed during audit")
+
+    @register_entry_point("fixture-no-exec")
+    def _build():
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            return jax.pure_callback(
+                boom, jax.ShapeDtypeStruct((), jnp.float32), x)
+        return BuiltEntry(fn=step, args=(jnp.zeros(4),))
+
+    fs = jaxpr_audit.audit_entry_point(get_entry_point("fixture-no-exec"))
+    assert [f.rule for f in fs] == ["jaxpr-host-callback"]
+
+
+def test_build_failure_becomes_audit_error_finding():
+    @register_entry_point("fixture-broken")
+    def _build():
+        raise RuntimeError("no mesh today")
+
+    fs = jaxpr_audit.audit_entry_point(get_entry_point("fixture-broken"))
+    assert [f.rule for f in fs] == ["jaxpr-audit-error"]
+    assert "no mesh today" in fs[0].message
+
+
+def test_cli_jaxpr_register_fixture_fails():
+    r = subprocess.run(
+        [sys.executable, "-m", "neuronx_distributed_tpu.analysis",
+         "--jaxpr", "--register", FIXTURE],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout + r.stderr
+    for rid in JAXPR_RULES:
+        assert rid in r.stdout, rid
+    # --register replaces the default registry: only the fixture entry ran
+    assert "train-step" not in r.stdout

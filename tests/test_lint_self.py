@@ -42,3 +42,26 @@ def test_fixture_corpus_stays_bad():
          os.path.join(REPO, "tests", "analysis_fixtures")],
         cwd=REPO, capture_output=True, text=True)
     assert r.returncode == 1
+
+
+def test_tests_and_examples_gate_under_baseline_ratchet():
+    """The PR-14 ratchet: tests/ and examples/ carry known findings
+    (recorded in .nxdlint-baseline.json), and the gate is zero NEW
+    findings on top of them. The fixture corpus is deliberately bad and
+    stays excluded."""
+    r = subprocess.run(
+        [sys.executable, "-m", "neuronx_distributed_tpu.analysis",
+         "tests", "examples", "--exclude", "analysis_fixtures",
+         "--baseline", ".nxdlint-baseline.json", "--fail-on-new"],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 0, (
+        "new nxdlint findings over tests/ + examples/ (fix them or "
+        "re-run with --write-baseline if intentional):\n"
+        + r.stdout + r.stderr)
+
+
+def test_baseline_file_is_loadable_and_current_format():
+    from neuronx_distributed_tpu.analysis import baseline as bl
+    base = bl.load_baseline(os.path.join(REPO, ".nxdlint-baseline.json"))
+    assert base, "baseline unexpectedly empty"
+    assert all(len(fp) == 3 for fp in base)
