@@ -1,0 +1,103 @@
+"""Rule ``slo``: latency thresholds in serving code belong in SloPolicy.
+
+The SLO layer (``obs/slo.py``, docs/observability.md "SLOs") exists so
+that every latency judgment the serving stack makes — when to degrade,
+when to scale, when a replica counts as unhealthy — is stated once, in a
+declarative :class:`SloPolicy`, where operators can see and change it.
+A comparison like ``ttft_p99_s > 0.25`` buried in router code is the
+anti-pattern: an invisible SLO that no policy file mentions, no
+``nxd_slo_compliance`` gauge tracks, and no breach event fires for.
+
+The rule flags ordering comparisons (``<``/``<=``/``>``/``>=``) between
+a latency-named value (ttft/tpot/latency/queue/wait/e2e stems, ``*_s`` /
+``*_ms`` / ``*_p99``-style suffixes) and a positive numeric literal.
+
+Not flagged — these are how the threshold is *supposed* to arrive:
+
+* comparisons against configuration attributes (``pol.ttft_p99_high_s``,
+  ``self.cfg.degrade_threshold``, ``policy.max_queue_s``): the base name
+  chain mentions a config/policy object, so the number lives in a
+  policy, not in the code;
+* zero/negative literals (``ttft > 0`` is a validity guard, not an SLO);
+* equality checks (thresholds are orderings).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from . import astutil
+from .core import Finding, LintContext, register
+
+#: name shapes that read as a latency/duration measurement
+_LATENCY_RE = re.compile(
+    r"(^|_)(ttft|tpot|latency|queue|wait|e2e)(_|$)"
+    r"|_p\d{2}(_m?s)?$"
+    r"|_m?s$")
+
+#: a base-chain component that marks the value as policy/config-sourced
+_POLICY_BASES = frozenset(
+    {"cfg", "config", "policy", "pol", "slo", "scale", "target",
+     "targets", "threshold", "thresholds"})
+
+
+def _latency_name(node: ast.AST) -> Optional[str]:
+    """The latency-ish name a comparison side measures, or None."""
+    name = astutil.tail_name(node)
+    if name is not None and _LATENCY_RE.search(name):
+        return name
+    return None
+
+
+def _policy_sourced(node: ast.AST) -> bool:
+    """True when any component of the dotted base chain names a
+    config/policy object — the threshold came from configuration."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+        if astutil.tail_name(node) in _POLICY_BASES:
+            return True
+    return isinstance(node, ast.Name) and node.id in _POLICY_BASES
+
+
+def _positive_number(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, (int, float))
+            and not isinstance(node.value, bool)
+            and node.value > 0)
+
+
+@register(
+    "slo",
+    "hard-coded latency threshold in serving code (ordering comparison "
+    "of a ttft/tpot/latency-named value against a numeric literal) — "
+    "the number belongs in a declarative SloPolicy where it is visible, "
+    "monitored, and emits breach events",
+    scope=("inference",))
+def check(ctx: LintContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left, *node.comparators]
+        ops = node.ops
+        for i, op in enumerate(ops):
+            if not isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE)):
+                continue
+            lhs, rhs = sides[i], sides[i + 1]
+            for measured, literal in ((lhs, rhs), (rhs, lhs)):
+                name = _latency_name(measured)
+                if name is None or not _positive_number(literal):
+                    continue
+                if _policy_sourced(measured):
+                    continue
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, "slo",
+                    f"`{name}` compared against the literal "
+                    f"`{literal.value}` — a latency threshold hard-coded "
+                    "outside SloPolicy is an invisible SLO: no "
+                    "nxd_slo_compliance gauge tracks it and no "
+                    "slo_breach event fires when it is violated; move "
+                    "the number into the policy (obs/slo.py) and "
+                    "consult SloMonitor instead")
+                break

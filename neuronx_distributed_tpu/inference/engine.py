@@ -114,12 +114,17 @@ class RequestRejected(RuntimeError):
 
     REASONS = ("never_fits", "over_budget", "draining", "tenant_throttled")
 
-    def __init__(self, reason: str, detail: str = ""):
+    def __init__(self, reason: str, detail: str = "",
+                 trace_id: Optional[str] = None):
         if reason not in self.REASONS:
             raise ValueError(f"unknown rejection reason {reason!r}")
         super().__init__(f"request rejected ({reason})"
                          + (f": {detail}" if detail else ""))
         self.reason = reason
+        # request trace-id (when tracing is on): a rejection closes the
+        # request's trace with outcome="rejected", and the id lets the
+        # caller correlate the exception with that span
+        self.trace_id = trace_id
 
 
 @dataclasses.dataclass
@@ -132,6 +137,7 @@ class _RequestState:
     slot: Optional[int] = None
     n_cached: int = 0               # tokens whose K/V are in the pool
     first_token_time: Optional[float] = None
+    admit_time: Optional[float] = None  # when the request got its slot
     admit_seq: int = -1             # admission order, for preemption choice
     shared_tokens: int = 0          # prompt tokens mapped from the trie
     chain: Optional[int] = None     # trie chain hash for continued insert
@@ -156,6 +162,7 @@ class _RequestState:
         self.slot = None
         self.n_cached = 0
         self.first_token_time = None
+        self.admit_time = None
         self.shared_tokens = 0
         self.chain = None
         self.trie_blocks = 0
@@ -193,6 +200,50 @@ class SessionTicket:
     n_blocks: int = 0
     kv: Optional[Dict[str, Any]] = None
     kv_fp: Optional[Dict[str, List[int]]] = None
+    # exported request trace (tracer.request_export): the destination
+    # resumes the same trace-id with accumulated phase totals, so a
+    # migrated request still yields one complete end-to-end span. None
+    # with tracing off (and for tickets from older exporters).
+    trace: Optional[Dict[str, Any]] = None
+
+
+#: label set shared by the four per-request histograms.
+_REQUEST_LABELS = ("tenant", "replica", "outcome")
+
+
+def observe_request_metrics(outcome: str, *, tenant: str = "-",
+                            replica: str = "-",
+                            ttft_s: Optional[float] = None,
+                            tpot_s: Optional[float] = None,
+                            queue_s: Optional[float] = None,
+                            e2e_s: Optional[float] = None,
+                            registry=None) -> None:
+    """Record one retired request into the per-request histograms
+    (``nxd_request_{ttft,tpot,queue,e2e}_seconds``), labeled by
+    tenant/replica/outcome. Called once per request at retirement — by
+    the router when the engine is fleet-managed, by the engine itself
+    when standalone — so samples are never double-counted."""
+    reg = registry if registry is not None else get_registry()
+    if not reg.enabled:
+        return
+
+    def _observe(name: str, help: str, value: Optional[float]) -> None:
+        if value is None:
+            return
+        reg.histogram(name, help, labels=_REQUEST_LABELS).labels(
+            tenant=tenant, replica=replica,
+            outcome=outcome).observe(max(0.0, float(value)))
+
+    _observe("nxd_request_ttft_seconds",
+             "Per-request time to first token.", ttft_s)
+    _observe("nxd_request_tpot_seconds",
+             "Per-request mean time per output token after the first.",
+             tpot_s)
+    _observe("nxd_request_queue_seconds",
+             "Per-request wait from arrival to slot admission.", queue_s)
+    _observe("nxd_request_e2e_seconds",
+             "Per-request end-to-end latency, arrival to retirement.",
+             e2e_s)
 
 
 @dataclasses.dataclass
@@ -203,6 +254,7 @@ class RequestResult:
     status: str                     # "completed" | "rejected"
     ttft_s: Optional[float] = None
     finish_s: Optional[float] = None
+    tpot_s: Optional[float] = None  # mean time per token after the first
 
 
 @dataclasses.dataclass
@@ -351,6 +403,11 @@ class ServingEngine:
             wn: CompileTracker.for_function(f"{site}/{wn}", fn)
             for wn, fn in workers.items()}
         self._obs_cache = None  # (registry, generation, handles...)
+        # request-lifecycle ownership: a fleet router retires request
+        # traces and histograms itself (it knows tenant and outcome);
+        # it clears this flag on engines it manages so samples are
+        # recorded exactly once
+        self._standalone_obs = True
 
     # -- construction -----------------------------------------------------
 
@@ -489,6 +546,13 @@ class ServingEngine:
             uid=uid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             arrival_time=(self._now() if arrival_time is None
                           else float(arrival_time)))
+        tracer = get_tracer()
+        if tracer.enabled:
+            # idempotent: adopts the router's trace when fleet-managed,
+            # opens a fresh one standalone — before the admission checks
+            # so a rejection still closes a complete span
+            tracer.request_begin(uid, replica=self.name or "engine")
+            tracer.request_phase_begin(uid, "engine_queue")
         if self._draining:
             self._reject(req, "draining",
                          f"{uid}: engine is draining, not admitting")
@@ -506,7 +570,17 @@ class ServingEngine:
         self.results[req.uid] = RequestResult(
             uid=req.uid, prompt_len=req.prompt_len, tokens=[],
             status="rejected")
-        raise RequestRejected(reason, detail)
+        tracer = get_tracer()
+        trace_id = tracer.request_trace_id(req.uid) if tracer.enabled \
+            else None
+        if self._standalone_obs:
+            observe_request_metrics(
+                "rejected", replica=self.name or "engine",
+                queue_s=0.0, e2e_s=0.0)
+            if tracer.enabled:
+                tracer.request_end(req.uid, outcome="rejected",
+                                   reason=reason)
+        raise RequestRejected(reason, detail, trace_id=trace_id)
 
     def has_work(self) -> bool:
         return bool(self._queue) or any(s is not None for s in self._slots)
@@ -597,7 +671,8 @@ class ServingEngine:
                     generated=list(req.generated),
                     max_new_tokens=req.max_new_tokens,
                     n_cached=0, age_s=now - req.arrival_time,
-                    ttft_s=None)
+                    ttft_s=None,
+                    trace=get_tracer().request_export(req.uid))
         for req in self._slots:
             if req is not None and req.uid == request_id:
                 blocks = [int(b) for b in self._tables[req.slot]
@@ -617,7 +692,8 @@ class ServingEngine:
                     ttft_s=(req.first_token_time - req.arrival_time
                             if req.first_token_time is not None
                             else None),
-                    n_blocks=len(blocks), kv=kv, kv_fp=kv_fp)
+                    n_blocks=len(blocks), kv=kv, kv_fp=kv_fp,
+                    trace=get_tracer().request_export(req.uid))
                 self._release(req)
                 self.stats.migrated_out += 1
                 self.stats.queue_depth = self.queue_depth()
@@ -669,6 +745,18 @@ class ServingEngine:
             max_new_tokens=int(ticket.max_new_tokens),
             arrival_time=now - ticket.age_s,
             generated=[int(t) for t in ticket.generated])
+        tracer = get_tracer()
+        if tracer.enabled:
+            # resume the request's trace under its original trace-id (or
+            # open one for tickets from a pre-tracing exporter) and mark
+            # the hop, so the final span shows the migration count
+            if ticket.trace is not None:
+                tracer.request_import(ticket.trace)
+            else:
+                tracer.request_begin(req.uid)
+            tracer.request_mark(req.uid, "migrate")
+            tracer.request_annotate(req.uid,
+                                    replica=self.name or "engine")
         if ticket.n_blocks == 0:
             self._queue.append(req)
             self.stats.migrated_in += 1
@@ -687,6 +775,7 @@ class ServingEngine:
         req.slot = slot
         req.admit_seq = self._admit_counter
         self._admit_counter += 1
+        req.admit_time = now
         req.n_cached = int(ticket.n_cached)
         if ticket.ttft_s is not None:
             req.first_token_time = req.arrival_time + ticket.ttft_s
@@ -771,12 +860,17 @@ class ServingEngine:
     def _admit(self) -> None:
         free = self._free_slots()
         now = self._now()
+        tracer = get_tracer()
         while free and self._queue and self._queue[0].arrival_time <= now:
             req = self._queue.popleft()
             slot = free.pop(0)
             req.slot = slot
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
+            if req.admit_time is None:
+                req.admit_time = now
+            if tracer.enabled:
+                tracer.request_phase_end(req.uid, "engine_queue")
             self._slots[slot] = req
             self._apply_prefix(req)
 
@@ -1043,6 +1137,17 @@ class ServingEngine:
                 self._maybe_insert_prefix(req)
 
         now = self._now()
+        if tracer.enabled:
+            # per-request slice attribution: a request served this step
+            # spent the whole step waiting on it (request-clock, not CPU
+            # share), so each participant's phase accumulates the full
+            # step wall time. One batched tracer call per step.
+            step_us = (now - t_start) * 1e6
+            tracer.request_slices(
+                [(req.uid, "decode_step", step_us) for req in
+                 {id(r[0]): r[0] for r in decode_rows}.values()]
+                + [(req.uid, "prefill_slice", step_us) for req in
+                   {id(r[0]): r[0] for r in prefill_rows}.values()])
         with tracer.span("engine/retirement"):
             for i, (req, _, pos, produce) in enumerate(rows):
                 if req.decoding and pos == req.n_cached:
@@ -1103,14 +1208,24 @@ class ServingEngine:
                 "(monotonic fields included — they mirror the engine's "
                 "own counters).",
                 labels=("field",))
+            step_h = reg.histogram("nxd_engine_step_seconds",
+                                   "Serving step wall time.")
+            # a registry reset() mid-run restarts the histogram empty
+            # while EngineStats keeps its full sample lists — replaying
+            # the retained window (all but this step's sample, observed
+            # below) keeps the histogram quantiles and the stats-derived
+            # percentiles telling the same story after the bump
+            from ..obs.metrics import HISTOGRAM_RESERVOIR
+
+            for v in self.stats.step_latency_s[-HISTOGRAM_RESERVOIR:-1]:
+                step_h.observe(v)
             cache = self._obs_cache = (
                 reg, reg.generation,
                 {f: stats_g.labels(field=f)
                  for f in self._OBS_SCALAR_FIELDS},
                 reg.gauge("nxd_engine_pool_free_blocks",
                           "Unallocated KV blocks."),
-                reg.histogram("nxd_engine_step_seconds",
-                              "Serving step wall time."))
+                step_h)
         _, _, fields, free_g, step_h = cache
         st = self.stats
         for f, child in fields.items():
@@ -1121,12 +1236,29 @@ class ServingEngine:
     def _retire(self, req: _RequestState, now: float) -> None:
         self._release(req)
         self.stats.completed += 1
+        ttft = (req.first_token_time - req.arrival_time
+                if req.first_token_time is not None else None)
+        n_gen = len(req.generated)
+        tpot = ((now - req.first_token_time) / (n_gen - 1)
+                if req.first_token_time is not None and n_gen > 1
+                else None)
         self.results[req.uid] = RequestResult(
             uid=req.uid, prompt_len=req.prompt_len,
             tokens=list(req.generated), status="completed",
-            ttft_s=(req.first_token_time - req.arrival_time
-                    if req.first_token_time is not None else None),
-            finish_s=now)
+            ttft_s=ttft, finish_s=now, tpot_s=tpot)
+        if self._standalone_obs:
+            observe_request_metrics(
+                "completed", replica=self.name or "engine",
+                ttft_s=ttft,
+                tpot_s=tpot,
+                queue_s=(req.admit_time - req.arrival_time
+                         if req.admit_time is not None else None),
+                e2e_s=now - req.arrival_time)
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.request_end(req.uid, outcome="completed",
+                                   replica=self.name or "engine",
+                                   tokens=n_gen)
 
 
 # -- nxdlint jaxpr-audit entry point ---------------------------------------

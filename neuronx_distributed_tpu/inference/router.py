@@ -76,12 +76,14 @@ import numpy as np
 
 from ..obs.events import emit_event
 from ..obs.metrics import get_registry
+from ..obs.slo import SloMonitor, SloPolicy
 from ..obs.tracing import get_tracer
 from ..resilience.chaos import FaultPlan
 from ..resilience.preemption import EXIT_PREEMPTED, PreemptionGuard
 from ..resilience.watchdog import SpikeDetector, StallTimer
 from .aot_cache import AotExecutableCache
-from .engine import (EngineConfig, RequestRejected, ServingEngine)
+from .engine import (EngineConfig, RequestRejected, ServingEngine,
+                     observe_request_metrics)
 from .paging import CacheExhaustedError
 
 
@@ -180,6 +182,13 @@ class RouterConfig:
     # elasticity: None = fixed fleet (scale_up/scale_down stay manual);
     # a ScalePolicy turns on the obs-driven autoscale tick
     scale: Optional[ScalePolicy] = None
+    # declarative service-level objectives: when set, a
+    # :class:`~..obs.slo.SloMonitor` is evaluated once per router step
+    # (availability = live replica fraction); a *sustained* breach emits
+    # `slo_breach`, degrades new admissions like the load ladder, and
+    # counts as a hot signal for the autoscaler — SLO attainment instead
+    # of another hand-picked latency constant
+    slo: Optional[SloPolicy] = None
     # trie subtrees shipped to a fresh/revived replica from the hottest
     # surviving trie (0 = off; needs EngineConfig.prefix_sharing)
     warm_prefix_blocks: int = 0
@@ -228,6 +237,8 @@ class RouterStats:
     reprefilled_tokens: int = 0     # migration fallbacks that re-prefilled
     integrity_shadows: int = 0      # shadow re-decodes launched
     integrity_mismatches: int = 0   # shadow/primary token divergences
+    slo_breaches: int = 0           # objectives entering sustained breach
+    slo_scale_ups: int = 0          # scale-ups the SLO layer demanded
     ttft_s: List[float] = dataclasses.field(default_factory=list)
 
     def availability(self) -> float:
@@ -257,6 +268,8 @@ class RouterStats:
             "reprefilled_tokens": self.reprefilled_tokens,
             "integrity_shadows": self.integrity_shadows,
             "integrity_mismatches": self.integrity_mismatches,
+            "slo_breaches": self.slo_breaches,
+            "slo_scale_ups": self.slo_scale_ups,
             "rejected_by_reason": dict(self.rejected_by_reason),
             "tenant_shed": dict(self.tenant_shed),
             "ttft_p50_ms": float(np.percentile(ttft, 50)) * 1e3,
@@ -397,13 +410,20 @@ class ReplicaRouter:
         self.replicas = [
             _Replica(name=f"r{i}", engine=eng, monitor=ReplicaMonitor(cfg))
             for i, eng in enumerate(engines)]
+        for eng in engines:
+            eng._standalone_obs = False  # router owns request retirement
         self._replica_seq = cfg.num_replicas  # next fresh replica name
+        # declarative SLO layer (see RouterConfig.slo)
+        self.slo = SloMonitor(cfg.slo) if cfg.slo is not None else None
+        self._slo_active_prev: set = set()
         self._recompute_budget()
 
     def _new_engine(self, name: Optional[str] = None) -> ServingEngine:
-        return ServingEngine(self.model_cfg, self.params, self.ecfg,
-                             clock=self._clock, aot_cache=self._aot,
-                             name=name)
+        eng = ServingEngine(self.model_cfg, self.params, self.ecfg,
+                            clock=self._clock, aot_cache=self._aot,
+                            name=name)
+        eng._standalone_obs = False  # router owns request retirement
+        return eng
 
     def _recompute_budget(self) -> None:
         """Global committed-token budget tracks fleet size unless pinned
@@ -461,6 +481,12 @@ class ReplicaRouter:
                           else float(arrival_time)),
             session=session)
         self.stats.submitted += 1
+        tracer = get_tracer()
+        if tracer.enabled:
+            # begin the request span before any admission check, so a
+            # rejection still produces a complete (if short) span
+            tracer.request_begin(uid, tenant=tenant)
+            tracer.request_phase_begin(uid, "router_queue")
         if self._draining:
             self._reject(req, "draining", "router is draining")
         if not self._fits_any(req):
@@ -478,7 +504,8 @@ class ReplicaRouter:
             self._reject(req, "over_budget",
                          f"shedding low-priority tenant {tenant!r} at "
                          f"load {load:.2f}")
-        if load >= self.cfg.degrade_threshold:
+        slo_hot = self.slo is not None and self.slo.breached
+        if load >= self.cfg.degrade_threshold or slo_hot:
             capped = min(req.max_new_tokens, self.cfg.degrade_max_new)
             if capped < req.max_new_tokens:
                 req.max_new_tokens = capped
@@ -543,7 +570,18 @@ class ReplicaRouter:
         self.results[req.uid] = RouterResult(
             uid=req.uid, tenant=req.tenant, status="rejected",
             reason=reason)
-        raise RequestRejected(reason, detail)
+        wait = max(0.0, self._now() - req.arrival_time)
+        observe_request_metrics("rejected", tenant=req.tenant,
+                                queue_s=wait, e2e_s=wait)
+        if self.slo is not None:
+            self.slo.observe(ok=False)
+        tracer = get_tracer()
+        trace_id = None
+        if tracer.enabled:
+            trace_id = tracer.request_trace_id(req.uid)
+            tracer.request_end(req.uid, outcome="rejected",
+                               tenant=req.tenant, reason=reason)
+        raise RequestRejected(reason, detail, trace_id=trace_id)
 
     # -- placement ---------------------------------------------------------
 
@@ -580,6 +618,7 @@ class ReplicaRouter:
     def _place_pending(self) -> int:
         placed = 0
         now = self._now()
+        tracer = get_tracer()
         for req in list(self._pending):
             if req.arrival_time > now or req.next_try > now:
                 continue
@@ -597,10 +636,16 @@ class ReplicaRouter:
                 # failover event for this request, not a router rejection
                 rep.engine.results.pop(req.uid, None)
                 self._pending.remove(req)
+                if tracer.enabled:
+                    # the engine-queue phase its submit opened must not
+                    # keep accruing while the request waits out backoff
+                    tracer.request_phase_end(req.uid, "engine_queue")
                 self._requeue(req, rep, lost_generated=0)
                 continue
             self._pending.remove(req)
             req.placed_at = now
+            if tracer.enabled:
+                tracer.request_phase_end(req.uid, "router_queue")
             rep.assigned[req.uid] = req
             if req.session:
                 self._sessions[req.session] = rep.name
@@ -619,12 +664,15 @@ class ReplicaRouter:
                  lost_generated: int) -> None:
         """Route a request back through pending after its replica failed
         it; bounded retries with exponential backoff."""
+        tracer = get_tracer()
         if req.shadow_of is not None:
             # shadows are probes, not traffic: a probe that loses its
             # replica retries quietly and is *dropped* (never a "failed"
             # result, never counted) once retries run out
             req.attempts += 1
             if req.attempts > self.cfg.max_retries:
+                if tracer.enabled:
+                    tracer.request_end(req.uid, outcome="shadow")
                 return
             req.next_try = self._now() + (
                 self.cfg.backoff_base_s * 2 ** (req.attempts - 1))
@@ -643,11 +691,25 @@ class ReplicaRouter:
             self.results[req.uid] = RouterResult(
                 uid=req.uid, tenant=req.tenant, status="failed",
                 reason="max_retries", resubmits=req.attempts - 1)
+            e2e = max(0.0, self._now() - req.arrival_time)
+            observe_request_metrics("failed", tenant=req.tenant,
+                                    queue_s=None, e2e_s=e2e)
+            if self.slo is not None:
+                self.slo.observe(ok=False)
+            if tracer.enabled:
+                tracer.request_end(req.uid, outcome="failed",
+                                   tenant=req.tenant,
+                                   reason="max_retries")
             return
         req.next_try = self._now() + (
             self.cfg.backoff_base_s * 2 ** (req.attempts - 1))
         req.placed_at = None
         self.stats.resubmits += 1
+        if tracer.enabled:
+            # failover is visible in the span: a zero-duration resubmit
+            # marker plus a reopened router-queue wait
+            tracer.request_mark(req.uid, "resubmit")
+            tracer.request_phase_begin(req.uid, "router_queue")
         if rep is not None and req.uid in rep.assigned:
             del rep.assigned[req.uid]
         self._pending.append(req)
@@ -772,6 +834,11 @@ class ReplicaRouter:
             else:
                 self.stats.reprefilled_tokens += min(
                     ticket.n_cached, len(ticket.prompt))
+                # nobody imported the ticket, so its exported trace is
+                # orphaned — re-adopt it locally before the failover
+                # path resubmits, keeping the span history intact
+                if ticket.trace is not None:
+                    get_tracer().request_import(ticket.trace)
                 self._requeue(req, None,
                               lost_generated=len(ticket.generated))
         if moved:
@@ -879,17 +946,27 @@ class ReplicaRouter:
             1.0 - r.engine.pool_free_blocks()
             / max(1, r.engine.allocator.num_blocks) for r in live)
         ttft = self._ttft_p99()
+        # a sustained SLO breach is a hot signal in its own right —
+        # attainment, not another raw constant, drives the fleet
+        slo_hot = (self.slo is not None
+                   and self.slo.last_status is not None
+                   and bool(self.slo.last_status.breached))
         hot = (queue >= pol.queue_high or occupancy >= pol.occupancy_high
-               or ttft >= pol.ttft_p99_high_s)
+               or ttft >= pol.ttft_p99_high_s or slo_hot)
         cold = (queue <= pol.queue_low
                 and occupancy < pol.occupancy_high
-                and ttft < pol.ttft_p99_high_s)
+                and ttft < pol.ttft_p99_high_s and not slo_hot)
         if hot:
             self._scale_up_streak += 1
             self._scale_down_streak = 0
             if self._scale_up_streak >= pol.hysteresis_steps:
-                self.scale_up(f"obs:queue={queue:.1f}"
-                              f",occ={occupancy:.2f},ttft={ttft:.3f}")
+                reason = (f"obs:queue={queue:.1f}"
+                          f",occ={occupancy:.2f},ttft={ttft:.3f}")
+                if slo_hot:
+                    reason = "slo:" + ",".join(
+                        self.slo.last_status.breached)
+                if self.scale_up(reason) is not None and slo_hot:
+                    self.stats.slo_scale_ups += 1
         elif cold:
             self._scale_down_streak += 1
             self._scale_up_streak = 0
@@ -941,10 +1018,15 @@ class ReplicaRouter:
 
     def _collect(self, rep: _Replica) -> None:
         eng = rep.engine
+        now = self._now()
+        tracer = get_tracer()
         for uid in [u for u in rep.assigned if u in eng.results]:
             req = rep.assigned.pop(uid)
             res = eng.results.pop(uid)
             if req.shadow_of is not None:
+                if tracer.enabled:
+                    tracer.request_end(uid, outcome="shadow",
+                                       replica=rep.name)
                 self._resolve_shadow(rep, req, list(res.tokens))
                 continue
             self._committed -= req.charged_tokens
@@ -960,6 +1042,22 @@ class ReplicaRouter:
                         "End-to-end TTFT (router arrival to first "
                         "token) — the autoscaler's latency signal."
                     ).observe(ttft)
+            # a request that survived a failover retires as
+            # "resubmitted" so the latency SLO can see recovery cost
+            outcome = "resubmitted" if req.attempts > 0 else "completed"
+            observe_request_metrics(
+                outcome, tenant=req.tenant, replica=rep.name,
+                ttft_s=ttft, tpot_s=res.tpot_s,
+                queue_s=(req.placed_at - req.arrival_time
+                         if req.placed_at is not None else None),
+                e2e_s=max(0.0, now - req.arrival_time))
+            if self.slo is not None:
+                self.slo.observe(ttft_s=ttft, tpot_s=res.tpot_s, ok=True)
+            if tracer.enabled:
+                tracer.request_end(uid, outcome=outcome,
+                                   tenant=req.tenant, replica=rep.name,
+                                   tokens=len(res.tokens),
+                                   resubmits=req.attempts)
             self.results[uid] = RouterResult(
                 uid=uid, tenant=req.tenant, status="completed",
                 tokens=list(res.tokens), replica=rep.name,
@@ -1090,6 +1188,13 @@ class ReplicaRouter:
                 rep.ok_steps += 1
                 if rep.ok_steps >= self.cfg.probation_ok_steps:
                     rep.state = "up"
+        if self.slo is not None:
+            live_frac = (len(self.live_replicas())
+                         / max(1, len(self.replicas)))
+            status = self.slo.evaluate(availability=live_frac)
+            newly = set(status.breached) - self._slo_active_prev
+            self.stats.slo_breaches += len(newly)
+            self._slo_active_prev = set(status.breached)
         self._tick_autoscale()
         self.stats.steps += 1
         self._publish_obs()
@@ -1330,10 +1435,19 @@ def elastic_chaos_drill(model_cfg, params, engine_cfg: EngineConfig,
     plan = FaultPlan.parse(
         "step|r1 : preempt, after=2, times=1 ; "
         "scale|fleet : scale_burst, after=5, times=1")
+    # a deliberately-unmeetable TTFT target plus a full-fleet
+    # availability target: the preemption window and the charged step
+    # latency each push an objective into sustained breach, so the drill
+    # exercises slo_breach emission and the SLO-hot autoscale path
+    slo = SloPolicy(name="drill", ttft_p99_s=1e-4, availability=1.0,
+                    min_samples=2, breach_patience=2, window=64)
     router = ReplicaRouter(
         model_cfg, params, engine_cfg,
         RouterConfig(num_replicas=2, global_token_budget=budget,
-                     scale=ScalePolicy(min_replicas=1, max_replicas=3)),
+                     scale=ScalePolicy(min_replicas=1, max_replicas=3,
+                                       hysteresis_steps=2,
+                                       cooldown_steps=2),
+                     slo=slo),
         clock=clock, chaos=plan, aot_cache=aot)
     _submit_all(router)
     scaled_down = False
@@ -1379,6 +1493,8 @@ def elastic_chaos_drill(model_cfg, params, engine_cfg: EngineConfig,
         "elastic_scale_ups": d["scale_ups"],
         "elastic_scale_downs": d["scale_downs"],
         "elastic_revivals": d["revivals"],
+        "elastic_slo_breaches": d["slo_breaches"],
+        "elastic_slo_scale_ups": d["slo_scale_ups"],
         "migrated_sessions": d["migrated_sessions"],
         "migrated_tokens": d["migrated_tokens"],
         "reprefilled_tokens": d["reprefilled_tokens"],

@@ -16,19 +16,21 @@ check — the serving drill cannot measure the difference. See
 from __future__ import annotations
 
 from .accounting import (CompileTracker, cache_size, compile_events,
-                         record_wire_bytes, wire_compression_ratio,
-                         wire_totals)
+                         record_collective_time, record_wire_bytes,
+                         wire_compression_ratio, wire_totals)
 from .events import emit_event, subscribe
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       get_registry)
+from .slo import SloMonitor, SloPolicy, SloStatus
 from .tracing import Span, SpanTracer, get_tracer
 
 __all__ = [
     "CompileTracker", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "SloMonitor", "SloPolicy", "SloStatus",
     "Span", "SpanTracer", "cache_size", "compile_events", "disable",
     "emit_event", "enable", "enabled", "get_registry", "get_tracer",
-    "record_wire_bytes", "reset", "subscribe", "wire_compression_ratio",
-    "wire_totals",
+    "record_collective_time", "record_wire_bytes", "reset", "subscribe",
+    "wire_compression_ratio", "wire_totals",
 ]
 
 

@@ -63,6 +63,52 @@ def record_wire_bytes(kind: str, dtype: str, wire_bytes: float,
                 labels=labels).labels(collective=kind, dtype=dtype).inc()
 
 
+def record_collective_time(tier: str, nbytes: float, seconds: float,
+                           registry: Optional[MetricsRegistry] = None
+                           ) -> None:
+    """Account one *timed* collective: (payload bytes, wall seconds).
+
+    Unlike :func:`record_wire_bytes` (traced-bytes, counted at trace
+    time), this records measured host wall time around an executed
+    collective — the (bytes, time) pairs ``plan/calibrate.py`` fits α-β
+    link constants from. ``tier`` is the link tier label ("ici"/"dcn");
+    the payload size rides as a label so the calibrator recovers
+    distinct sizes from a plain registry snapshot.
+    """
+    reg = registry if registry is not None else get_registry()
+    if not reg.enabled:
+        return
+    reg.histogram("nxd_collective_seconds",
+                  "Measured wall time of executed collectives, labeled "
+                  "by link tier and payload bytes (calibration source).",
+                  labels=("tier", "nbytes")).labels(
+                      tier=tier, nbytes=str(int(nbytes))).observe(seconds)
+
+
+def collective_samples(registry: Optional[MetricsRegistry] = None
+                       ) -> Dict[str, list]:
+    """Calibration view: ``{tier: [(nbytes, mean_seconds, count), ...]}``
+    recovered from the ``nxd_collective_seconds`` histogram family."""
+    reg = registry if registry is not None else get_registry()
+    metric = reg.get("nxd_collective_seconds")
+    out: Dict[str, list] = {}
+    if metric is None:
+        return out
+    for child in metric.children():
+        if child.count == 0:
+            continue
+        tier = child.labels.get("tier", "ici")
+        try:
+            nbytes = float(child.labels.get("nbytes", "0"))
+        except ValueError:
+            continue
+        out.setdefault(tier, []).append(
+            (nbytes, child.sum / child.count, child.count))
+    for pairs in out.values():
+        pairs.sort()
+    return out
+
+
 def wire_totals(registry: Optional[MetricsRegistry] = None
                 ) -> Tuple[float, float]:
     """(wire_bytes, raw_bytes) summed over all collective kinds."""

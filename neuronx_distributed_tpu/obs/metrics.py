@@ -19,8 +19,10 @@ every layer of the package without creating an import cycle.
 from __future__ import annotations
 
 import math
+import random
 import re
 import threading
+import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -30,8 +32,12 @@ _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 QUANTILES = (0.5, 0.9, 0.99)
 
 #: samples kept per histogram child for quantile estimation. Beyond the
-#: cap the reservoir degrades to a ring buffer of the most recent values,
-#: which is the right bias for latency monitoring (recent behaviour wins).
+#: cap the reservoir switches to uniform replacement (Vitter's Algorithm
+#: R): every observation ever recorded has the same retention probability,
+#: so quantiles estimate the whole run's distribution instead of drifting
+#: toward whatever the last window looked like. The replacement RNG is
+#: seeded per child from the series identity, keeping long-run quantiles
+#: reproducible across processes.
 HISTOGRAM_RESERVOIR = 4096
 
 
@@ -93,7 +99,7 @@ class _HistChild:
     """One histogram time series: count/sum plus a bounded reservoir."""
 
     __slots__ = ("_metric", "labels", "count", "sum", "min", "max",
-                 "_reservoir", "_next")
+                 "_reservoir", "_rng")
 
     def __init__(self, metric: "_MetricBase", labels: Dict[str, str]):
         self._metric = metric
@@ -103,7 +109,11 @@ class _HistChild:
         self.min = math.inf
         self.max = -math.inf
         self._reservoir: List[float] = []
-        self._next = 0
+        # deterministic per-series seed: quantiles over a long run are
+        # reproducible, and the pinned-distribution test can assert them
+        seed_key = metric.name + "|" + ",".join(
+            "%s=%s" % kv for kv in sorted(labels.items()))
+        self._rng = random.Random(zlib.crc32(seed_key.encode("utf-8")))
 
     def observe(self, value: float) -> None:
         m = self._metric
@@ -119,9 +129,17 @@ class _HistChild:
                 self.max = v
             if len(self._reservoir) < HISTOGRAM_RESERVOIR:
                 self._reservoir.append(v)
-            else:  # ring-buffer the most recent window
-                self._reservoir[self._next] = v
-                self._next = (self._next + 1) % HISTOGRAM_RESERVOIR
+            else:
+                # Vitter Algorithm R: keep each of the `count` samples
+                # with equal probability RESERVOIR/count
+                j = self._rng.randrange(self.count)
+                if j < HISTOGRAM_RESERVOIR:
+                    self._reservoir[j] = v
+
+    def samples(self) -> List[float]:
+        """Copy of the retained reservoir (uniform sample of the run)."""
+        with self._metric._registry._lock:
+            return list(self._reservoir)
 
     def quantile(self, q: float) -> float:
         """Nearest-rank quantile over the retained window (NaN if empty)."""

@@ -6,11 +6,23 @@ jitted/shard_mapped function (the nxdlint ``observability`` rule enforces
 this): a span around ``step_fn(...)`` measures dispatch+execution, a span
 *inside* would measure trace time once and then lie forever.
 
-Three surfaces:
+Four surfaces:
 
 * ``span(name, **attrs)`` — context manager, nests via a per-thread stack;
 * ``mark_event_start/end(name)`` — name-keyed flat events (the Timeline
   compatibility surface, also handy across callback boundaries);
+* ``request_*`` — request-scoped traces keyed by request uid. A serving
+  request crosses threads and step boundaries (router admission → engine
+  queue → chunked-prefill slices → per-step decode → retirement, possibly
+  via failover/migration to another replica), so the per-thread span stack
+  cannot follow it. Request traces instead accumulate per-phase time
+  under an explicit uid: ``request_begin`` at admission,
+  ``request_phase_begin/end`` for open-ended waits, ``request_mark`` /
+  ``request_slices`` for step-sliced work, ``request_export`` /
+  ``request_import`` to carry the trace across a live-migration ticket,
+  and ``request_end(outcome=...)`` at retirement — which emits one
+  chrome event per request with per-phase totals and critical-path
+  attribution in ``args``.
 * ``profile_step(logdir)`` — wraps ``jax.profiler`` start/stop_trace and
   records a host span carrying the logdir attribute, so the device trace
   is findable from the host timeline.
@@ -29,9 +41,36 @@ import math
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from .metrics import QUANTILES
+
+#: live request traces kept before the oldest is evicted — a leak guard
+#: for callers that begin traces and never retire them, not a window.
+MAX_LIVE_REQUESTS = 10_000
+
+
+class _RequestTrace:
+    """Accumulated per-phase time for one in-flight request."""
+
+    __slots__ = ("uid", "trace_id", "t0_us", "attrs", "phase_us",
+                 "phase_n", "open_phases", "migrations")
+
+    def __init__(self, uid: str, trace_id: str, t0_us: float,
+                 attrs: Dict[str, Any]):
+        self.uid = uid
+        self.trace_id = trace_id
+        self.t0_us = t0_us
+        self.attrs = attrs
+        self.phase_us: Dict[str, float] = {}
+        self.phase_n: Dict[str, int] = {}
+        self.open_phases: Dict[str, float] = {}
+        self.migrations = 0
+
+    def add(self, phase: str, dur_us: float, n: int = 1) -> None:
+        self.phase_us[phase] = self.phase_us.get(phase, 0.0) + dur_us
+        self.phase_n[phase] = self.phase_n.get(phase, 0) + n
 
 
 class _NullSpan:
@@ -99,6 +138,7 @@ class SpanTracer:
         self._next = 0
         self._open_named: Dict[str, float] = {}
         self._stats: Dict[str, List[float]] = {}
+        self._requests: Dict[str, _RequestTrace] = {}
         self._lock = threading.Lock()
         self._tls = threading.local()
 
@@ -168,6 +208,184 @@ class SpanTracer:
         finally:
             self.mark_event_end(name)
 
+    # -- request-scoped traces ---------------------------------------
+    def request_begin(self, uid: str, trace_id: Optional[str] = None,
+                      **attrs: Any) -> Optional[str]:
+        """Open (or adopt) a request trace; returns its trace-id.
+
+        Idempotent: a second ``request_begin`` for a live uid merges
+        attributes and keeps the original trace-id, so the router can
+        open the trace at admission and a standalone engine can call it
+        again at ``submit`` without forking the request's identity.
+        """
+        if not self.enabled:
+            return None
+        now = time.perf_counter_ns() / 1000.0
+        with self._lock:
+            tr = self._requests.get(uid)
+            if tr is not None:
+                tr.attrs.update(attrs)
+                return tr.trace_id
+            if len(self._requests) >= MAX_LIVE_REQUESTS:
+                # leak guard: drop the oldest live trace, not the newest
+                self._requests.pop(next(iter(self._requests)))
+            tr = _RequestTrace(uid, trace_id or ("trace-%s" % uid),
+                               now, dict(attrs))
+            self._requests[uid] = tr
+            return tr.trace_id
+
+    def request_trace_id(self, uid: str) -> Optional[str]:
+        with self._lock:
+            tr = self._requests.get(uid)
+            return tr.trace_id if tr is not None else None
+
+    def request_annotate(self, uid: str, **attrs: Any) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            tr = self._requests.get(uid)
+            if tr is not None:
+                tr.attrs.update(attrs)
+
+    def request_phase_begin(self, uid: str, phase: str) -> None:
+        """Open-ended phase (queue waits) closed by ``request_phase_end``
+        — or implicitly by ``request_end`` / ``request_export``."""
+        if not self.enabled:
+            return
+        now = time.perf_counter_ns() / 1000.0
+        with self._lock:
+            tr = self._requests.get(uid)
+            if tr is not None:
+                tr.open_phases.setdefault(phase, now)
+
+    def request_phase_end(self, uid: str, phase: str) -> None:
+        if not self.enabled:
+            return
+        now = time.perf_counter_ns() / 1000.0
+        with self._lock:
+            tr = self._requests.get(uid)
+            if tr is None:
+                return
+            start = tr.open_phases.pop(phase, None)
+            if start is not None:
+                tr.add(phase, now - start)
+
+    def request_mark(self, uid: str, phase: str, dur_us: float = 0.0,
+                     n: int = 1) -> None:
+        """Accumulate a known duration (or a zero-duration marker such as
+        ``resubmit``) into a request phase."""
+        if not self.enabled:
+            return
+        with self._lock:
+            tr = self._requests.get(uid)
+            if tr is not None:
+                tr.add(phase, dur_us, n)
+
+    def request_slices(
+            self, items: Iterable[Tuple[str, str, float]]) -> None:
+        """Batch ``request_mark`` — one lock acquisition for a whole
+        engine step's prefill/decode slice attribution."""
+        if not self.enabled:
+            return
+        with self._lock:
+            for uid, phase, dur_us in items:
+                tr = self._requests.get(uid)
+                if tr is not None:
+                    tr.add(phase, dur_us)
+
+    def request_export(self, uid: str) -> Optional[Dict[str, Any]]:
+        """Pop a live trace into a portable dict (a ``SessionTicket``
+        rider): the importing replica resumes the same trace-id and the
+        accumulated phase totals survive the migration."""
+        if not self.enabled:
+            return None
+        now = time.perf_counter_ns() / 1000.0
+        with self._lock:
+            tr = self._requests.pop(uid, None)
+            if tr is None:
+                return None
+            for phase, start in tr.open_phases.items():
+                tr.add(phase, now - start)
+            return {
+                "uid": tr.uid, "trace_id": tr.trace_id,
+                "attrs": dict(tr.attrs),
+                "phase_us": dict(tr.phase_us),
+                "phase_n": dict(tr.phase_n),
+                "elapsed_us": now - tr.t0_us,
+                "migrations": tr.migrations + 1,
+            }
+
+    def request_import(self, state: Dict[str, Any]) -> None:
+        """Adopt an exported request trace on the destination replica."""
+        if not self.enabled or not state:
+            return
+        now = time.perf_counter_ns() / 1000.0
+        with self._lock:
+            uid = str(state.get("uid", ""))
+            if not uid or uid in self._requests:
+                return
+            if len(self._requests) >= MAX_LIVE_REQUESTS:
+                self._requests.pop(next(iter(self._requests)))
+            tr = _RequestTrace(uid, str(state.get("trace_id", uid)),
+                               now - float(state.get("elapsed_us", 0.0)),
+                               dict(state.get("attrs", {})))
+            tr.phase_us = {str(k): float(v)
+                           for k, v in state.get("phase_us", {}).items()}
+            tr.phase_n = {str(k): int(v)
+                          for k, v in state.get("phase_n", {}).items()}
+            tr.migrations = int(state.get("migrations", 1))
+            self._requests[uid] = tr
+
+    def request_end(self, uid: str, outcome: str = "completed",
+                    **attrs: Any) -> Optional[Dict[str, Any]]:
+        """Retire a request trace: emits one chrome event carrying the
+        per-phase totals and critical-path attribution, and returns the
+        summary (``None`` for unknown uids or when disabled)."""
+        if not self.enabled:
+            return None
+        now = time.perf_counter_ns() / 1000.0
+        with self._lock:
+            tr = self._requests.pop(uid, None)
+            if tr is None:
+                return None
+            for phase, start in tr.open_phases.items():
+                tr.add(phase, now - start)
+            total_us = max(0.0, now - tr.t0_us)
+            attributed = sum(tr.phase_us.values())
+            critical = max(tr.phase_us.items(), key=lambda kv: kv[1])[0] \
+                if tr.phase_us else ""
+            args: Dict[str, Any] = dict(tr.attrs)
+            args.update(attrs)
+            args.update({
+                "trace_id": tr.trace_id, "outcome": outcome,
+                "phase_us": {k: round(v, 3)
+                             for k, v in sorted(tr.phase_us.items())},
+                "phase_n": dict(sorted(tr.phase_n.items())),
+                "critical_path": critical,
+                "phase_share": {
+                    k: round(v / total_us, 4) if total_us > 0 else 0.0
+                    for k, v in sorted(tr.phase_us.items())},
+                "unattributed_us": round(max(0.0, total_us - attributed),
+                                         3),
+            })
+            if tr.migrations:
+                args["migrations"] = tr.migrations
+            self._append_event({
+                "name": "request:%s" % uid, "ph": "X",
+                "ts": tr.t0_us, "dur": total_us,
+                "pid": os.getpid(),
+                # stable per-request lane so each request gets its own
+                # row in the chrome viewer regardless of serving thread
+                "tid": zlib.crc32(uid.encode("utf-8")) % 10000,
+                "args": args,
+            })
+            self._stats.setdefault("request/%s" % outcome,
+                                   []).append(total_us)
+            return {"uid": uid, "trace_id": tr.trace_id,
+                    "outcome": outcome, "total_us": total_us,
+                    "phase_us": dict(tr.phase_us),
+                    "critical_path": critical}
+
     # -- jax.profiler glue ------------------------------------------
     @contextlib.contextmanager
     def profile_step(self, logdir: str = "/tmp/nxd_profile"):
@@ -200,12 +418,24 @@ class SpanTracer:
                 events = (self._events[self._next:]
                           + self._events[:self._next])
             open_named = dict(self._open_named)
+            open_requests = [
+                (tr.uid, tr.trace_id, tr.t0_us, dict(tr.phase_us))
+                for tr in self._requests.values()]
         events = [dict(ev) for ev in events]
         for name, start in sorted(open_named.items()):
             events.append({
                 "name": name, "ph": "X", "ts": start, "dur": 0.0,
                 "pid": os.getpid(), "tid": threading.get_ident() % 10000,
                 "args": {"incomplete": True, "open_for_us": now - start},
+            })
+        for uid, trace_id, start, phase_us in sorted(open_requests):
+            events.append({
+                "name": "request:%s" % uid, "ph": "X", "ts": start,
+                "dur": 0.0, "pid": os.getpid(),
+                "tid": zlib.crc32(uid.encode("utf-8")) % 10000,
+                "args": {"incomplete": True, "trace_id": trace_id,
+                         "open_for_us": now - start,
+                         "phase_us": phase_us},
             })
         return {"traceEvents": events}
 
@@ -242,6 +472,7 @@ class SpanTracer:
             self._next = 0
             self._open_named.clear()
             self._stats.clear()
+            self._requests.clear()
 
 
 #: process-wide default tracer; enabled/disabled in lockstep with the

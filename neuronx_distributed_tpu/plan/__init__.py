@@ -16,9 +16,14 @@ CLI::
 See docs/planner.md.
 """
 
+from .calibrate import (CalibrationResult, LinkFit, calibrate,
+                        fit_alpha_beta, fit_mfu, load_bench_history,
+                        mfu_from_bench)
 from .cost import (CostBreakdown, HardwareSpec, LinkSpec, ModelSpec, Plan,
-                   ServingSpec, cold_start_s, default_hardware,
-                   memory_bytes, param_count, step_cost, step_flops,
+                   ServingCost, ServingPlan, ServingSpec, TrafficSpec,
+                   cold_start_s, default_hardware, memory_bytes,
+                   param_count, serving_cost, serving_pool_blocks,
+                   serving_search, serving_token_s, step_cost, step_flops,
                    tp_overlap_engagement, wire_bytes_per_element)
 from .emit import (plan_to_config, plan_to_config_kwargs, plan_to_yaml_dict,
                    render_kwargs)
@@ -47,10 +52,14 @@ def handpicked_plan(devices: int, *, platform: str = "cpu",
 
 
 __all__ = [
-    "CostBreakdown", "HardwareSpec", "LinkSpec", "ModelSpec", "Plan",
-    "ServingSpec", "cold_start_s", "default_hardware", "memory_bytes",
-    "param_count", "step_cost", "step_flops", "tp_overlap_engagement",
-    "wire_bytes_per_element",
+    "CalibrationResult", "CostBreakdown", "HardwareSpec", "LinkFit",
+    "LinkSpec", "ModelSpec", "Plan", "ServingCost", "ServingPlan",
+    "ServingSpec", "TrafficSpec", "calibrate", "cold_start_s",
+    "default_hardware", "fit_alpha_beta", "fit_mfu",
+    "load_bench_history", "memory_bytes", "mfu_from_bench",
+    "param_count", "serving_cost", "serving_pool_blocks",
+    "serving_search", "serving_token_s", "step_cost", "step_flops",
+    "tp_overlap_engagement", "wire_bytes_per_element",
     "plan_to_config", "plan_to_config_kwargs", "plan_to_yaml_dict",
     "render_kwargs",
     "RefinedPlan", "proxy_measure", "refine",

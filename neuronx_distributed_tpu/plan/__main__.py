@@ -13,8 +13,10 @@ import argparse
 import sys
 from typing import Optional
 
-from . import (ModelSpec, ServingSpec, default_hardware, handpicked_plan,
-               refine, render_kwargs, search, step_cost)
+from . import (ModelSpec, ServingSpec, TrafficSpec, calibrate,
+               default_hardware, handpicked_plan, refine, render_kwargs,
+               search, serving_search, step_cost)
+from .cost import TPOT_P99_OVER_MEAN, TTFT_P99_OVER_MEAN
 from .emit import plan_to_config, plan_to_yaml_dict
 
 
@@ -76,7 +78,32 @@ def main(argv=None) -> int:
                     help="override per-device memory budget, GiB")
     ap.add_argument("--serving", action="store_true",
                     help="plan a serving deployment: single-stage layouts "
-                         "only, paged-KV pool charged to memory")
+                         "only, paged-KV pool charged to memory, and an "
+                         "EngineConfig/router search for the stated "
+                         "traffic mix and SLO")
+    ap.add_argument("--serving-rate", type=float, default=8.0,
+                    metavar="RPS", help="offered request rate (Poisson)")
+    ap.add_argument("--serving-prompt", type=float, default=64.0,
+                    metavar="TOK", help="mean prompt tokens per request")
+    ap.add_argument("--serving-new", type=float, default=16.0,
+                    metavar="TOK", help="mean generated tokens per request")
+    ap.add_argument("--serving-shared", type=float, default=0.0,
+                    metavar="TOK", help="shared prompt-prefix tokens "
+                    "(enables prefix sharing in the emitted config)")
+    ap.add_argument("--serving-block", type=int, default=8,
+                    help="paged-KV block size for the serving search")
+    ap.add_argument("--disaggregated", action="store_true",
+                    help="search disaggregated prefill/decode configs")
+    ap.add_argument("--slo-ttft-p99-ms", type=float, default=None,
+                    help="TTFT p99 target (ms) the serving config must "
+                         "meet")
+    ap.add_argument("--slo-tpot-p99-ms", type=float, default=None,
+                    help="TPOT p99 target (ms) the serving config must "
+                         "meet")
+    ap.add_argument("--calibrate-bench", metavar="DIR", default=None,
+                    help="refit hardware constants from BENCH_*.json "
+                         "history under DIR before planning "
+                         "(plan/calibrate.py)")
     ap.add_argument("--refine", action="store_true",
                     help="re-rank the analytic top-k with measured jitted "
                          "proxies (uses whatever backend is available)")
@@ -96,6 +123,14 @@ def main(argv=None) -> int:
         import dataclasses
 
         hw = dataclasses.replace(hw, hbm_bytes=args.hbm_gb * 2**30)
+    if args.calibrate_bench is not None:
+        cal = calibrate(hw, bench=args.calibrate_bench, model=spec)
+        for w in cal.warnings:
+            print(f"calibrate: {w}")
+        if cal.hardware is not hw:
+            print(f"calibrate: {hw.name} -> {cal.hardware.name} "
+                  f"(mfu={cal.hardware.mfu:.3f})")
+        hw = cal.hardware
     serving = ServingSpec() if args.serving else None
 
     result = search(spec, hw, args.devices, dcn_dp=args.dcn,
@@ -152,6 +187,58 @@ def main(argv=None) -> int:
     else:
         print("emitted config:")
         print(render_kwargs(best))
+
+    if args.serving:
+        import json as _json
+        import math as _math
+
+        traffic = TrafficSpec(request_rate=args.serving_rate,
+                              prompt_tokens=args.serving_prompt,
+                              new_tokens=args.serving_new,
+                              shared_prefix_tokens=args.serving_shared)
+        ttft_tgt = (args.slo_ttft_p99_ms / 1e3
+                    if args.slo_ttft_p99_ms is not None else _math.inf)
+        tpot_tgt = (args.slo_tpot_p99_ms / 1e3
+                    if args.slo_tpot_p99_ms is not None else _math.inf)
+        plans = serving_search(spec, hw, traffic,
+                               slo_ttft_p99_s=ttft_tgt,
+                               slo_tpot_p99_s=tpot_tgt,
+                               tp=best.tp, block_size=args.serving_block,
+                               disaggregated=args.disaggregated,
+                               top_k=args.top_k)
+        print(f"serving plan: rate={traffic.request_rate:g} req/s, "
+              f"prompt={traffic.prompt_tokens:g}, "
+              f"new={traffic.new_tokens:g}, "
+              f"shared={traffic.shared_prefix_tokens:g}"
+              + (f", ttft_p99<={ttft_tgt * 1e3:g}ms"
+                 if _math.isfinite(ttft_tgt) else "")
+              + (f", tpot_p99<={tpot_tgt * 1e3:g}ms"
+                 if _math.isfinite(tpot_tgt) else ""))
+        if not plans:
+            print("serving plan: no feasible engine config "
+                  "(pool never fits — raise --hbm-gb)")
+            return 1
+        print(f"{'#':>3}  {'ttft ms':>9}  {'tpot ms':>9}  {'tok/s':>8}  "
+              f"{'util':>5}  {'slo':>4}  config")
+        for i, p in enumerate(plans, 1):
+            c = p.cost
+            print(f"{i:>3}  {c.ttft_s * 1e3:>9.2f}  {c.tpot_s * 1e3:>9.2f}"
+                  f"  {c.tokens_per_s:>8.1f}  {c.utilization:>5.2f}  "
+                  f"{'ok' if p.meets_slo else 'MISS':>4}  {p.describe()}")
+        chosen = plans[0]
+        if _math.isfinite(ttft_tgt) or _math.isfinite(tpot_tgt):
+            if not chosen.meets_slo:
+                print("serving plan: stated SLO is unattainable at this "
+                      "rate on one replica — emitting the closest config; "
+                      "scale replicas or relax the target")
+        print("emitted serving config (modeled p99: "
+              f"ttft={chosen.cost.ttft_s * TTFT_P99_OVER_MEAN * 1e3:.2f}ms"
+              f", tpot={chosen.cost.tpot_s * TPOT_P99_OVER_MEAN * 1e3:.2f}"
+              "ms):")
+        kw = ", ".join(f"{k}={v!r}" for k, v in chosen.engine.items())
+        print(f"EngineConfig({kw})")
+        if chosen.router:
+            print(f"router: {_json.dumps(chosen.router)}")
 
     # prove the emitted config really initializes when the runtime matches
     import jax

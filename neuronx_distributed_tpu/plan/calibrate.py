@@ -1,0 +1,387 @@
+"""Obs-calibrated planner constants: fit ``HardwareSpec`` from measurement.
+
+Closes the measurement loop (ISSUE 15, layer c): the cost model in
+``plan/cost.py`` prices collectives with hand-set α-β link constants and
+compute with a hand-set ``mfu``. This module refits those numbers from
+what the observability layer actually measured, so the planner's
+rankings track the machine it runs on rather than the datasheet:
+
+* **links** — ``obs.accounting.record_collective_time`` accumulates
+  (payload bytes, wall seconds) per link tier into the
+  ``nxd_collective_seconds`` histogram family; :func:`fit_alpha_beta`
+  runs a count-weighted least squares of ``t = α + β·B`` per tier with
+  one outlier-trimmed refit, and maps the fit onto
+  ``LinkSpec(bandwidth=1/β, latency=α)``.
+* **compute** — step-latency samples (``nxd_train_step_seconds`` or any
+  caller-measured wall times) plus the model's known FLOPs per step give
+  an achieved-efficiency estimate that replaces ``mfu``; serving
+  step-latency intercepts refit ``serve_overhead_s``.
+* **bench history** — ``BENCH_*.json`` records (one flat metric each)
+  contribute throughput figures (``*_tokens_per_sec_per_chip_*``) as an
+  additional mfu source via :func:`mfu_from_bench`.
+
+Robustness contract (regression-pinned in tests/test_calibrate.py): a
+degenerate sample set — a single point, a single distinct payload size,
+zero-byte collectives only, clock-skewed (non-positive or non-finite)
+durations, or a non-positive fitted slope — degrades to the hand-set
+defaults **with a warning recorded in the result**, and the fitted α and
+β are never negative. Calibration must never make the planner worse than
+uncalibrated; it can only refuse.
+
+Jax-free at module load, like the rest of ``plan/``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .cost import HardwareSpec, LinkSpec, ModelSpec, step_flops
+
+#: fits whose RMS fractional residual exceeds this are rejected — the
+#: samples disagree with the α-β form badly enough that hand-set
+#: constants are the safer ranking basis.
+MAX_RELATIVE_RESIDUAL = 0.5
+#: achieved efficiency must land in this open interval to replace mfu;
+#: outside it the measurement contradicts the stated peak FLOPs.
+MFU_BOUNDS = (1e-4, 1.0)
+
+
+@dataclass(frozen=True)
+class LinkFit:
+    """One tier's fitted α-β constants and fit quality.
+
+    ``alpha`` is the per-collective latency intercept (seconds),
+    ``beta`` the per-byte slope (seconds/byte, i.e. 1/bandwidth);
+    ``residual`` is the RMS *fractional* error of the fit over the
+    samples that survived trimming; ``n`` counts weighted samples used;
+    ``source`` says where the samples came from (``registry``,
+    ``samples``, ``default`` when the fit degraded)."""
+
+    tier: str
+    alpha: float
+    beta: float
+    residual: float
+    n: int
+    source: str
+
+    @property
+    def link(self) -> LinkSpec:
+        return LinkSpec(bandwidth=1.0 / self.beta if self.beta > 0
+                        else math.inf,
+                        latency=self.alpha)
+
+    def to_dict(self) -> dict:
+        return {f.name: getattr(self, f.name)
+                for f in dataclasses.fields(self)}
+
+
+@dataclass(frozen=True)
+class CalibrationResult:
+    """A calibrated :class:`HardwareSpec` plus the evidence trail."""
+
+    hardware: HardwareSpec
+    links: Dict[str, LinkFit] = field(default_factory=dict)
+    mfu: Optional[float] = None
+    serve_overhead_s: Optional[float] = None
+    warnings: Tuple[str, ...] = ()
+
+    def to_dict(self) -> dict:
+        return dict(
+            hardware=dataclasses.asdict(self.hardware),
+            links={t: f.to_dict() for t, f in self.links.items()},
+            mfu=self.mfu, serve_overhead_s=self.serve_overhead_s,
+            warnings=list(self.warnings))
+
+
+def _clean_pairs(pairs: Sequence, warn: List[str], tier: str
+                 ) -> List[Tuple[float, float, float]]:
+    """Normalize samples to (nbytes, seconds, weight), dropping entries a
+    wall clock cannot legitimately produce (negative / zero / non-finite
+    durations — NTP steps and clock skew show up exactly like this)."""
+    out: List[Tuple[float, float, float]] = []
+    skewed = 0
+    for p in pairs:
+        try:
+            b = float(p[0])
+            t = float(p[1])
+            w = float(p[2]) if len(p) > 2 else 1.0
+        except (TypeError, ValueError, IndexError):
+            skewed += 1
+            continue
+        if not (math.isfinite(b) and math.isfinite(t) and math.isfinite(w)):
+            skewed += 1
+            continue
+        if b < 0 or t <= 0 or w <= 0:
+            skewed += 1
+            continue
+        out.append((b, t, w))
+    if skewed:
+        warn.append(f"{tier}: dropped {skewed} unusable sample(s) "
+                    "(non-finite, non-positive, or malformed)")
+    return out
+
+
+def _wls(pairs: Sequence[Tuple[float, float, float]]
+         ) -> Optional[Tuple[float, float]]:
+    """Count-weighted least squares of t = α + β·B. None when singular."""
+    sw = sum(w for _, _, w in pairs)
+    sb = sum(w * b for b, _, w in pairs)
+    st = sum(w * t for _, t, w in pairs)
+    sbb = sum(w * b * b for b, _, w in pairs)
+    sbt = sum(w * b * t for b, t, w in pairs)
+    det = sw * sbb - sb * sb
+    if det <= 0 or not math.isfinite(det):
+        return None
+    beta = (sw * sbt - sb * st) / det
+    alpha = (st - beta * sb) / sw
+    return alpha, beta
+
+
+def _residual(pairs: Sequence[Tuple[float, float, float]],
+              alpha: float, beta: float) -> float:
+    num = den = 0.0
+    for b, t, w in pairs:
+        pred = alpha + beta * b
+        num += w * ((pred - t) / t) ** 2
+        den += w
+    return math.sqrt(num / den) if den > 0 else math.inf
+
+
+def fit_alpha_beta(pairs: Sequence, *, tier: str = "ici",
+                   default: Optional[LinkSpec] = None,
+                   source: str = "samples",
+                   warn: Optional[List[str]] = None) -> LinkFit:
+    """Fit one tier's α-β constants from (nbytes, seconds[, count]) pairs.
+
+    Robust pipeline: drop unusable samples, weighted LS, clamp a slightly
+    negative intercept to α=0 (refitting β through the origin), one
+    trimmed refit without the worst-residual sample when enough remain.
+    Any degenerate outcome — fewer than two distinct payload sizes, a
+    non-positive slope (bigger payloads measured *faster*: contention or
+    noise, not a link law), or an oversized residual — returns the
+    ``default`` constants with ``source="default"`` and a recorded
+    warning. The returned α and β are never negative."""
+    w = warn if warn is not None else []
+    default = default or HardwareSpec().ici
+    fallback = LinkFit(tier=tier, alpha=default.latency,
+                       beta=1.0 / default.bandwidth, residual=math.inf,
+                       n=0, source="default")
+
+    clean = _clean_pairs(pairs, w, tier)
+    distinct = {b for b, _, _ in clean}
+    if len(distinct) < 2:
+        w.append(f"{tier}: {len(distinct)} distinct payload size(s) — "
+                 "need 2+ to separate latency from bandwidth; keeping "
+                 "hand-set constants")
+        return fallback
+
+    def _solve(pts):
+        sol = _wls(pts)
+        if sol is None:
+            return None
+        alpha, beta = sol
+        if alpha < 0:
+            # pure-bandwidth refit through the origin
+            sbb = sum(ww * b * b for b, _, ww in pts)
+            sbt = sum(ww * b * t for b, t, ww in pts)
+            alpha, beta = 0.0, (sbt / sbb if sbb > 0 else -1.0)
+        if beta <= 0 or not math.isfinite(beta):
+            return None
+        return alpha, beta
+
+    sol = _solve(clean)
+    if sol is not None and len(clean) > 3:
+        # one trimmed refit: drop the worst fractional residual
+        a, b_ = sol
+        worst = max(clean, key=lambda p: abs((a + b_ * p[0] - p[1]) / p[1]))
+        trimmed = [p for p in clean if p is not worst]
+        if len({b for b, _, _ in trimmed}) >= 2:
+            sol2 = _solve(trimmed)
+            if sol2 is not None and \
+                    _residual(trimmed, *sol2) < _residual(clean, *sol):
+                sol, clean = sol2, trimmed
+    if sol is None:
+        w.append(f"{tier}: non-positive fitted slope — samples do not "
+                 "follow t = α + β·B; keeping hand-set constants")
+        return fallback
+    alpha, beta = sol
+    res = _residual(clean, alpha, beta)
+    if res > MAX_RELATIVE_RESIDUAL:
+        w.append(f"{tier}: fit residual {res:.0%} exceeds "
+                 f"{MAX_RELATIVE_RESIDUAL:.0%}; keeping hand-set "
+                 "constants")
+        return fallback
+    n = int(sum(ww for _, _, ww in clean))
+    return LinkFit(tier=tier, alpha=max(0.0, alpha), beta=beta,
+                   residual=res, n=n, source=source)
+
+
+def fit_mfu(step_seconds: Sequence[float], flops_per_step: float,
+            hw: HardwareSpec, *, devices: int = 1,
+            warn: Optional[List[str]] = None) -> Optional[float]:
+    """Achieved compute efficiency from measured step wall times: the
+    median step implies ``flops_per_step / (median · devices · peak)``.
+    Median, not mean — compile steps and GC pauses pollute the tail.
+    Returns None (with a warning) when the implied efficiency falls
+    outside ``MFU_BOUNDS``."""
+    w = warn if warn is not None else []
+    times = sorted(t for t in step_seconds
+                   if isinstance(t, (int, float)) and math.isfinite(t)
+                   and t > 0)
+    if not times or flops_per_step <= 0:
+        w.append("mfu: no usable step-latency samples")
+        return None
+    med = times[len(times) // 2]
+    eff = flops_per_step / (med * max(1, devices) * hw.flops)
+    lo, hi = MFU_BOUNDS
+    if not (lo < eff <= hi):
+        w.append(f"mfu: implied efficiency {eff:.3g} outside ({lo}, {hi}] "
+                 "— measurement contradicts stated peak FLOPs; keeping "
+                 f"hand-set mfu={hw.mfu}")
+        return None
+    return eff
+
+
+def load_bench_history(path: str = ".") -> List[dict]:
+    """Parsed metrics from ``BENCH_*.json`` files under ``path`` (a
+    directory or a glob). Each file holds one record with a flat
+    ``parsed: {metric, value, unit}``; malformed files are skipped —
+    bench history is an opportunistic calibration source, never a
+    required one."""
+    if os.path.isdir(path):
+        pattern = os.path.join(path, "BENCH_*.json")
+    else:
+        pattern = path
+    out: List[dict] = []
+    for fn in sorted(glob.glob(pattern)):
+        try:
+            with open(fn) as f:
+                rec = json.load(f)
+            parsed = rec.get("parsed") or {}
+            metric = parsed.get("metric")
+            value = float(parsed.get("value"))
+        except (OSError, ValueError, TypeError, json.JSONDecodeError):
+            continue
+        if not metric or not math.isfinite(value):
+            continue
+        out.append(dict(metric=str(metric), value=value,
+                        unit=parsed.get("unit"), file=os.path.basename(fn)))
+    return out
+
+
+def mfu_from_bench(records: Sequence[dict], m: ModelSpec, hw: HardwareSpec,
+                   *, pattern: str = "tokens_per_sec_per_chip",
+                   warn: Optional[List[str]] = None) -> Optional[float]:
+    """Efficiency implied by bench-history throughput records: a
+    ``*_tokens_per_sec_per_chip_*`` figure times the model's training
+    FLOPs per token, over peak. Uses the best (highest) run — bench
+    history mixes machines and regressions; calibration wants the
+    demonstrated capability, not the average incident."""
+    w = warn if warn is not None else []
+    vals = [r["value"] for r in records
+            if pattern in r.get("metric", "")
+            and hw.name in r.get("metric", "") and r["value"] > 0]
+    if not vals:
+        vals = [r["value"] for r in records
+                if pattern in r.get("metric", "") and r["value"] > 0]
+    if not vals:
+        w.append(f"bench: no '{pattern}' records in history")
+        return None
+    fpt = step_flops(m, remat=True) / m.tokens_per_step
+    eff = max(vals) * fpt / hw.flops
+    lo, hi = MFU_BOUNDS
+    if not (lo < eff <= hi):
+        w.append(f"bench: implied efficiency {eff:.3g} outside "
+                 f"({lo}, {hi}]; ignoring bench history")
+        return None
+    return eff
+
+
+def _registry_samples(registry: Any) -> Dict[str, list]:
+    """Collective (bytes, seconds, count) samples from a live metrics
+    registry, via ``obs.accounting.collective_samples``. Lazy import so
+    ``plan`` stays importable standalone."""
+    try:
+        from ..obs.accounting import collective_samples
+    except ImportError:  # pragma: no cover
+        return {}
+    return {tier: [(b, t, c) for b, t, c in pairs]
+            for tier, pairs in collective_samples(registry).items()}
+
+
+def calibrate(base: Optional[HardwareSpec] = None, *,
+              samples: Optional[Dict[str, Sequence]] = None,
+              registry: Any = None,
+              step_seconds: Optional[Sequence[float]] = None,
+              flops_per_step: Optional[float] = None,
+              devices: int = 1,
+              serve_step_seconds: Optional[Sequence[float]] = None,
+              bench: Optional[str] = None,
+              model: Optional[ModelSpec] = None) -> CalibrationResult:
+    """Refit ``base`` (default: the stock :func:`default_hardware` TPU
+    spec) from whatever measurement sources are on hand; every source is
+    optional and every degenerate source degrades to the hand-set
+    constant with a recorded warning.
+
+    * ``samples`` — ``{tier: [(nbytes, seconds[, count]), ...]}``
+      collective timings (e.g. an exported obs snapshot).
+    * ``registry`` — a live ``MetricsRegistry`` to pull the same from
+      (``nxd_collective_seconds``); used only when ``samples`` is None.
+    * ``step_seconds`` + ``flops_per_step`` — training step walls
+      (``nxd_train_step_seconds``) refit ``mfu``.
+    * ``serve_step_seconds`` — serving step walls refit
+      ``serve_overhead_s`` (their floor: the emptiest observed step).
+    * ``bench`` + ``model`` — a ``BENCH_*.json`` directory/glob refits
+      ``mfu`` when no step samples were given.
+    """
+    hw = base or HardwareSpec()
+    warn: List[str] = []
+    if samples is None and registry is not None:
+        samples = _registry_samples(registry)
+
+    links: Dict[str, LinkFit] = {}
+    replace: Dict[str, Any] = {}
+    for tier in ("ici", "dcn"):
+        pairs = (samples or {}).get(tier)
+        if not pairs:
+            continue
+        fit = fit_alpha_beta(pairs, tier=tier, default=getattr(hw, tier),
+                             source="registry" if registry is not None
+                             else "samples", warn=warn)
+        links[tier] = fit
+        if fit.source != "default":
+            replace[tier] = fit.link
+
+    mfu: Optional[float] = None
+    if step_seconds and flops_per_step:
+        mfu = fit_mfu(step_seconds, flops_per_step, hw,
+                      devices=devices, warn=warn)
+    if mfu is None and bench is not None and model is not None:
+        mfu = mfu_from_bench(load_bench_history(bench), model, hw,
+                             warn=warn)
+    if mfu is not None:
+        replace["mfu"] = mfu
+
+    overhead: Optional[float] = None
+    if serve_step_seconds:
+        floor = [t for t in serve_step_seconds
+                 if isinstance(t, (int, float)) and math.isfinite(t)
+                 and t > 0]
+        if floor:
+            overhead = min(floor)
+            replace["serve_overhead_s"] = overhead
+        else:
+            warn.append("serve: no usable serving step samples")
+
+    if replace:
+        replace["name"] = hw.name + "+cal"
+        hw = dataclasses.replace(hw, **replace)
+    return CalibrationResult(hardware=hw, links=links, mfu=mfu,
+                             serve_overhead_s=overhead,
+                             warnings=tuple(warn))

@@ -66,6 +66,10 @@ class HardwareSpec:
     dcn: LinkSpec = LinkSpec(bandwidth=3.125e9, latency=25e-6)
     #: fraction of HBM a plan may budget (runtime/XLA scratch takes the rest)
     memory_fraction: float = 0.92
+    #: fixed per-step host overhead of one packed serving step (schedule,
+    #: dispatch, token readback) — the intercept of the serving cost
+    #: model; ``plan/calibrate.py`` refits it from step-latency samples
+    serve_overhead_s: float = 5e-4
 
     @property
     def memory_budget(self) -> float:
@@ -80,7 +84,8 @@ def default_hardware(platform: str = "tpu") -> HardwareSpec:
         return HardwareSpec(name="cpu", flops=5e10, mfu=0.5,
                             hbm_bytes=4 * 2**30,
                             ici=LinkSpec(bandwidth=8e9, latency=2e-6),
-                            dcn=LinkSpec(bandwidth=1e9, latency=50e-6))
+                            dcn=LinkSpec(bandwidth=1e9, latency=50e-6),
+                            serve_overhead_s=2e-3)
     return HardwareSpec()
 
 
@@ -572,3 +577,269 @@ def cold_start_s(plan: Plan, m: ModelSpec, hw: HardwareSpec,
         bundle = AOT_BYTES_PER_LAYER * stage_layers
         return AOT_LOAD_BASE_S + bundle / hw.dcn.bandwidth + fetch_s
     return COMPILE_BASE_S + COMPILE_PER_LAYER_S * stage_layers + fetch_s
+
+
+# ---------------------------------------------------------------------------
+# Serving cost model (request-level)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class TrafficSpec:
+    """Offered serving load: Poisson arrivals at ``request_rate`` req/s,
+    each with ``prompt_tokens`` of context (of which
+    ``shared_prefix_tokens`` are trie-shareable across requests) and
+    ``new_tokens`` generated tokens. Means, not maxima — the queueing
+    terms below supply the tail."""
+
+    request_rate: float
+    prompt_tokens: float = 64.0
+    new_tokens: float = 16.0
+    shared_prefix_tokens: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.request_rate < 0:
+            raise ValueError("request_rate must be >= 0")
+        if self.shared_prefix_tokens > self.prompt_tokens:
+            raise ValueError("shared_prefix_tokens exceeds prompt_tokens")
+
+    @property
+    def unique_prompt_tokens(self) -> float:
+        """Prompt tokens that must actually be prefilled per request when
+        prefix sharing absorbs the shared head."""
+        return max(0.0, self.prompt_tokens - self.shared_prefix_tokens)
+
+
+#: dequant tax on a quantized KV pool: the packed step spends extra
+#: element-wise work unpacking int8 KV before attention.
+QUANTIZED_COMPUTE_OVERHEAD = 1.1
+#: p99/mean inflation applied when checking a modeled mean against a p99
+#: SLO target. TTFT inherits the arrival process's queueing variance
+#: (M/G/1-ish); TPOT is step-paced and much tighter.
+TTFT_P99_OVER_MEAN = 3.0
+TPOT_P99_OVER_MEAN = 1.5
+#: per-request length cap headroom: TrafficSpec states *mean* prompt/new
+#: tokens, but the emitted ``max_blocks_per_seq`` is a hard admission cap
+#: — size it for the tail so the engine never rejects a legitimately
+#: long request as never_fits.
+REQUEST_TOKENS_MAX_OVER_MEAN = 2.0
+
+
+def serving_token_s(m: ModelSpec, hw: HardwareSpec, *, context: float = 0.0,
+                    tp: int = 1, quantized: bool = False) -> float:
+    """Marginal wall time of one extra row in a packed serving step:
+    forward matmul FLOPs for one token plus its attention reads over
+    ``context`` cached KV entries, at the hardware's dense efficiency.
+    The step's fixed overhead lives in ``hw.serve_overhead_s``."""
+    n_matmul = param_count(m) - m.vocab * m.hidden
+    flops = 2.0 * n_matmul
+    flops += 4.0 * context * m.heads * m.head_dim_ * m.layers
+    if quantized:
+        flops *= QUANTIZED_COMPUTE_OVERHEAD
+    return flops / (max(1, tp) * hw.flops * hw.mfu)
+
+
+@dataclass(frozen=True)
+class ServingCost:
+    """Modeled steady-state serving behavior for one engine config under
+    one traffic mix. All figures are per-replica means; compare p99 SLO
+    targets against ``*_P99_OVER_MEAN`` times these."""
+
+    ttft_s: float            # arrival -> first token (queue + prefill)
+    tpot_s: float            # per generated token after the first
+    tokens_per_s: float      # generated-token goodput actually served
+    step_s: float            # modeled packed-step wall time
+    utilization: float       # max of token-capacity and slot pressure
+    concurrency: float       # mean live decode slots (Little's law)
+    saturated: bool          # offered load exceeds capacity
+
+    def to_dict(self) -> dict:
+        return {f.name: getattr(self, f.name)
+                for f in dataclasses.fields(self)}
+
+
+def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
+                 token_budget: int, max_slots: int,
+                 prefill_budget: Optional[int] = None,
+                 quantized: bool = False, tp: int = 1) -> ServingCost:
+    """Steady-state TTFT / TPOT / goodput of one continuous-batching
+    engine (``inference.engine.ServingEngine``) under Poisson load.
+
+    The packed step is padded to a fixed ``token_budget`` width (that is
+    what keeps it one executable), so every step costs
+    ``step_s = serve_overhead_s + token_budget * token_s`` *regardless
+    of occupancy* — oversizing the budget buys capacity at the price of
+    every step's latency. Decode concurrency follows from Little's law
+    (rate x residence), TPOT stretches when live slots outnumber the
+    decode rows a step can carry, and TTFT stacks an M/G/1-style
+    queueing wait ``rho/(1-rho) * step_s`` on top of the prefill
+    slicing delay. Saturation (``rho >= 1``) caps goodput at capacity
+    instead of diverging, so search ranking stays total."""
+    t = traffic
+    token_s = serving_token_s(
+        m, hw, context=t.prompt_tokens + t.new_tokens / 2.0,
+        tp=tp, quantized=quantized)
+    prompt_eff = t.unique_prompt_tokens
+    tokens_per_req = prompt_eff + t.new_tokens
+    demand_tps = t.request_rate * tokens_per_req
+
+    # padded width: a step pays for the whole budget, occupied or not
+    step_s = hw.serve_overhead_s + token_s * token_budget
+    capacity_tps = token_budget / step_s
+
+    decode_rows = float(min(max_slots, token_budget))
+    # Little's law on the decode phase: a slot holds new_tokens steps.
+    # slot_demand <= decode_rows -> every live request advances each
+    # step (tpot = step_s); beyond that slots queue and TPOT stretches.
+    slot_demand = t.request_rate * t.new_tokens * step_s
+    conc = min(slot_demand, decode_rows)
+    tpot = step_s * max(1.0, slot_demand / decode_rows)
+    rho = max(demand_tps / capacity_tps, slot_demand / decode_rows)
+    saturated = rho >= 1.0
+
+    if prefill_budget is not None:
+        prefill_rows = float(max(1, prefill_budget))
+    else:
+        prefill_rows = max(1.0, token_budget - conc)
+    prefill_steps = (math.ceil(prompt_eff / prefill_rows)
+                     if prompt_eff > 0 else 0)
+    rho_q = min(rho, 0.99)
+    wait = rho_q / (1.0 - rho_q) * step_s
+    ttft = wait + (prefill_steps + 1) * step_s
+
+    if saturated:
+        goodput = min(capacity_tps * (t.new_tokens
+                                      / max(1e-9, tokens_per_req)),
+                      decode_rows / step_s)
+    else:
+        goodput = t.request_rate * t.new_tokens
+    return ServingCost(ttft_s=ttft, tpot_s=tpot, tokens_per_s=goodput,
+                       step_s=step_s, utilization=rho, concurrency=conc,
+                       saturated=saturated)
+
+
+def serving_pool_blocks(m: ModelSpec, traffic: TrafficSpec, *,
+                        block_size: int, max_slots: int,
+                        slack: float = 1.25) -> int:
+    """Paged-pool blocks the stated mix needs: every concurrent slot at
+    full sequence length plus the shared prefix held once, with
+    fragmentation slack. Conservative — prefix sharing only shrinks the
+    footprint further."""
+    per_seq = math.ceil((traffic.prompt_tokens + traffic.new_tokens)
+                        / block_size)
+    shared = math.ceil(traffic.shared_prefix_tokens / block_size)
+    return int(math.ceil((max_slots * per_seq + shared) * slack))
+
+
+@dataclass(frozen=True)
+class ServingPlan:
+    """One serving candidate: plain-dict ``EngineConfig`` /
+    ``RouterConfig`` kwargs (this module stays jax-free; callers build
+    the real config objects) plus its modeled cost and SLO verdict."""
+
+    engine: dict
+    router: dict
+    cost: ServingCost
+    meets_slo: bool
+    slo: dict
+
+    def describe(self) -> str:
+        e = self.engine
+        tags = [f"budget={e['token_budget']}", f"slots={e['max_slots']}",
+                f"blocks={e['num_blocks']}x{e['block_size']}"]
+        if e.get("disaggregated"):
+            tags.append(f"disagg/pf={e['prefill_budget']}")
+        if e.get("prefix_sharing"):
+            tags.append("prefix")
+        if e.get("quantized"):
+            tags.append("q8kv")
+        return " ".join(tags)
+
+    def to_dict(self) -> dict:
+        return dict(engine=dict(self.engine), router=dict(self.router),
+                    cost=self.cost.to_dict(), meets_slo=self.meets_slo,
+                    slo=dict(self.slo))
+
+
+def serving_search(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
+                   slo_ttft_p99_s: float = math.inf,
+                   slo_tpot_p99_s: float = math.inf,
+                   tp: int = 1, quantized: bool = False,
+                   block_size: int = 8,
+                   budgets: tuple = (4, 8, 16, 32, 64, 128, 256),
+                   slots: tuple = (1, 2, 4, 8, 12, 16, 24, 32),
+                   disaggregated: bool = False,
+                   top_k: int = 5) -> list:
+    """Enumerate (token_budget, max_slots[, prefill_budget]) engine
+    configs for the stated traffic and SLO, score each with
+    :func:`serving_cost`, and return the top candidates.
+
+    Ranking: SLO-feasible before infeasible, unsaturated before
+    saturated, then highest goodput; among configs within 2% of the best
+    goodput, the lowest modeled TTFT wins (burst absorption), then the
+    smallest ``token_budget`` / ``max_slots`` — headroom you don't need
+    is compile width and pool memory you pay for. Candidates whose KV
+    pool would not fit ``hw.memory_budget`` are dropped."""
+    seq_cap = m.seq
+    need = traffic.prompt_tokens + traffic.new_tokens
+    cands = []
+    for budget in budgets:
+        for ms in slots:
+            if ms > budget * 2:
+                continue
+            nblocks = serving_pool_blocks(m, traffic,
+                                          block_size=block_size,
+                                          max_slots=ms)
+            spec = ServingSpec(num_blocks=nblocks, block_size=block_size,
+                               quantized=quantized,
+                               kv_bytes=1 if quantized else 2)
+            if _kv_pool_bytes(m, spec, tp) > hw.memory_budget:
+                continue
+            pf_opts = ([None] if not disaggregated
+                       else [max(ms, budget // 4)])
+            for pf in pf_opts:
+                cost = serving_cost(m, hw, traffic, token_budget=budget,
+                                    max_slots=ms, prefill_budget=pf,
+                                    quantized=quantized, tp=tp)
+                meets = (cost.ttft_s * TTFT_P99_OVER_MEAN <= slo_ttft_p99_s
+                         and cost.tpot_s * TPOT_P99_OVER_MEAN
+                         <= slo_tpot_p99_s
+                         and not cost.saturated)
+                mbps = max(1, math.ceil(
+                    min(need * REQUEST_TOKENS_MAX_OVER_MEAN, seq_cap)
+                    / block_size))
+                engine = dict(block_size=block_size, num_blocks=nblocks,
+                              max_slots=ms, max_blocks_per_seq=mbps,
+                              token_budget=budget)
+                if quantized:
+                    engine["quantized"] = True
+                if traffic.shared_prefix_tokens > 0:
+                    engine["prefix_sharing"] = True
+                if pf is not None:
+                    engine["disaggregated"] = True
+                    engine["prefill_budget"] = pf
+                slo = dict(ttft_p99_s=slo_ttft_p99_s,
+                           tpot_p99_s=slo_tpot_p99_s)
+                router = {}
+                if math.isfinite(slo_ttft_p99_s) \
+                        or math.isfinite(slo_tpot_p99_s):
+                    router["slo"] = {k: v for k, v in slo.items()
+                                     if math.isfinite(v)}
+                cands.append(ServingPlan(engine=engine, router=router,
+                                         cost=cost, meets_slo=meets,
+                                         slo=slo))
+    cands.sort(key=lambda p: (not p.meets_slo, p.cost.saturated,
+                              -p.cost.tokens_per_s,
+                              p.engine["token_budget"],
+                              p.engine["max_slots"]))
+    if cands:
+        best = cands[0]
+        peers = [p for p in cands
+                 if p.meets_slo == best.meets_slo
+                 and p.cost.saturated == best.cost.saturated
+                 and p.cost.tokens_per_s >= 0.98 * best.cost.tokens_per_s]
+        peers.sort(key=lambda p: (round(p.cost.ttft_s, 4),
+                                  p.engine["token_budget"],
+                                  p.engine["max_slots"]))
+        rest = [p for p in cands if p not in peers]
+        cands = peers + rest
+    return cands[:top_k]

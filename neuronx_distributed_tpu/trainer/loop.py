@@ -152,6 +152,10 @@ class Trainer:
         # When obs is disabled every hook below is a single bool check.
         self._compile_tracker = CompileTracker.for_function(
             "trainer/step", step_fn)
+        # obs handle cache, keyed by (registry, generation) so a mid-run
+        # reset() rebuilds the children instead of writing into dropped
+        # metrics
+        self._obs_cache = None
         # host-side mirror of state.step: callbacks read this instead of
         # int(state.step), which would force a device sync every iteration
         # and break async dispatch overlap
@@ -207,11 +211,25 @@ class Trainer:
             t0 = time.perf_counter()
             with tracer.span("train/step", step=self.host_step):
                 self.state, metrics = self.step_fn(self.state, batch)
-            self._compile_tracker.poll(wall_s=time.perf_counter() - t0)
+            wall_s = time.perf_counter() - t0
+            self._compile_tracker.poll(wall_s=wall_s)
             self.host_step += 1
             if reg.enabled:
-                reg.counter("nxd_train_steps_total",
-                            "Train steps completed.").inc()
+                cache = self._obs_cache
+                if (cache is None or cache[0] is not reg
+                        or cache[1] != reg.generation):
+                    cache = (reg, reg.generation,
+                             reg.counter("nxd_train_steps_total",
+                                         "Train steps completed."),
+                             reg.histogram(
+                                 "nxd_train_step_seconds",
+                                 "Wall time per train step (dispatch + "
+                                 "any blocking compile) — the planner's "
+                                 "compute-efficiency calibration "
+                                 "source."))
+                    self._obs_cache = cache
+                cache[2].inc()
+                cache[3].observe(wall_s)
             # phase: checkpoint et al. — callbacks (CheckpointCallback
             # opens its own train/checkpoint span inside)
             with tracer.span("train/callbacks", step=self.host_step):

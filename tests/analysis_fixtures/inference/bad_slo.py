@@ -1,0 +1,27 @@
+"""Fixture: hard-coded latency thresholds outside SloPolicy (rule slo).
+
+Lives under an ``inference/`` directory so the scoped rule applies."""
+
+
+def should_degrade(stats):
+    if stats.ttft_p99_s > 0.25:           # BAD: invisible SLO
+        return True
+    return stats.tpot_ms >= 40            # BAD: ordering vs literal
+
+
+def queue_pressure(queue_wait_s):
+    return 1.5 < queue_wait_s             # BAD: literal on the left
+
+
+def fine(stats, pol, self_like):
+    if pol.ttft_p99_high_s > 0.25:        # ok: policy attr is the source
+        pass
+    if stats.ttft_p99_s > pol.ttft_p99_high_s:   # ok: no literal
+        pass
+    if self_like.cfg.max_queue_s < 2.0:   # ok: config-sourced
+        pass
+    if stats.ttft_s > 0:                  # ok: validity guard, not an SLO
+        pass
+    if stats.retries > 3:                 # ok: not a latency name
+        pass
+    return stats.ttft_p99_s == 0.25       # ok: equality, not a threshold

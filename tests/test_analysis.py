@@ -316,6 +316,49 @@ def test_elasticity_scoped_and_exempts_cache_module():
         axes=DEFAULT_AXES)] == ["elasticity"]
 
 
+def test_slo_fires_on_fixture():
+    fs = _lint(os.path.join("inference", "bad_slo.py"))
+    assert _rules(fs) == {"slo"}
+    live = [f for f in fs if not f.suppressed]
+    # exactly the three hard-coded thresholds; none of the ok: lines
+    assert len(live) == 3
+    msgs = " | ".join(f.message for f in live)
+    assert "ttft_p99_s" in msgs and "tpot_ms" in msgs \
+        and "queue_wait_s" in msgs
+    assert "SloPolicy" in msgs
+    assert not any(f.line > 14 for f in live)
+
+
+def test_slo_scoped_and_policy_attrs_exempt():
+    bad = ("def degrade(stats):\n"
+           "    return stats.ttft_p99_s > 0.25\n")
+    # outside inference/ a latency literal is not this rule's business...
+    assert analyze_source(bad, "mymodel/trainer/loop.py",
+                          axes=DEFAULT_AXES) == []
+    # ...inside it fires
+    assert [f.rule for f in analyze_source(
+        bad, "mymodel/inference/router.py",
+        axes=DEFAULT_AXES)] == ["slo"]
+    # thresholds routed through a policy/config object stay quiet
+    ok = ("def degrade(stats, pol):\n"
+          "    return stats.ttft_p99_s > pol.ttft_p99_high_s\n"
+          "def drain(self, wait_s):\n"
+          "    return wait_s > self.cfg.max_queue_s\n")
+    assert analyze_source(ok, "mymodel/inference/router.py",
+                          axes=DEFAULT_AXES) == []
+
+
+def test_slo_self_gate_inference_package():
+    """The serving stack itself must hold the bar the rule sets: every
+    latency threshold in inference/ is policy-sourced."""
+    pkg = os.path.join(REPO, "neuronx_distributed_tpu", "inference")
+    paths = [os.path.join(pkg, f) for f in sorted(os.listdir(pkg))
+             if f.endswith(".py")]
+    fs = [f for f in analyze_paths(paths)
+          if f.rule == "slo" and not f.suppressed]
+    assert fs == [], [f"{f.path}:{f.line} {f.message}" for f in fs]
+
+
 def test_paging_refcount_fires_on_fixture():
     fs = _lint(os.path.join("inference", "bad_refcount_bypass.py"))
     assert _rules(fs) == {"paging-refcount"}
@@ -499,7 +542,8 @@ def test_cli_nonzero_on_fixture_corpus():
                          "recompile-hazard", "resilience",
                          "comm-compression", "tp-overlap",
                          "serving-resilience", "paging-refcount", "plan",
-                         "observability", "elasticity", "integrity"}
+                         "observability", "elasticity", "integrity",
+                         "slo"}
 
 
 def test_cli_zero_on_clean_file():

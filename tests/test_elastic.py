@@ -522,4 +522,8 @@ def test_elastic_chaos_drill_acceptance(tiny_model, tmp_path):
     assert m["elastic_revivals"] >= 1
     assert m["max_compile_count"] == 1
     assert m["aot_warm_loaded"] == 1.0
+    # the drill's deliberately-unmeetable SLO goes into sustained breach
+    # and the breach is what the autoscaler acts on
+    assert m["elastic_slo_breaches"] >= 1
+    assert m["elastic_slo_scale_ups"] >= 1
     assert m["bundle_cold_start_warm_ms"] <= m["bundle_cold_start_ms"] / 10

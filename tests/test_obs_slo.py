@@ -1,0 +1,390 @@
+"""Request-level observability: per-request distributed traces, the
+declarative SLO layer (``obs/slo.py``), the deterministic histogram
+reservoir, and their wiring into the serving engine and router.
+
+Regression pins for ISSUE 15's satellites: mid-run registry reset keeps
+the step histogram and EngineStats telling the same story; rejections
+carry the trace-id; a failed-over request's retired trace shows the
+resubmit hop; a sustained SLO breach is what the autoscaler acts on.
+"""
+
+import json
+import math
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+
+from neuronx_distributed_tpu import obs
+from neuronx_distributed_tpu.obs.events import subscribe
+from neuronx_distributed_tpu.obs.metrics import (HISTOGRAM_RESERVOIR,
+                                                 MetricsRegistry)
+from neuronx_distributed_tpu.obs.slo import (SloMonitor, SloPolicy,
+                                             slo_from_dict)
+from neuronx_distributed_tpu.obs.tracing import SpanTracer
+from neuronx_distributed_tpu.parallel import mesh as ps
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    was = obs.enabled()
+    obs.reset()
+    yield
+    obs.reset()
+    if was:
+        obs.enable()
+    else:
+        obs.disable()
+
+
+@pytest.fixture
+def events():
+    captured = []
+    unsub = subscribe(lambda name, attrs: captured.append((name, attrs)))
+    yield captured
+    unsub()
+
+
+@pytest.fixture
+def tiny_model():
+    ps.initialize_model_parallel()
+    from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                      tiny_config)
+    cfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                      num_layers=2)
+    params = meta.unbox(LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    return cfg, params
+
+
+def _ecfg(**kw):
+    from neuronx_distributed_tpu.inference.engine import EngineConfig
+    base = dict(block_size=4, num_blocks=16, max_slots=2,
+                max_blocks_per_seq=8, token_budget=8,
+                kv_dtype=jnp.float32)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _prompts(cfg, n, length=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size, (length,)).tolist()
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# SloPolicy / SloMonitor
+# ---------------------------------------------------------------------------
+
+def test_policy_only_pays_for_stated_objectives():
+    assert SloPolicy().targeted() == ()
+    pol = SloPolicy(ttft_p99_s=0.2, availability=0.99)
+    assert pol.targeted() == ("ttft_p99_s", "availability")
+    assert pol.target_of("availability") == 0.99
+    rt = slo_from_dict({"name": "gold", "tpot_p99_s": 0.05,
+                        "not_a_field": 1})
+    assert rt.name == "gold" and rt.targeted() == ("tpot_p99_s",)
+
+
+def test_breach_needs_patience_then_recovers(events):
+    """A violated objective must persist ``breach_patience`` consecutive
+    evaluations before one slo_breach fires; dropping back under target
+    emits slo_recovered and clears the gauge."""
+    reg = MetricsRegistry()
+    reg.enable()
+    pol = SloPolicy(name="p", ttft_p99_s=0.1, min_samples=4,
+                    breach_patience=3, window=32)
+    mon = SloMonitor(pol, registry=reg)
+    for _ in range(8):
+        mon.observe(ttft_s=0.5, ok=True)
+    assert mon.evaluate().compliant          # streak 1: too fresh
+    assert mon.evaluate().compliant          # streak 2
+    st = mon.evaluate()                      # streak 3 == patience
+    assert st.breached == ("ttft_p99_s",) and not st.compliant
+    assert mon.breached
+    assert [e for e in events if e[0] == "slo_breach"] == [
+        ("slo_breach", dict(policy="p", objective="ttft_p99_s",
+                            measured=0.5, target=0.1, samples=8))]
+    g = {c.labels["objective"]: c.value
+         for c in reg.get("nxd_slo_compliance").children()}
+    assert g["ttft_p99_s"] == 0.0 and g["all"] == 0.0
+    assert st.attainment("ttft_p99_s") == pytest.approx(0.2)
+    # recovery is immediate (patience gates entry, not exit)
+    for _ in range(32):
+        mon.observe(ttft_s=0.01, ok=True)
+    st = mon.evaluate()
+    assert st.compliant and not mon.breached
+    assert any(e[0] == "slo_recovered" for e in events)
+    g = {c.labels["objective"]: c.value
+         for c in reg.get("nxd_slo_compliance").children()}
+    assert g["ttft_p99_s"] == 1.0 and g["all"] == 1.0
+
+
+def test_min_samples_withholds_latency_judgment(events):
+    pol = SloPolicy(ttft_p99_s=0.1, min_samples=8, breach_patience=1)
+    mon = SloMonitor(pol, registry=MetricsRegistry())
+    for _ in range(4):                       # under min_samples
+        mon.observe(ttft_s=9.9)
+    st = mon.evaluate()
+    assert st.compliant and math.isnan(st.measured["ttft_p99_s"])
+    assert not [e for e in events if e[0] == "slo_breach"]
+
+
+def test_availability_and_error_rate_objectives(events):
+    pol = SloPolicy(availability=0.9, error_rate=0.25, min_samples=2,
+                    breach_patience=1, window=16)
+    mon = SloMonitor(pol, registry=MetricsRegistry())
+    for ok in (True, True, False, False):
+        mon.observe(ok=ok)
+    st = mon.evaluate(availability=0.5)      # both objectives violated
+    assert set(st.breached) == {"availability", "error_rate"}
+    assert st.measured["error_rate"] == pytest.approx(0.5)
+    assert st.attainment("availability") == pytest.approx(0.5 / 0.9)
+    breached = {e[1]["objective"] for e in events
+                if e[0] == "slo_breach"}
+    assert breached == {"availability", "error_rate"}
+
+
+def test_monitor_prefers_request_histograms():
+    """With obs enabled, the monitor reads the per-request histograms
+    rather than its own window — enforcement follows what is exported."""
+    from neuronx_distributed_tpu.inference.engine import \
+        observe_request_metrics
+
+    obs.enable()
+    reg = obs.get_registry()
+    for _ in range(10):
+        observe_request_metrics("completed", tenant="t", ttft_s=0.4)
+    pol = SloPolicy(ttft_p99_s=0.1, min_samples=8, breach_patience=1)
+    mon = SloMonitor(pol, registry=reg)      # note: nothing observe()d
+    st = mon.evaluate()
+    assert st.breached == ("ttft_p99_s",)
+    assert st.measured["ttft_p99_s"] == pytest.approx(0.4)
+
+
+# ---------------------------------------------------------------------------
+# deterministic histogram reservoir (Vitter R)
+# ---------------------------------------------------------------------------
+
+def test_reservoir_pinned_distribution_and_determinism():
+    """Past capacity the reservoir stays a uniform sample: quantiles of
+    a known ramp stay within a few percent, min/max/count/sum remain
+    exact, and the per-series seeded RNG makes two identical runs retain
+    bit-identical reservoirs."""
+    n = 3 * HISTOGRAM_RESERVOIR
+
+    def run():
+        reg = MetricsRegistry()
+        reg.enable()
+        h = reg.histogram("nxd_test_seconds", "t.", labels=("k",))
+        c = h.labels(k="a")
+        for i in range(n):                   # ramp 0..1
+            c.observe(i / (n - 1))
+        return c
+
+    a, b = run(), run()
+    assert a.count == n and len(a.samples()) == HISTOGRAM_RESERVOIR
+    assert a.min == 0.0 and a.max == 1.0
+    assert a.sum == pytest.approx(n / 2, rel=1e-3)
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert a.quantile(q) == pytest.approx(q, abs=0.03)
+    assert a.samples() == b.samples()        # pinned: same seed, same run
+
+
+# ---------------------------------------------------------------------------
+# request-scoped traces
+# ---------------------------------------------------------------------------
+
+def test_request_trace_lifecycle_and_chrome_export():
+    tr = SpanTracer(enabled=True)
+    tid = tr.request_begin("r1", tenant="gold")
+    assert tid == "trace-r1" and tr.request_trace_id("r1") == tid
+    # idempotent re-begin merges attrs, keeps identity
+    assert tr.request_begin("r1", replica="eng0") == tid
+    tr.request_phase_begin("r1", "router_queue")
+    tr.request_phase_end("r1", "router_queue")
+    tr.request_mark("r1", "resubmit")
+    tr.request_slices([("r1", "prefill_slice", 120.0),
+                       ("r1", "decode_step", 40.0),
+                       ("r1", "decode_step", 40.0),
+                       ("ghost", "decode_step", 40.0)])  # unknown: no-op
+    tr.request_phase_begin("r1", "engine_queue")  # left open on purpose
+    time.sleep(0.002)                        # give the wall a measurable width
+    summary = tr.request_end("r1", outcome="completed", tokens=2)
+    assert summary["trace_id"] == tid
+    assert summary["phase_us"]["decode_step"] == pytest.approx(80.0)
+    assert "engine_queue" in summary["phase_us"]  # open phase closed
+    assert tr.request_end("r1") is None      # already retired
+    ev = [e for e in tr.chrome_trace()["traceEvents"]
+          if e["name"] == "request:r1"]
+    assert len(ev) == 1
+    args = ev[0]["args"]
+    assert args["outcome"] == "completed" and args["tenant"] == "gold"
+    assert args["replica"] == "eng0" and args["tokens"] == 2
+    assert args["phase_n"]["resubmit"] == 1
+    assert args["phase_n"]["decode_step"] == 2
+    assert args["critical_path"] in args["phase_us"]
+    # each share is that phase's fraction of the request wall (the event
+    # dur); device-measured slices stack on top of queue phases, so the
+    # shares need not sum below 1 — the per-phase ratio is the invariant
+    dur = ev[0]["dur"]
+    assert dur > 0
+    for k, v in args["phase_share"].items():
+        assert v == pytest.approx(args["phase_us"][k] / dur, abs=2e-3)
+        assert v >= 0.0
+    assert "request/completed" in tr.stats()
+
+
+def test_request_trace_migration_roundtrip():
+    """export/import carries the trace across replicas: same trace-id,
+    accumulated phases survive, migrations are counted."""
+    src, dst = SpanTracer(enabled=True), SpanTracer(enabled=True)
+    src.request_begin("r9", tenant="t")
+    src.request_mark("r9", "decode_step", 55.0, n=3)
+    src.request_phase_begin("r9", "engine_queue")
+    state = src.request_export("r9")
+    assert state["trace_id"] == "trace-r9"
+    assert state["migrations"] == 1
+    assert src.request_trace_id("r9") is None    # gone from the source
+    assert "engine_queue" in state["phase_us"]   # open phase flushed
+    dst.request_import(state)
+    dst.request_mark("r9", "decode_step", 45.0)
+    summary = dst.request_end("r9", outcome="completed")
+    assert summary["trace_id"] == "trace-r9"
+    assert summary["phase_us"]["decode_step"] == pytest.approx(100.0)
+    ev = [e for e in dst.chrome_trace()["traceEvents"]
+          if e["name"] == "request:r9"][0]
+    assert ev["args"]["migrations"] == 1
+    assert ev["args"]["phase_n"]["decode_step"] == 4
+
+
+def test_request_trace_disabled_is_free():
+    tr = SpanTracer(enabled=False)
+    assert tr.request_begin("r1") is None
+    tr.request_mark("r1", "decode_step", 1.0)
+    assert tr.request_end("r1") is None
+    assert tr.request_export("r1") is None
+
+
+# ---------------------------------------------------------------------------
+# engine + router integration
+# ---------------------------------------------------------------------------
+
+def test_engine_step_histogram_coherent_across_registry_reset(tiny_model):
+    """Satellite 1: a registry reset mid-run must not desynchronize the
+    step-latency histogram from EngineStats — the engine replays its
+    retained window into the fresh generation."""
+    from neuronx_distributed_tpu.inference.engine import ServingEngine
+
+    cfg, params = tiny_model
+    obs.enable()
+    eng = ServingEngine(cfg, params, _ecfg())
+    for i, p in enumerate(_prompts(cfg, 3)):
+        eng.submit(p, 3, uid=f"a{i}")
+    eng.run()
+    reg = obs.get_registry()
+    h = reg.get("nxd_engine_step_seconds")
+    assert h.count == len(eng.stats.step_latency_s)
+
+    reg.reset()  # an exporter restart mid-run
+    for i, p in enumerate(_prompts(cfg, 3, seed=1)):
+        eng.submit(p, 3, uid=f"b{i}")
+    eng.run()
+    h = reg.get("nxd_engine_step_seconds")
+    assert h.count == len(eng.stats.step_latency_s)
+    # and the quantiles agree with the stats-derived view of the run
+    walls = sorted(eng.stats.step_latency_s)
+    assert h.quantile(0.5) == pytest.approx(
+        walls[int(math.ceil(0.5 * len(walls))) - 1], rel=1e-9)
+    assert eng.compile_count() == 1
+
+
+def test_rejection_carries_trace_id(tiny_model):
+    """Satellite 2a: admission rejections carry the trace-id so a client
+    can join its error to the server-side trace."""
+    from neuronx_distributed_tpu.inference.engine import RequestRejected
+    from neuronx_distributed_tpu.inference.router import (ReplicaRouter,
+                                                          RouterConfig)
+
+    cfg, params = tiny_model
+    obs.enable()
+    router = ReplicaRouter(cfg, params, _ecfg(),
+                           RouterConfig(num_replicas=1))
+    with pytest.raises(RequestRejected) as exc:
+        router.submit([1] * 40, 40, uid="huge")
+    assert exc.value.trace_id == "trace-huge"
+    ev = [e for e in obs.get_tracer().chrome_trace()["traceEvents"]
+          if e["name"] == "request:huge"]
+    assert len(ev) == 1 and ev[0]["args"]["outcome"] == "rejected"
+    assert ev[0]["args"]["reason"] == "never_fits"
+
+
+def test_failover_trace_shows_resubmit_hop(tiny_model):
+    """Satellite 2b: a request that fails over retires with a complete
+    trace — the resubmit hop and both queue phases are attributed."""
+    from neuronx_distributed_tpu.inference.router import (ReplicaRouter,
+                                                          RouterConfig)
+    from neuronx_distributed_tpu.resilience.chaos import FaultPlan
+
+    cfg, params = tiny_model
+    obs.enable()
+    router = ReplicaRouter(
+        cfg, params, _ecfg(), RouterConfig(num_replicas=2),
+        chaos=FaultPlan.parse("step|r1 : crash, after=2, times=1"))
+    for i, p in enumerate(_prompts(cfg, 5)):
+        router.submit(p, 4, uid=f"req{i}")
+    res = router.run()
+    assert all(r.status == "completed" for r in res.values())
+    assert router.stats.failovers >= 1
+    evs = [e for e in obs.get_tracer().chrome_trace()["traceEvents"]
+           if e["name"].startswith("request:")]
+    assert len(evs) == 5               # every admitted request retired
+    # survivors retire "completed"; failed-over ones "resubmitted" so
+    # the SLO layer can price recovery cost separately
+    assert {e["args"]["outcome"] for e in evs} == {"completed",
+                                                   "resubmitted"}
+    hops = [e for e in evs if e["args"]["phase_n"].get("resubmit")]
+    assert hops and all(e["args"]["outcome"] == "resubmitted"
+                        for e in hops)
+    for e in hops:
+        assert "router_queue" in e["args"]["phase_us"]
+        assert "decode_step" in e["args"]["phase_us"]
+        assert e["args"]["trace_id"] == "trace-" + \
+            e["name"].split(":", 1)[1]
+    # chrome export is valid JSON end to end
+    json.dumps(obs.get_tracer().chrome_trace())
+
+
+def test_sustained_breach_drives_scale_up(tiny_model, events):
+    """Satellite: the autoscaler consumes slo_breach — an unmeetable
+    TTFT target pushes the monitor into sustained breach and the fleet
+    scales up with an slo: reason."""
+    from neuronx_distributed_tpu.inference.router import (ReplicaRouter,
+                                                          RouterConfig,
+                                                          ScalePolicy)
+
+    cfg, params = tiny_model
+    router = ReplicaRouter(
+        cfg, params, _ecfg(),
+        RouterConfig(num_replicas=1,
+                     scale=ScalePolicy(min_replicas=1, max_replicas=2,
+                                       hysteresis_steps=1,
+                                       cooldown_steps=0),
+                     slo=SloPolicy(name="unit", ttft_p99_s=1e-9,
+                                   min_samples=1, breach_patience=1,
+                                   window=16)))
+    for i, p in enumerate(_prompts(cfg, 6)):
+        router.submit(p, 4, uid=f"req{i}")
+    res = router.run()
+    assert all(r.status == "completed" for r in res.values())
+    assert router.stats.slo_breaches >= 1
+    assert router.stats.slo_scale_ups >= 1
+    assert len(router.replicas) == 2
+    scale_evs = [a for n, a in events if n == "router_scale_up"]
+    assert any(a["reason"].startswith("slo:") for a in scale_evs)
+    breach_evs = [a for n, a in events if n == "slo_breach"]
+    assert breach_evs and breach_evs[0]["policy"] == "unit"
