@@ -427,7 +427,8 @@ def lint_metric():
     """Static-analysis self-measurement (docs/analysis.md): wall time and
     unsuppressed finding count of the full nxdlint run over the package +
     tests + examples (fixture corpus excluded), plus the wall time of the
-    jaxpr-level entry-point audit. Both run as subprocess CLI invocations
+    jaxpr-level entry-point audit and of the tier-4 mesh-protocol
+    verifier (with its finding count). All run as subprocess CLI invocations
     — the auditor's entry builders construct their own meshes and must
     not collide with the bench's parallel state. RETURNS aux entries
     keyed by metric name — never prints a JSON line."""
@@ -447,6 +448,12 @@ def lint_metric():
     subprocess.run(cli + ["--jaxpr"], cwd=root, capture_output=True,
                    text=True)
     jaxpr_ms = (time.perf_counter() - t1) * 1000.0
+    t2 = time.perf_counter()
+    r_mp = subprocess.run(cli + ["--mesh-protocol", "--format", "json"],
+                          cwd=root, capture_output=True, text=True)
+    mp_ms = (time.perf_counter() - t2) * 1000.0
+    mp_findings = (len(json.loads(r_mp.stdout)["findings"])
+                   if r_mp.stdout.strip() else -1)
     return {
         "lint_wall_ms": {
             "value": round(lint_ms, 1), "unit": "ms", "vs_baseline": 1.0},
@@ -454,6 +461,10 @@ def lint_metric():
             "value": n_findings, "unit": "findings", "vs_baseline": 1.0},
         "jaxpr_audit_wall_ms": {
             "value": round(jaxpr_ms, 1), "unit": "ms", "vs_baseline": 1.0},
+        "mesh_protocol_wall_ms": {
+            "value": round(mp_ms, 1), "unit": "ms", "vs_baseline": 1.0},
+        "mesh_protocol_findings": {
+            "value": mp_findings, "unit": "findings", "vs_baseline": 1.0},
     }
 
 

@@ -1,6 +1,6 @@
 """CLI for nxdlint: ``python -m neuronx_distributed_tpu.analysis [paths]``.
 
-Three tiers (see docs/analysis.md):
+Four tiers (see docs/analysis.md):
 
 * syntactic + dataflow (default): lint the given paths with the rule
   set, with the def-use taint engine feeding the rules; pass
@@ -8,6 +8,15 @@ Three tiers (see docs/analysis.md):
 * ``--jaxpr``: abstract-trace the registered entry points on the CPU
   backend and audit the resulting jaxprs (collective scope, host
   callbacks, donation, wire precision).
+* ``--mesh-protocol``: the tier-4 mesh-protocol verifier — extract each
+  entry point's collective schedule (flagging cond-branch divergence
+  and malformed ppermute rings) and check post-propagation shardings
+  against the registered contract. ``--emit-schedule FILE`` writes the
+  extracted schedule as reviewable JSON (implies ``--mesh-protocol``).
+
+``--changed-only`` restricts the syntactic tiers to files changed
+relative to ``--base`` (default HEAD, per ``git diff --name-only`` plus
+untracked files), falling back to a full scan outside a git repo.
 
 The CI ratchet: ``--baseline FILE --write-baseline`` records the
 current findings; ``--baseline FILE --fail-on-new`` then fails only on
@@ -21,11 +30,13 @@ findings remain, 2 on usage errors.
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from . import baseline as baseline_mod
-from . import jaxpr_audit, output
+from . import jaxpr_audit, mesh_protocol, output
 from .core import all_rules, analyze_paths
 
 
@@ -50,13 +61,15 @@ def _explain(rule_id: str) -> int:
             print()
             print(doc.strip())
         return 0
-    if rule_id in jaxpr_audit.RULES:
-        print(f"{rule_id}: {jaxpr_audit.RULES[rule_id]}")
-        if jaxpr_audit.__doc__:
-            print()
-            print(jaxpr_audit.__doc__.strip())
-        return 0
-    known = sorted(rules) + sorted(jaxpr_audit.RULES)
+    for mod in (jaxpr_audit, mesh_protocol):
+        if rule_id in mod.RULES:
+            print(f"{rule_id}: {mod.RULES[rule_id]}")
+            if mod.__doc__:
+                print()
+                print(mod.__doc__.strip())
+            return 0
+    known = (sorted(rules) + sorted(jaxpr_audit.RULES)
+             + sorted(mesh_protocol.RULES))
     print(f"error: unknown rule {rule_id!r}; known rules: "
           f"{', '.join(known)}", file=sys.stderr)
     return 2
@@ -66,7 +79,28 @@ def _rule_descriptions() -> Dict[str, str]:
     descs = {name: rule.description
              for name, rule in all_rules().items()}
     descs.update(jaxpr_audit.RULES)
+    descs.update(mesh_protocol.RULES)
     return descs
+
+
+def _changed_files(base: str) -> Optional[Set[str]]:
+    """Absolute paths of files changed vs ``base`` (plus untracked
+    files), or ``None`` when git is unavailable / not a repo — the
+    caller falls back to a full scan then."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True).stdout.strip()
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", base],
+            capture_output=True, text=True, check=True).stdout
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, check=True).stdout
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return {os.path.join(top, ln.strip())
+            for ln in (diff + untracked).splitlines() if ln.strip()}
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -109,14 +143,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="audit registered entry points at the "
                              "jaxpr level (abstract tracing on the CPU "
                              "backend; no user code is executed)")
+    parser.add_argument("--mesh-protocol", action="store_true",
+                        help="run the tier-4 mesh-protocol verifier on "
+                             "the registered entry points: collective-"
+                             "schedule divergence, ppermute ring "
+                             "bijectivity, and sharding-contract / "
+                             "replication audits")
+    parser.add_argument("--emit-schedule", metavar="FILE", default=None,
+                        help="write the extracted collective schedule "
+                             "as JSON to FILE ('-' for stdout); implies "
+                             "--mesh-protocol")
     parser.add_argument("--register", metavar="FILE", action="append",
                         default=None,
-                        help="with --jaxpr: execute FILE to register "
-                             "extra entry points (replaces the default "
-                             "registry for this run; repeatable)")
+                        help="with --jaxpr/--mesh-protocol: execute FILE "
+                             "to register extra entry points (replaces "
+                             "the default registry for this run; "
+                             "repeatable)")
     parser.add_argument("--entry", metavar="NAMES", default=None,
-                        help="with --jaxpr: comma-separated entry-point "
-                             "names to audit (default: all registered)")
+                        help="with --jaxpr/--mesh-protocol: comma-"
+                             "separated entry-point names to audit "
+                             "(default: all registered)")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="lint only files changed vs --base (git "
+                             "diff --name-only + untracked); full scan "
+                             "outside a git repo")
+    parser.add_argument("--base", metavar="REF", default="HEAD",
+                        help="with --changed-only: git ref to diff "
+                             "against (default: HEAD)")
     parser.add_argument("--explain", metavar="RULE", default=None,
                         help="print the rule's description and rationale "
                              "and exit")
@@ -124,15 +177,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="print registered rules and exit")
     args = parser.parse_args(argv)
 
+    if args.emit_schedule:
+        args.mesh_protocol = True
+
     if args.list_rules:
         for name, rule in sorted(all_rules().items()):
             print(f"{name}: {rule.description}")
         for name in sorted(jaxpr_audit.RULES):
             print(f"{name}: {jaxpr_audit.RULES[name]} [--jaxpr]")
+        for name in sorted(mesh_protocol.RULES):
+            print(f"{name}: {mesh_protocol.RULES[name]} "
+                  "[--mesh-protocol]")
         return 0
     if args.explain:
         return _explain(args.explain)
-    if not args.paths and not args.jaxpr:
+    if not args.paths and not args.jaxpr and not args.mesh_protocol:
         parser.print_usage(sys.stderr)
         print("error: no paths given", file=sys.stderr)
         return 2
@@ -140,6 +199,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("error: --fail-on-new/--write-baseline require --baseline",
               file=sys.stderr)
         return 2
+
+    only_files = None
+    if args.changed_only:
+        only_files = _changed_files(args.base)
+        if only_files is None:
+            print("nxdlint: --changed-only: not a git repo, running a "
+                  "full scan", file=sys.stderr)
 
     findings = []
     if args.paths:
@@ -150,21 +216,39 @@ def main(argv: Optional[List[str]] = None) -> int:
                 disable=_split(args.disable) or (),
                 extra_axes=_split(args.extra_axes) or (),
                 dataflow=not args.heuristics_only,
-                exclude=tuple(_split(args.exclude) or ()))
+                exclude=tuple(_split(args.exclude) or ()),
+                only_files=only_files)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
 
-    if args.jaxpr:
+    if args.jaxpr or args.mesh_protocol:
         jaxpr_audit.ensure_cpu_backend()
         if args.register:
             import runpy
             for reg in args.register:
                 runpy.run_path(reg)
+        entry_names = _split(args.entry)
+        include_defaults = not args.register
         try:
-            findings = findings + jaxpr_audit.audit_entry_points(
-                names=_split(args.entry),
-                include_defaults=not args.register)
+            if args.jaxpr:
+                findings = findings + jaxpr_audit.audit_entry_points(
+                    names=entry_names, include_defaults=include_defaults)
+            if args.mesh_protocol:
+                mp_findings, schedules = mesh_protocol.audit_entry_points(
+                    names=entry_names, include_defaults=include_defaults)
+                findings = findings + mp_findings
+                if args.emit_schedule:
+                    doc = mesh_protocol.schedules_to_json(schedules)
+                    if args.emit_schedule == "-":
+                        print(doc)
+                    else:
+                        with open(args.emit_schedule, "w",
+                                  encoding="utf-8") as fh:
+                            fh.write(doc + "\n")
+                        print(f"nxdlint: wrote collective schedule for "
+                              f"{len(schedules)} entry point(s) to "
+                              f"{args.emit_schedule}", file=sys.stderr)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2

@@ -33,11 +33,15 @@ DEFAULT_DONATION_MIN_BYTES = 1 << 20
 class BuiltEntry:
     """What a builder returns: the function to abstract-trace plus the
     example arguments (arrays or ``jax.ShapeDtypeStruct``s — tracing
-    never reads values)."""
+    never reads values). ``mesh`` (a ``jax.sharding.Mesh``) anchors the
+    entry's sharding contract: the mesh-protocol verifier builds
+    ``NamedSharding``s from it when checking the registered
+    ``in_shardings`` / ``max_replicated_bytes`` fields."""
 
     fn: Callable[..., Any]
     args: Tuple[Any, ...]
     donate_argnums: Tuple[int, ...] = ()
+    mesh: Any = None
 
 
 @dataclasses.dataclass
@@ -55,6 +59,16 @@ class EntryPoint:
     donation_min_bytes: int = DEFAULT_DONATION_MIN_BYTES
     #: minimum element count for the wire-precision check
     wire_min_elems: int = 64
+    #: sharding contract for the mesh-protocol verifier: one entry per
+    #: *flattened* example-argument leaf — ``None`` (no expectation) or a
+    #: plain tuple of ``PartitionSpec`` dim assignments (axis name,
+    #: ``None``, or a tuple of axis names; ``()`` = fully replicated).
+    #: Expressed jax-free so registration stays import-cheap.
+    in_shardings: Optional[Tuple[Any, ...]] = None
+    #: mesh-protocol replication ceiling: any input/output leaf at least
+    #: this many bytes that lowers to a *fully replicated* sharding on a
+    #: multi-device mesh is flagged (``jaxpr-silent-replication``)
+    max_replicated_bytes: Optional[int] = None
     #: ``path:lineno`` of the registration site, for findings
     source: str = ""
 
@@ -71,6 +85,8 @@ def register_entry_point(name: str, *,
                          donation_min_bytes: int =
                          DEFAULT_DONATION_MIN_BYTES,
                          wire_min_elems: int = 64,
+                         in_shardings: Optional[Sequence[Any]] = None,
+                         max_replicated_bytes: Optional[int] = None,
                          ) -> Callable[[Callable[[], BuiltEntry]],
                                        Callable[[], BuiltEntry]]:
     """Decorator: register ``build`` as the builder for entry ``name``.
@@ -90,7 +106,10 @@ def register_entry_point(name: str, *,
             tags=tuple(tags), wire_dtype=wire_dtype,
             expects_donation=expects_donation,
             donation_min_bytes=donation_min_bytes,
-            wire_min_elems=wire_min_elems, source=source)
+            wire_min_elems=wire_min_elems,
+            in_shardings=(tuple(in_shardings)
+                          if in_shardings is not None else None),
+            max_replicated_bytes=max_replicated_bytes, source=source)
         return build
     return deco
 
@@ -117,5 +136,8 @@ def load_default_entry_points() -> Dict[str, EntryPoint]:
         from ..trainer import trainer as _trainer  # noqa: F401
         from ..inference import engine as _engine  # noqa: F401
         from ..parallel import ep_dispatch as _epd  # noqa: F401
+        from ..ops import flash_decoding as _fd  # noqa: F401
+        from ..ops import ring_attention as _ra  # noqa: F401
+        from ..ops import ulysses as _ul  # noqa: F401
         _DEFAULTS_LOADED = True
     return dict(_ENTRY_POINTS)

@@ -391,12 +391,16 @@ def analyze_paths(paths: Sequence[str],
                   disable: Iterable[str] = (),
                   extra_axes: Iterable[str] = (), *,
                   dataflow: bool = True,
-                  exclude: Iterable[str] = ()) -> List[Finding]:
+                  exclude: Iterable[str] = (),
+                  only_files: Optional[Iterable[str]] = None
+                  ) -> List[Finding]:
     """Lint every ``.py`` file under ``paths``. Returns ALL findings; the
     caller decides what to do with suppressed ones. ``exclude`` skips
     files matching the given path patterns (same syntax as
     :func:`path_matches`); ``dataflow=False`` runs in heuristics-only
-    (v1) mode."""
+    (v1) mode. ``only_files`` (``--changed-only``) restricts the walk to
+    the given files (compared as absolute paths); ``None`` = no
+    restriction, an empty iterable = lint nothing."""
     _ensure_rules_loaded()
     if not paths:
         raise ValueError("no paths to analyze")
@@ -425,9 +429,13 @@ def analyze_paths(paths: Sequence[str],
     scope_over = dict(cfg.get("scope", {}))
     exempt_over = dict(cfg.get("exempt", {}))
     exclude = tuple(exclude)
+    only_set = (None if only_files is None
+                else {os.path.abspath(f) for f in only_files})
     findings: List[Finding] = []
     for path in iter_python_files(paths):
         if exclude and path_matches(path, exclude):
+            continue
+        if only_set is not None and os.path.abspath(path) not in only_set:
             continue
         try:
             with open(path, "r", encoding="utf-8") as fh:

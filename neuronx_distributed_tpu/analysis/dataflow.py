@@ -338,12 +338,23 @@ class ModuleDataflow:
                     changed |= self._bind_kinds(
                         item.optional_vars,
                         self._eval(item.context_expr, env, ns), env)
-        # walrus bindings anywhere in the statement's expressions
+        # walrus + comprehension-target bindings anywhere in the
+        # statement's expressions. Comprehension targets don't leak in
+        # py3 scoping, but rules query the *expressions inside* the
+        # comprehension against this scope's env (expr_kinds), so the
+        # targets must be visible here — binding them is a sound
+        # overapproximation. The enclosing _fixpoint orders the chain
+        # (comp target -> walrus reading it) across rounds.
         for sub in astutil.walk_stop_at_functions(stmt):
             if isinstance(sub, ast.NamedExpr) and \
                     isinstance(sub.target, ast.Name):
                 changed |= self._update(
                     env, sub.target.id, self._eval(sub.value, env, ns))
+            elif isinstance(sub, (ast.ListComp, ast.SetComp,
+                                  ast.GeneratorExp, ast.DictComp)):
+                for gen in sub.generators:
+                    changed |= self._bind_kinds(
+                        gen.target, self._eval(gen.iter, env, ns), env)
         # recurse into compound bodies
         for field in ("body", "orelse", "finalbody"):
             for child in getattr(stmt, field, ()) or ():
@@ -447,6 +458,12 @@ class ModuleDataflow:
             for x in e.elts:
                 out |= self._eval(x, env, ns)
             return out
+        if isinstance(e, ast.Dict):
+            out = set()
+            for x in list(e.keys) + list(e.values):
+                if x is not None:  # None key = **mapping splat
+                    out |= self._eval(x, env, ns)
+            return out
         if isinstance(e, ast.BinOp):
             return self._eval(e.left, env, ns) | \
                 self._eval(e.right, env, ns)
@@ -459,11 +476,17 @@ class ModuleDataflow:
             return self._eval(e.value, env, ns)
         if isinstance(e, ast.Await):
             return self._eval(e.value, env, ns)
-        if isinstance(e, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+        if isinstance(e, (ast.ListComp, ast.GeneratorExp, ast.SetComp,
+                          ast.DictComp)):
             cenv = {k: set(v) for k, v in env.items()}
             for gen in e.generators:
+                # iter evaluated in cenv so later generators see earlier
+                # targets ([y for xs in grads for y in xs])
                 self._bind_kinds(gen.target,
-                                 self._eval(gen.iter, env, ns), cenv)
+                                 self._eval(gen.iter, cenv, ns), cenv)
+            if isinstance(e, ast.DictComp):
+                return self._eval(e.key, cenv, ns) | \
+                    self._eval(e.value, cenv, ns)
             return self._eval(e.elt, cenv, ns)
         return set()
 

@@ -75,3 +75,40 @@ def flash_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.reshape(b, s, n, d).astype(q.dtype)
+
+
+# -- nxdlint jaxpr-audit entry point ---------------------------------------
+
+from ..analysis.audit_registry import BuiltEntry, register_entry_point
+
+
+@register_entry_point(
+    "flash-decoding",
+    description="tp flash decoding: slot-sharded KV combine via pmax + "
+                "two psums on the tp axis",
+    tags=("serve",),
+    in_shardings=((), (None, "tp"), (None, "tp"), (None, "tp"), ()),
+)
+def _audit_flash_decoding() -> BuiltEntry:
+    """Builder for ``analysis --jaxpr``/``--mesh-protocol``: decode
+    combine on a 4-way tp mesh. The cache shards stay tp-sharded after
+    propagation; the small query/output are replicated by design (the
+    entry declares no ``max_replicated_bytes`` ceiling)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..inference.kv_cache import PAD_POSITION
+
+    if ps.model_parallel_is_initialized():
+        ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    fn = jax.jit(ps.shard_map(
+        lambda q, k, v, sp, qp: flash_decode_attention(q, k, v, sp, qp),
+        mesh,
+        in_specs=(P(), P(None, "tp"), P(None, "tp"), P(None, "tp"), P()),
+        out_specs=P()))
+    b, s, n, kvh, d, slots = 2, 2, 8, 4, 16, 32
+    q = jnp.zeros((b, s, n, d), jnp.float32)
+    k = jnp.zeros((b, slots, kvh, d), jnp.float32)
+    slot_pos = jnp.full((b, slots), PAD_POSITION, jnp.int32)
+    q_pos = jnp.zeros((b, s), jnp.int32)
+    return BuiltEntry(fn=fn, args=(q, k, k, slot_pos, q_pos), mesh=mesh)

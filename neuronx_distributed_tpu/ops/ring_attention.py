@@ -329,3 +329,33 @@ def ring_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
     scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
     return _ring_pallas(q, k, v, seed, axis, bq, bk, scale_, interpret,
                         dropout_p)
+
+
+# -- nxdlint jaxpr-audit entry point ---------------------------------------
+
+from ..analysis.audit_registry import BuiltEntry, register_entry_point
+
+
+@register_entry_point(
+    "ring-attention",
+    description="cp ring attention: cp-1 rotating ppermute hops under "
+                "shard_map on the cp axis",
+    tags=("train", "serve"),
+    in_shardings=((None, "cp", None, None),) * 3,
+    max_replicated_bytes=1 << 20,
+)
+def _audit_ring_attention() -> BuiltEntry:
+    """Builder for ``analysis --jaxpr``/``--mesh-protocol``: the XLA ring
+    on a 4-way cp mesh. The verifier checks every rotation perm covers
+    the axis exactly once and q/k/v stay cp-sharded after propagation."""
+    from jax.sharding import PartitionSpec as P
+
+    if ps.model_parallel_is_initialized():
+        ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(context_parallel_size=4)
+    fn = jax.jit(ps.shard_map(
+        lambda q, k, v: ring_attention(q, k, v),
+        mesh, in_specs=(P(None, "cp", None, None),) * 3,
+        out_specs=P(None, "cp", None, None)))
+    q = jnp.zeros((2, 32, 4, 8), jnp.float32)
+    return BuiltEntry(fn=fn, args=(q, q, q), mesh=mesh)

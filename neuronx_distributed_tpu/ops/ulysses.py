@@ -102,3 +102,33 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     out = flash_attention(qh, kh, vh, causal=causal, scale=scale_,
                           dropout_p=dropout_p, dropout_seed=dropout_seed)
     return heads_to_seq(out)
+
+
+# -- nxdlint jaxpr-audit entry point ---------------------------------------
+
+from ..analysis.audit_registry import BuiltEntry, register_entry_point
+
+
+@register_entry_point(
+    "ulysses-attention",
+    description="cp all-to-all (Ulysses) attention: the enter/exit "
+                "expert-parallel-region pair resharding seq <-> heads",
+    tags=("train", "serve"),
+    in_shardings=((None, "cp", None, None),) * 3,
+    max_replicated_bytes=1 << 20,
+)
+def _audit_ulysses_attention() -> BuiltEntry:
+    """Builder for ``analysis --jaxpr``/``--mesh-protocol``: the a2a
+    reshard pair on a 4-way cp mesh with heads divisible by cp, so both
+    all-to-alls move the unexpanded kv."""
+    from jax.sharding import PartitionSpec as P
+
+    if ps.model_parallel_is_initialized():
+        ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(context_parallel_size=4)
+    fn = jax.jit(ps.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v),
+        mesh, in_specs=(P(None, "cp", None, None),) * 3,
+        out_specs=P(None, "cp", None, None)))
+    q = jnp.zeros((2, 32, 4, 8), jnp.float32)
+    return BuiltEntry(fn=fn, args=(q, q, q), mesh=mesh)

@@ -327,11 +327,14 @@ from ..analysis.audit_registry import BuiltEntry, register_entry_point
                 "chunks under shard_map on the expert mesh",
     tags=("train", "serve"),
     wire_dtype="int8",
+    in_shardings=(("ep", None),),
 )
 def _audit_ep_dispatch_ring() -> BuiltEntry:
     """Builder for ``analysis --jaxpr``: the int8-wire dispatch ring on
     a 4-way expert mesh. Every ``ppermute`` hop must ship the encoded
-    payload — a full-precision hop is a wire-precision violation."""
+    payload — a full-precision hop is a wire-precision violation. The
+    mesh-protocol tier additionally checks the token shard stays
+    ep-sharded after propagation and the ring hops cover the axis."""
     from jax.sharding import PartitionSpec as P
 
     from ..config import neuronx_distributed_config
@@ -350,4 +353,4 @@ def _audit_ep_dispatch_ring() -> BuiltEntry:
     fn = jax.jit(ps.shard_map(ring, em, in_specs=P("ep", None),
                               out_specs=P("ep", None)))
     x = jnp.zeros((4 * 8, 64), jnp.float32)
-    return BuiltEntry(fn=fn, args=(x,))
+    return BuiltEntry(fn=fn, args=(x,), mesh=em)

@@ -1,0 +1,107 @@
+"""Fixture: mesh-protocol violations, registered via ``--register`` and
+abstract-traced by the tier-4 verifier — never executed.
+
+Four toy entry points, one per mesh-protocol rule, each seeding exactly
+its own violation:
+
+* ``fixture-divergent-cond`` — a ``cond`` whose true branch runs a
+  ppermute ring while the false branch is pure math: ranks taking the
+  false branch never post the collective (deadlock hazard).
+* ``fixture-bad-ring`` — a ppermute perm with a duplicate destination
+  that also skips ranks (non-bijective, incomplete coverage).
+* ``fixture-silent-replication`` — the entry declares
+  ``max_replicated_bytes`` and its 256 KiB output is pinned fully
+  replicated across the 8-device mesh.
+* ``fixture-implicit-gather`` — the entry declares a dp-sharded input
+  contract, but the body pins its result replicated, so propagation
+  all-gathers the input on every call.
+
+The *static* tiers must find nothing here — every violation only exists
+in the traced/lowered program."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from neuronx_distributed_tpu.analysis.audit_registry import (
+    BuiltEntry, register_entry_point)
+
+
+@register_entry_point(
+    "fixture-divergent-cond",
+    description="cond with a ppermute ring in one branch only",
+    tags=("fixture",),
+)
+def _build_divergent_cond():
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("ep",))
+    ring = [(i, (i + 1) % 4) for i in range(4)]
+
+    def body(x, flag):
+        return lax.cond(flag > 0,
+                        lambda b: lax.ppermute(b, "ep", ring),
+                        lambda b: b * 2.0, x)
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(PartitionSpec("ep", None), PartitionSpec()),
+        out_specs=PartitionSpec("ep", None), check_rep=False))
+    x = jnp.zeros((8, 64), jnp.float32)
+    flag = jnp.zeros((), jnp.int32)
+    return BuiltEntry(fn=fn, args=(x, flag))
+
+
+@register_entry_point(
+    "fixture-bad-ring",
+    description="ppermute perm with duplicate destination + skipped ranks",
+    tags=("fixture",),
+)
+def _build_bad_ring():
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("ep",))
+    # rank 1 receives twice, rank 1 never sends, ranks 2/3 never receive
+    perm = [(0, 1), (2, 1), (3, 0)]
+
+    fn = jax.jit(shard_map(
+        lambda x: lax.ppermute(x, "ep", perm), mesh=mesh,
+        in_specs=PartitionSpec("ep", None),
+        out_specs=PartitionSpec("ep", None), check_rep=False))
+    x = jnp.zeros((8, 64), jnp.float32)
+    return BuiltEntry(fn=fn, args=(x,))
+
+
+@register_entry_point(
+    "fixture-silent-replication",
+    description="256 KiB output pinned fully replicated on 8 devices",
+    tags=("fixture",),
+    max_replicated_bytes=1 << 16,
+)
+def _build_silent_replication():
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+
+    def grow(x):
+        y = jnp.tile(x, (8, 1))  # (64,128) 32 KiB -> (512,128) 256 KiB
+        return lax.with_sharding_constraint(
+            y, NamedSharding(mesh, PartitionSpec()))
+
+    x = jnp.zeros((64, 128), jnp.float32)
+    return BuiltEntry(fn=jax.jit(grow), args=(x,), mesh=mesh)
+
+
+@register_entry_point(
+    "fixture-implicit-gather",
+    description="dp-sharded input contract vs a replicated-pinned body",
+    tags=("fixture",),
+    in_shardings=(("dp", None),),
+)
+def _build_implicit_gather():
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+
+    def step(x):
+        return lax.with_sharding_constraint(
+            x * 2.0, NamedSharding(mesh, PartitionSpec()))
+
+    x = jnp.zeros((64, 128), jnp.float32)
+    return BuiltEntry(fn=jax.jit(step), args=(x,), mesh=mesh)

@@ -1,0 +1,27 @@
+"""Fixture: gradient collectives whose taint flows through comprehension
+targets and a walrus binding (never imported, only parsed).
+
+No variable here matches the v1 gradient naming patterns —
+heuristics-only mode must find nothing. The tier-2 dataflow engine must
+carry the ``value_and_grad`` taint into the comprehension targets and
+through the walrus assignment, and flag both collectives."""
+
+import jax
+from jax import lax
+
+
+def walrus_reduce(loss_fn, params, batch):
+    loss, update = jax.value_and_grad(loss_fn)(params, batch)
+    shards = [update, update]
+    # comprehension target carries the gradient taint into the collective
+    reduced = [lax.pmean(uu, "dp") for uu in shards]
+    # walrus inside a comprehension leaks the taint to a later statement
+    scaled = [(held := uu2) * 0.5 for uu2 in shards]
+    total = lax.psum(held, "dp")
+    return loss, reduced, scaled, total
+
+
+def comp_targets_stay_scoped(values):
+    # non-gradient comprehension traffic must NOT fire — activation
+    # collectives are the model's own business
+    return [lax.pmean(vv, "tp") for vv in values]

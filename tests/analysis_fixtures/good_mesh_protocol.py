@@ -1,0 +1,98 @@
+"""Fixture: the clean counterparts of ``bad_mesh_protocol.py`` — the
+same four shapes of program with the protocol hazard removed, so the
+tier-4 verifier's exact-corpus tests can assert zero findings on each:
+
+* ``fixture-symmetric-cond`` — both cond branches post the identical
+  ppermute ring (every rank reaches the collective either way).
+* ``fixture-good-ring`` — a full-rotation perm covering the axis
+  exactly once.
+* ``fixture-no-replication`` — the 256 KiB result is dp-sharded instead
+  of replicated.
+* ``fixture-contract-ok`` — the propagated input sharding matches the
+  declared dp contract."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from neuronx_distributed_tpu.analysis.audit_registry import (
+    BuiltEntry, register_entry_point)
+
+
+@register_entry_point(
+    "fixture-symmetric-cond",
+    description="cond whose branches post the identical ppermute ring",
+    tags=("fixture",),
+)
+def _build_symmetric_cond():
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("ep",))
+    ring = [(i, (i + 1) % 4) for i in range(4)]
+
+    def body(x, flag):
+        return lax.cond(flag > 0,
+                        lambda b: lax.ppermute(b, "ep", ring),
+                        lambda b: lax.ppermute(b * 2.0, "ep", ring), x)
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(PartitionSpec("ep", None), PartitionSpec()),
+        out_specs=PartitionSpec("ep", None), check_rep=False))
+    x = jnp.zeros((8, 64), jnp.float32)
+    flag = jnp.zeros((), jnp.int32)
+    return BuiltEntry(fn=fn, args=(x, flag))
+
+
+@register_entry_point(
+    "fixture-good-ring",
+    description="full-rotation ppermute covering the axis exactly once",
+    tags=("fixture",),
+)
+def _build_good_ring():
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("ep",))
+    perm = [(i, (i + 1) % 4) for i in range(4)]
+
+    fn = jax.jit(shard_map(
+        lambda x: lax.ppermute(x, "ep", perm), mesh=mesh,
+        in_specs=PartitionSpec("ep", None),
+        out_specs=PartitionSpec("ep", None), check_rep=False))
+    x = jnp.zeros((8, 64), jnp.float32)
+    return BuiltEntry(fn=fn, args=(x,))
+
+
+@register_entry_point(
+    "fixture-no-replication",
+    description="256 KiB result dp-sharded under the same ceiling",
+    tags=("fixture",),
+    max_replicated_bytes=1 << 16,
+)
+def _build_no_replication():
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+
+    def grow(x):
+        y = jnp.tile(x, (8, 1))
+        return lax.with_sharding_constraint(
+            y, NamedSharding(mesh, PartitionSpec("dp", None)))
+
+    x = jnp.zeros((64, 128), jnp.float32)
+    return BuiltEntry(fn=jax.jit(grow), args=(x,), mesh=mesh)
+
+
+@register_entry_point(
+    "fixture-contract-ok",
+    description="propagated input sharding matches the dp contract",
+    tags=("fixture",),
+    in_shardings=(("dp", None),),
+)
+def _build_contract_ok():
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+
+    def step(x):
+        return lax.with_sharding_constraint(
+            x * 2.0, NamedSharding(mesh, PartitionSpec("dp", None)))
+
+    x = jnp.zeros((64, 128), jnp.float32)
+    return BuiltEntry(fn=jax.jit(step), args=(x,), mesh=mesh)

@@ -749,3 +749,109 @@ def test_cli_explain_and_list_rules_cover_jaxpr_tier():
     r3 = _cli("--list-rules")
     assert "jaxpr-collective-scope" in r3.stdout
     assert "jaxpr-wire-precision" in r3.stdout
+
+
+# ---------------------------------------------------------------------------
+# tier 2: walrus + comprehension-target taint (PR 16)
+# ---------------------------------------------------------------------------
+
+def test_walrus_and_comprehension_targets_need_dataflow():
+    fs = _lint("bad_walrus_grad.py")
+    assert _rules(fs) == {"comm-compression"}
+    # the comprehension-target pmean and the walrus-leaked psum; the
+    # activation comprehension at the bottom stays clean
+    assert {f.line for f in fs} == {17, 20}
+    # no variable is gradient-named: v1 heuristics see nothing
+    assert _lint("bad_walrus_grad.py", dataflow=False) == []
+
+
+def test_dict_comprehension_carries_taint():
+    src = (
+        "import jax\n"
+        "from jax import lax\n"
+        "def reduce_tree(loss_fn, params):\n"
+        "    upd = jax.grad(loss_fn)(params)\n"
+        "    parts = {'a': upd}\n"
+        "    out = {kk: lax.pmean(vv, 'dp')\n"
+        "           for kk, vv in parts.items()}\n"
+        "    return out\n")
+    fs = analyze_source(src, "x.py", DEFAULT_AXES)
+    assert _rules(fs) == {"comm-compression"}
+
+
+# ---------------------------------------------------------------------------
+# --changed-only (PR 16): pre-commit iteration over the git diff
+# ---------------------------------------------------------------------------
+
+def _git(repo, *args):
+    return subprocess.run(
+        ["git", "-c", "user.email=t@example.com", "-c", "user.name=t",
+         *args], cwd=repo, capture_output=True, text=True, check=True)
+
+
+def _changed_cli(cwd, *args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "neuronx_distributed_tpu.analysis", *args],
+        cwd=cwd, capture_output=True, text=True, env=env)
+
+
+def test_changed_only_lints_only_the_diff(tmp_path):
+    import shutil
+    repo = tmp_path / "r"
+    repo.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "bad_mesh_axes.py"),
+                repo / "committed_bad.py")
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-q", "-m", "seed")
+    # committed findings are invisible to --changed-only...
+    r = _changed_cli(repo, ".", "--changed-only")
+    assert r.returncode == 0, r.stdout + r.stderr
+    # ...until a (here: untracked) file changes
+    shutil.copy(os.path.join(FIXTURES, "bad_mesh_axes.py"),
+                repo / "fresh_bad.py")
+    r2 = _changed_cli(repo, ".", "--changed-only")
+    assert r2.returncode == 1, r2.stdout + r2.stderr
+    assert "fresh_bad.py" in r2.stdout
+    assert "committed_bad.py" not in r2.stdout
+    # a full scan still sees both
+    r3 = _changed_cli(repo, ".")
+    assert "committed_bad.py" in r3.stdout
+
+
+def test_changed_only_base_ref(tmp_path):
+    import shutil
+    repo = tmp_path / "r"
+    repo.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "clean.py"), repo / "mod.py")
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-q", "-m", "seed")
+    shutil.copy(os.path.join(FIXTURES, "bad_mesh_axes.py"),
+                repo / "mod.py")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-q", "-m", "break it")
+    # vs HEAD: nothing changed; vs the first commit: mod.py is dirty
+    assert _changed_cli(repo, ".", "--changed-only").returncode == 0
+    r = _changed_cli(repo, ".", "--changed-only", "--base", "HEAD~1")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "mod.py" in r.stdout
+
+
+def test_changed_only_falls_back_outside_git(tmp_path):
+    import shutil
+    work = tmp_path / "w"
+    work.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "bad_mesh_axes.py"),
+                work / "bad.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["GIT_CEILING_DIRECTORIES"] = str(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "neuronx_distributed_tpu.analysis", ".",
+         "--changed-only"],
+        cwd=work, capture_output=True, text=True, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr  # full scan ran
+    assert "full scan" in r.stderr

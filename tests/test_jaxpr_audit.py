@@ -25,16 +25,24 @@ JAXPR_RULES = {"jaxpr-host-callback", "jaxpr-collective-scope",
 
 def test_default_entry_points_registered():
     eps = load_default_entry_points()
-    assert {"train-step", "engine-step", "ep-dispatch-ring"} <= set(eps)
+    assert {"train-step", "engine-step", "ep-dispatch-ring",
+            "ring-attention", "flash-decoding",
+            "ulysses-attention"} <= set(eps)
     assert eps["train-step"].expects_donation
     assert not eps["engine-step"].expects_donation  # CPU never donates
     assert eps["ep-dispatch-ring"].wire_dtype == "int8"
+    # the collective-heavy ops entries carry mesh-protocol contracts
+    assert eps["ep-dispatch-ring"].in_shardings == (("ep", None),)
+    assert eps["ring-attention"].max_replicated_bytes == 1 << 20
+    assert eps["flash-decoding"].in_shardings is not None
     for ep in eps.values():
         assert ":" in ep.source  # findings anchor at the builder
 
 
 @pytest.mark.parametrize("name",
-                         ["train-step", "engine-step", "ep-dispatch-ring"])
+                         ["train-step", "engine-step", "ep-dispatch-ring",
+                          "ring-attention", "flash-decoding",
+                          "ulysses-attention"])
 def test_production_entry_points_audit_clean(name):
     ep = load_default_entry_points()[name]
     fs = jaxpr_audit.audit_entry_point(ep)
