@@ -136,7 +136,8 @@ import jax.numpy as jnp  # noqa: E402
 
 def main(chaos_spec=None, serving=False, overlap=False, router=False,
          prefix_heavy=False, plan_mode=False, obs_mode=False,
-         elastic=False, sdc=False, moe=False, lint_mode=False):
+         elastic=False, sdc=False, moe=False, lint_mode=False,
+         disagg_fabric=False):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -306,6 +307,18 @@ def main(chaos_spec=None, serving=False, overlap=False, router=False,
 
             traceback.print_exc()
             print(f"bench: elastic metric failed: {e!r}", file=sys.stderr)
+
+    # cross-host fabric drill (docs/serving.md "Cross-host fabric"):
+    # opt-in via --disagg-fabric; prefill->decode KV handoff streamed
+    # int8 over a simulated DCN link under every chaos link fault kind
+    if disagg_fabric:
+        try:
+            aux.update(fabric_metric(platform))
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: fabric metric failed: {e!r}", file=sys.stderr)
 
     # silent-data-corruption drill (docs/resilience.md "Silent data
     # corruption"): opt-in via --sdc; chaos bitflips on train params
@@ -1175,6 +1188,97 @@ def elastic_metric(platform: str) -> dict:
         f"elastic_max_compile_count_{tag}": {
             "value": int(drill["max_compile_count"]), "unit": "compiles",
             "vs_baseline": 1.0},
+    }
+
+
+def fabric_metric(platform: str) -> dict:
+    """Cross-host fabric drill (docs/serving.md "Cross-host fabric"):
+    run :func:`fabric_chaos_drill` twice — clean, then under
+    ``link_partition`` chaos (every stream torn mid-flight, every
+    request healed by the re-prefill fallback). RETURNS aux entries
+    keyed by metric name — never prints the JSON line itself.
+
+    The tiny config pins ``num_heads=num_kv_heads=1`` (head_dim 64):
+    the per-row scale tax of the int8 wire layout amortizes over the
+    row, so the measured ``handoff_wire_ratio`` clears the >=3.5x bar
+    the quantized codec promises vs fp32."""
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference.engine import EngineConfig
+    from neuronx_distributed_tpu.inference.router import fabric_chaos_drill
+    from neuronx_distributed_tpu.models import llama
+    from neuronx_distributed_tpu.parallel import mesh as ps
+
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel()
+    if platform == "cpu":
+        cfg = llama.tiny_config(num_layers=2, num_heads=1,
+                                num_kv_heads=1, dtype=jnp.float32,
+                                param_dtype=jnp.float32)
+        n_req, prompt_len, max_new = 6, 8, 5
+        ecfg = EngineConfig(block_size=4, num_blocks=32, max_slots=6,
+                            max_blocks_per_seq=8, token_budget=8,
+                            kv_dtype=jnp.float32, quantized=True)
+    else:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=16, num_heads=8, num_kv_heads=8, max_seq_len=4096)
+        n_req, prompt_len, max_new = 12, 32, 16
+        ecfg = EngineConfig(block_size=16, num_blocks=256, max_slots=12,
+                            max_blocks_per_seq=16, token_budget=64,
+                            kv_dtype=cfg.dtype, quantized=True)
+    params = meta.unbox(llama.LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    clean = fabric_chaos_drill(cfg, params, ecfg, n_requests=n_req,
+                               prompt_len=prompt_len,
+                               max_new_tokens=max_new,
+                               clock=lambda: 0.0)
+    torn = fabric_chaos_drill(
+        cfg, params, ecfg, n_requests=n_req, prompt_len=prompt_len,
+        max_new_tokens=max_new, clock=lambda: 0.0,
+        plan_spec="seed=3; link|* : link_partition, after=8, times=1")
+    print(f"bench: fabric drill "
+          f"availability={clean['fabric_availability']}"
+          f"/{torn['fabric_availability']} "
+          f"handoffs={clean['handoffs']} "
+          f"wire_ratio={clean['handoff_wire_ratio']:.2f} "
+          f"partition_aborts={torn['handoff_aborts']} "
+          f"reprefilled={torn['reprefilled_tokens']}",
+          file=sys.stderr)
+    tag = f"{platform}1"
+    return {
+        f"fabric_availability_{tag}": {
+            "value": round(clean["fabric_availability"], 4),
+            "unit": "frac", "vs_baseline": 1.0},
+        f"fabric_availability_partition_{tag}": {
+            "value": round(torn["fabric_availability"], 4),
+            "unit": "frac", "vs_baseline": 1.0},
+        f"fabric_greedy_match_ref_{tag}": {
+            "value": round(clean["fabric_greedy_match_ref"], 4),
+            "unit": "frac", "vs_baseline": 1.0},
+        f"handoff_wire_ratio_{tag}": {
+            "value": round(clean["handoff_wire_ratio"], 3),
+            "unit": "x", "vs_baseline": 1.0},
+        f"handoff_retries_{tag}": {
+            "value": int(clean["handoff_retries"]), "unit": "retries",
+            "vs_baseline": 1.0},
+        f"handoffs_{tag}": {
+            "value": int(clean["handoffs"]), "unit": "sessions",
+            "vs_baseline": 1.0},
+        f"ttft_p99_ms_handoff_{tag}": {
+            "value": round(clean["ttft_p99_ms_handoff"], 2),
+            "unit": "ms", "vs_baseline": 1.0},
+        f"fabric_reprefilled_tokens_partition_{tag}": {
+            "value": int(torn["reprefilled_tokens"]), "unit": "tokens",
+            "vs_baseline": 1.0},
+        f"fabric_decode_compile_count_{tag}": {
+            "value": int(max(clean["decode_compile_count"],
+                             torn["decode_compile_count"])),
+            "unit": "compiles", "vs_baseline": 1.0},
+        f"fabric_pool_leak_blocks_{tag}": {
+            "value": int(clean["pool_leak_blocks"]
+                         + torn["pool_leak_blocks"]),
+            "unit": "blocks", "vs_baseline": 1.0},
     }
 
 
@@ -2113,6 +2217,12 @@ if __name__ == "__main__":
              "graceful scale-down, revival through the executable cache; "
              "docs/serving.md)")
     _p.add_argument(
+        "--disagg-fabric", action="store_true",
+        help="also run the cross-host fabric drill (prefill->decode KV "
+             "handoff streamed int8 over a simulated DCN link, clean and "
+             "under link_partition chaos; reports handoff_wire_ratio, "
+             "handoff_retries, ttft_p99_ms_handoff; docs/serving.md)")
+    _p.add_argument(
         "--sdc", action="store_true",
         help="also run the silent-data-corruption drill (chaos bitflips "
              "on train params and served tokens; fingerprint detection "
@@ -2165,4 +2275,5 @@ if __name__ == "__main__":
          overlap=_args.overlap, router=_args.router,
          prefix_heavy=_args.prefix_heavy, plan_mode=_args.plan,
          obs_mode=_args.obs, elastic=_args.elastic, sdc=_args.sdc,
-         moe=_args.moe, lint_mode=_args.lint)
+         moe=_args.moe, lint_mode=_args.lint,
+         disagg_fabric=_args.disagg_fabric)

@@ -4,7 +4,11 @@ The router/engine contract (``docs/serving.md``) is that serving failures
 are *typed* and *bounded*: a replica failure surfaces as
 ``ReplicaCrashed``/``CacheExhaustedError``/``RequestRejected`` and is
 handled by the circuit breaker with bounded, backed-off resubmission.
-Two anti-patterns silently void that contract:
+The cross-host transport (``inference/transport.py``) extends the same
+contract to the wire: chunk loss/corruption surfaces as
+``ChunkError``/``ChunkIntegrityError`` and is healed by *bounded*
+retransmission with exponential backoff. Three anti-patterns silently
+void that contract:
 
 * **Bare ``except``/``except Exception`` swallowing around
   ``engine.step``/``submit`` call sites** — a handler that catches
@@ -13,34 +17,45 @@ Two anti-patterns silently void that contract:
   are never resubmitted, and the request is simply lost. Catch the typed
   serving exceptions instead.
 
-* **Unbounded retry loops without backoff** — a ``while True:`` retry
-  whose handler ``continue``s straight back without sleeping/backing off
-  hammers a sick replica in a hot loop (and, with the point above, can
-  spin forever). Retries must be bounded (attempt counter) or paced
+* **Bare excepts swallowing around chunk ``send``/``recv`` call
+  sites** — a swallowed link failure becomes silence: the receiver can
+  never NACK what it never learned was sent, the sender's retransmit
+  timers never arm, and the stream wedges instead of healing or
+  aborting into the re-prefill fallback.
+
+* **Unbounded retry/retransmit loops without backoff** — a ``while
+  True:`` retry whose handler ``continue``s straight back without
+  sleeping/backing off hammers a sick replica in a hot loop, and a
+  ``while True:`` retransmit around ``.send(...)`` with neither an
+  attempt bound nor pacing floods a degraded link forever. Retries must
+  be bounded (attempt counter, like ``max_chunk_attempts``) or paced
   (backoff), like the router's ``max_retries`` + exponential backoff.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List
+from typing import Iterator, List, Optional, Tuple
 
 from . import astutil
 from .core import Finding, LintContext, register
 
 _ENGINE_CALLS = ("step", "submit")
+_LINK_CALLS = ("send", "recv")
 _BROAD = ("Exception", "BaseException")
 _PACING = ("sleep", "backoff", "wait", "delay")
+#: identifier fragments that signal a retransmit loop is attempt-bounded
+_BOUND_NAMES = ("attempt", "retr", "tries")
 
 
-def _engine_call_in(body) -> ast.Call:
-    """First ``<obj>.step(...)`` / ``<obj>.submit(...)`` call under these
-    statements, or None."""
+def _call_in(body, names) -> Optional[ast.Call]:
+    """First ``<obj>.<name>(...)`` call under these statements, for any
+    ``name`` in ``names``, or None."""
     for stmt in body:
         for node in ast.walk(stmt):
             if (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _ENGINE_CALLS):
+                    and node.func.attr in names):
                 return node
     return None
 
@@ -58,13 +73,27 @@ def _swallows(handler: ast.ExceptHandler) -> bool:
                    for stmt in handler.body for n in ast.walk(stmt))
 
 
-def _calls_pacing(handler: ast.ExceptHandler) -> bool:
-    for stmt in handler.body:
-        for node in ast.walk(stmt):
-            if isinstance(node, ast.Call):
-                name = (astutil.tail_name(node.func) or "").lower()
-                if any(p in name for p in _PACING):
-                    return True
+def _calls_pacing(node) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = (astutil.tail_name(sub.func) or "").lower()
+            if any(p in name for p in _PACING):
+                return True
+    return False
+
+
+def _has_attempt_bound(loop: ast.While) -> bool:
+    """Any identifier in the loop that smells like an attempt counter
+    (``attempts``, ``retries``, ``tries``...) — the loop then has a
+    termination signal the rule trusts."""
+    for node in ast.walk(loop):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and any(b in name.lower() for b in _BOUND_NAMES):
+            return True
     return False
 
 
@@ -72,30 +101,49 @@ def _is_while_true(loop: ast.While) -> bool:
     return isinstance(loop.test, ast.Constant) and loop.test.value is True
 
 
+def _broad_swallow_findings(ctx, node: ast.Try
+                            ) -> Iterator[Tuple[ast.ExceptHandler, str]]:
+    engine_call = _call_in(node.body, _ENGINE_CALLS)
+    link_call = (None if engine_call is not None
+                 else _call_in(node.body, _LINK_CALLS))
+    if engine_call is None and link_call is None:
+        return
+    for handler in node.handlers:
+        if not (_is_broad_handler(handler) and _swallows(handler)):
+            continue
+        if engine_call is not None:
+            yield handler, (
+                f"broad except swallows failures around "
+                f"`.{engine_call.func.attr}(...)` — a replica death "
+                "becomes a silent no-op and the request is lost; "
+                "catch the typed serving exceptions "
+                "(RequestRejected / CacheExhaustedError / "
+                "ReplicaCrashed) or re-raise")
+        else:
+            yield handler, (
+                f"broad except swallows failures around chunk "
+                f"`.{link_call.func.attr}(...)` — a lost or corrupt "
+                "chunk becomes silence: no NACK, no retransmit timer, "
+                "no abort into the re-prefill fallback; catch the "
+                "typed transport exceptions (ChunkError / "
+                "ChunkIntegrityError) or re-raise")
+
+
 @register(
     "serving-resilience",
-    "bare except swallowing around engine.step/submit call sites and "
-    "unbounded retry loops without backoff inside inference/ (voids the "
+    "bare except swallowing around engine.step/submit and chunk "
+    "send/recv call sites, and unbounded retry/retransmit loops without "
+    "an attempt bound or backoff inside inference/ (voids the "
     "typed-failure + bounded-failover contract)",
     scope=("inference",))
 def check(ctx: LintContext) -> Iterator[Finding]:
     findings: List[Finding] = []
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.Try):
-            call = _engine_call_in(node.body)
-            if call is None:
-                continue
-            for handler in node.handlers:
-                if _is_broad_handler(handler) and _swallows(handler):
-                    findings.append(Finding(
-                        ctx.path, handler.lineno, handler.col_offset,
-                        "serving-resilience",
-                        f"broad except swallows failures around "
-                        f"`.{call.func.attr}(...)` — a replica death "
-                        "becomes a silent no-op and the request is lost; "
-                        "catch the typed serving exceptions "
-                        "(RequestRejected / CacheExhaustedError / "
-                        "ReplicaCrashed) or re-raise"))
+            for handler, msg in _broad_swallow_findings(ctx, node):
+                findings.append(Finding(
+                    ctx.path, handler.lineno, handler.col_offset,
+                    "serving-resilience", msg))
         elif isinstance(node, ast.While) and _is_while_true(node):
             for sub in ast.walk(node):
                 if not isinstance(sub, ast.ExceptHandler):
@@ -112,4 +160,15 @@ def check(ctx: LintContext) -> Iterator[Finding]:
                         "hammers a sick replica in a hot loop; bound the "
                         "retries (max_retries) and pace them "
                         "(exponential backoff)"))
+            send_call = _call_in(node.body, ("send",))
+            if (send_call is not None and not _calls_pacing(node)
+                    and not _has_attempt_bound(node)):
+                findings.append(Finding(
+                    ctx.path, node.lineno, node.col_offset,
+                    "serving-resilience",
+                    "unbounded retransmit: `while True` around "
+                    "`.send(...)` with neither an attempt cap nor "
+                    "backoff floods a degraded link forever; cap the "
+                    "attempts (max_chunk_attempts) and pace the "
+                    "retransmits (exponential backoff)"))
     yield from findings

@@ -10,7 +10,10 @@
 * :mod:`.engine` — continuous-batching serving engine over the paged pool.
 * :mod:`.router` — multi-replica front-end: placement, admission control,
   health-checked failover, graceful drain, obs-driven autoscaling, live
-  KV-session migration.
+  KV-session migration, two-tier prefill/decode fabric.
+* :mod:`.transport` — cross-host KV handoff: chunked int8 wire format,
+  simulated DCN link under chaos, NACK + bounded-backoff retransmit,
+  atomic commit with re-prefill fallback.
 * :mod:`.aot_cache` — serialized-executable cache: replicas *load* their
   compiled step instead of recompiling (warm scale-up/revival).
 """
@@ -25,9 +28,11 @@ from . import engine
 from . import sampling
 from . import speculative
 from . import router
+from . import transport
 from .aot_cache import AotExecutableCache, AotWorker
 from .engine import (EngineConfig, EngineStats, RequestRejected,
-                     RequestResult, ServingEngine, SessionTicket)
+                     RequestResult, ServingEngine, SessionTicket,
+                     TICKET_MAGIC, TicketWireError)
 from .generation import (DECODE_BUCKETS, decode_step, generate, pick_bucket,
                          prefill)
 from .kv_cache import KVCache, init_kv_cache
@@ -38,10 +43,12 @@ from .model_builder import (ModelBuilder, NxDModel, bundle_generate,
 from .paging import (BlockAllocator, CacheExhaustedError, PagedKVCache,
                      PrefixCache, QuantizedPagedKVCache, cow_copy_blocks,
                      init_paged_kv_cache, init_quantized_paged_kv_cache)
-from .router import (ReplicaRouter, RouterConfig, RouterResult, RouterStats,
-                     ScalePolicy, ServingPreempted, TenantPolicy,
-                     elastic_chaos_drill)
+from .router import (FabricConfig, ReplicaRouter, RouterConfig, RouterResult,
+                     RouterStats, ScalePolicy, ServingPreempted,
+                     TenantPolicy, elastic_chaos_drill, fabric_chaos_drill)
 from .sampling import SamplingConfig, sample
+from .transport import (CHUNK_MAGIC, ChunkError, ChunkIntegrityError,
+                        DcnLink, KVStreamTransport, StreamConfig)
 from .speculative import make_speculation_round_fn
 
 __all__ = [
@@ -54,10 +61,12 @@ __all__ = [
     "PrefixCache", "QuantizedPagedKVCache", "cow_copy_blocks",
     "init_paged_kv_cache", "init_quantized_paged_kv_cache",
     "ServingEngine", "EngineConfig", "EngineStats", "RequestRejected",
-    "RequestResult", "SessionTicket",
+    "RequestResult", "SessionTicket", "TICKET_MAGIC", "TicketWireError",
     "ReplicaRouter", "RouterConfig", "RouterResult", "RouterStats",
     "ScalePolicy", "ServingPreempted", "TenantPolicy",
-    "elastic_chaos_drill",
+    "elastic_chaos_drill", "fabric_chaos_drill", "FabricConfig",
+    "transport", "CHUNK_MAGIC", "ChunkError", "ChunkIntegrityError",
+    "DcnLink", "KVStreamTransport", "StreamConfig",
     "ModelBuilder", "NxDModel", "generate_buckets", "shard_checkpoint",
     "register_serving_workers", "serving_state_spec",
     "bundle_generate", "bundle_speculative_generate",

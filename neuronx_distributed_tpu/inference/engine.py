@@ -40,7 +40,11 @@ from ..obs.accounting import CompileTracker
 from ..obs.events import emit_event
 from ..obs.metrics import get_registry
 from ..obs.tracing import get_tracer
-from ..resilience.integrity import IntegrityError, kv_payload_fingerprints
+from ..resilience.integrity import (
+    IntegrityError,
+    fingerprint_array_np,
+    kv_payload_fingerprints,
+)
 from .aot_cache import AotExecutableCache, AotWorker, source_fingerprint
 from .kv_cache import PAD_POSITION
 from .paging import (PAYLOAD_BLOCK_AXES, BlockAllocator, CacheExhaustedError,
@@ -95,8 +99,9 @@ class EngineConfig:
     # shipped KV blocks (host-side int32 bit-folds over the extracted
     # payload) and import_session verifies them before touching the pool.
     # Host-only — the compiled step is untouched, so compile_count and
-    # AOT cache keys are integrity-agnostic. Tickets without fingerprints
-    # (older exporters, integrity=False) import unchecked.
+    # AOT cache keys are integrity-agnostic. Fail-closed: a ticket that
+    # ships KV *without* fingerprints is rejected when integrity is on —
+    # unverifiable blocks don't get to ride in under the radar.
     integrity: bool = True
 
 
@@ -169,6 +174,18 @@ class _RequestState:
         self.trie_dead = False
 
 
+#: SessionTicket wire format magic — same shape as the AOT cache's
+#: ``NXDAOT1``: ASCII magic + format version + newline, so version skew
+#: is detectable from the first 8 bytes.
+TICKET_MAGIC = b"NXDTKT1\n"
+
+
+class TicketWireError(RuntimeError):
+    """A serialized :class:`SessionTicket` failed to parse: wrong magic,
+    version skew, truncation, or payload corruption. Typed so transports
+    and drills can branch on 'bad bytes' without catching the world."""
+
+
 @dataclasses.dataclass
 class SessionTicket:
     """A live request lifted off one engine for landing on another
@@ -205,6 +222,104 @@ class SessionTicket:
     # migrated request still yields one complete end-to-end span. None
     # with tracing off (and for tickets from older exporters).
     trace: Optional[Dict[str, Any]] = None
+
+    # -- wire format ------------------------------------------------------
+    #
+    # magic+version line, one JSON header line (scheduler state, kv_fp,
+    # trace, and an array manifest: name/dtype/shape/nbytes in payload
+    # order plus a whole-payload fingerprint), then the concatenated raw
+    # array bytes. Mirrors the ``.aotx`` ``NXDAOT1`` layout so both wire
+    # formats are versioned and self-describing; unlike the AOT cache's
+    # degrade-to-miss read path, a bad ticket is *rejected* with a typed
+    # :class:`TicketWireError` — silently continuing a torn session is
+    # exactly what the integrity layer exists to prevent.
+
+    def to_bytes(self) -> bytes:
+        """Serialize into the versioned ``NXDTKT1`` wire format."""
+        manifest = []
+        payload = b""
+        for name in sorted(self.kv or {}):
+            arr = np.ascontiguousarray(np.asarray(self.kv[name]))
+            manifest.append({"name": name, "dtype": str(arr.dtype),
+                             "shape": list(arr.shape),
+                             "nbytes": int(arr.nbytes)})
+            payload += arr.tobytes()
+        header = {
+            "uid": self.uid, "prompt": list(self.prompt),
+            "generated": list(self.generated),
+            "max_new_tokens": int(self.max_new_tokens),
+            "n_cached": int(self.n_cached), "age_s": float(self.age_s),
+            "ttft_s": (None if self.ttft_s is None
+                       else float(self.ttft_s)),
+            "n_blocks": int(self.n_blocks), "kv_fp": self.kv_fp,
+            "trace": self.trace, "arrays": manifest,
+            "payload_fp": int(fingerprint_array_np(
+                np.frombuffer(payload, np.uint8))[0]),
+        }
+        import json
+
+        return (TICKET_MAGIC + json.dumps(header).encode("utf-8")
+                + b"\n" + payload)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SessionTicket":
+        """Parse :meth:`to_bytes` output; raises :class:`TicketWireError`
+        on bad magic, version skew, truncation, or a payload that does
+        not fingerprint to what the header promised."""
+        import json
+
+        if len(data) < len(TICKET_MAGIC) \
+                or data[:6] != TICKET_MAGIC[:6]:
+            raise TicketWireError(
+                "not a session ticket (bad magic)")
+        if data[:len(TICKET_MAGIC)] != TICKET_MAGIC:
+            got = data[:len(TICKET_MAGIC)].rstrip(b"\n").decode(
+                "ascii", "replace")
+            raise TicketWireError(
+                f"ticket version skew: got {got!r}, this reader speaks "
+                f"{TICKET_MAGIC.rstrip().decode('ascii')!r} — refusing "
+                "to guess at a foreign layout")
+        nl = data.find(b"\n", len(TICKET_MAGIC))
+        if nl < 0:
+            raise TicketWireError("truncated ticket: no header line")
+        try:
+            header = json.loads(data[len(TICKET_MAGIC):nl])
+        except ValueError as e:
+            raise TicketWireError(f"corrupt ticket header: {e}") from e
+        payload = data[nl + 1:]
+        want = sum(a["nbytes"] for a in header.get("arrays", []))
+        if len(payload) != want:
+            raise TicketWireError(
+                f"truncated ticket payload: header promises {want} "
+                f"byte(s), {len(payload)} arrived")
+        got_fp = int(fingerprint_array_np(
+            np.frombuffer(payload, np.uint8))[0])
+        if got_fp != int(header.get("payload_fp", got_fp)):
+            raise TicketWireError(
+                "ticket payload failed its integrity fingerprint — the "
+                "bytes that arrived are not the bytes that were sent")
+        kv: Optional[Dict[str, Any]] = None
+        off = 0
+        for a in header.get("arrays", []):
+            arr = np.frombuffer(
+                payload[off:off + a["nbytes"]],
+                dtype=np.dtype(a["dtype"])).reshape(a["shape"]).copy()
+            kv = kv or {}
+            kv[a["name"]] = arr
+            off += a["nbytes"]
+        kv_fp = header.get("kv_fp")
+        if kv_fp is not None:
+            kv_fp = {k: [int(x) for x in v] for k, v in kv_fp.items()}
+        return cls(
+            uid=header["uid"], prompt=list(header["prompt"]),
+            generated=list(header["generated"]),
+            max_new_tokens=int(header["max_new_tokens"]),
+            n_cached=int(header["n_cached"]),
+            age_s=float(header["age_s"]),
+            ttft_s=(None if header["ttft_s"] is None
+                    else float(header["ttft_s"])),
+            n_blocks=int(header["n_blocks"]), kv=kv, kv_fp=kv_fp,
+            trace=header.get("trace"))
 
 
 #: label set shared by the four per-request histograms.
@@ -712,13 +827,28 @@ class ServingEngine:
         :class:`~..resilience.integrity.IntegrityError` when the shipped
         KV blocks fail their fingerprint check (a corrupted session must
         never be continued, and a *partially* imported one would be
-        worse: the verify runs before any pool mutation)."""
+        worse: the verify runs before any pool mutation). With
+        ``integrity`` on, a ticket that ships KV *without* fingerprints
+        is also rejected — fail closed; importing unverifiable blocks
+        would silently disable the very check the config asked for."""
         if self._draining:
             raise RequestRejected(
                 "draining", f"{ticket.uid}: engine is draining")
         if not self.fits(len(ticket.prompt), ticket.max_new_tokens):
             raise RequestRejected(
                 "never_fits", f"{ticket.uid}: cannot fit this engine")
+        if (self.ecfg.integrity and ticket.kv is not None
+                and ticket.kv_fp is None):
+            self.stats.integrity_rejects += 1
+            emit_event("integrity_mismatch", scope="kv_ticket",
+                       uid=ticket.uid,
+                       corrupt=[("<unfingerprinted>", -1)])
+            raise IntegrityError(
+                f"{ticket.uid}: ticket ships KV with no fingerprints "
+                "while this engine enforces integrity — importing "
+                "unverifiable blocks would silently skip the check; "
+                "re-export with integrity on (or turn it off here "
+                "explicitly)")
         if ticket.kv is not None and ticket.kv_fp is not None:
             arrived = kv_payload_fingerprints(ticket.kv, PAYLOAD_BLOCK_AXES)
             bad: List[Tuple[str, int]] = []
@@ -739,6 +869,16 @@ class ServingEngine:
                     f"{ticket.uid}: shipped KV blocks failed their "
                     f"integrity fingerprints at (tensor, block) {bad[:8]} "
                     "— ticket rejected, nothing imported")
+        self._land_session(ticket, blocks=None)
+
+    def _land_session(self, ticket: SessionTicket,
+                      blocks: Optional[List[int]]) -> None:
+        """Shared landing tail of :meth:`import_session` and
+        :meth:`commit_stream_import`: rebuild scheduler state at the
+        ticket's exported position. ``blocks=None`` means the KV rides
+        in ``ticket.kv`` and blocks are allocated+injected here;
+        otherwise ``blocks`` are already allocated and hold the streamed
+        payload, and only the slot wiring happens."""
         now = self._now()
         req = _RequestState(
             uid=ticket.uid, prompt=[int(t) for t in ticket.prompt],
@@ -766,11 +906,12 @@ class ServingEngine:
         if not free:
             raise CacheExhaustedError(
                 f"{ticket.uid}: no free slot on this engine")
-        blocks = self._alloc_blocks(ticket.n_blocks)
-        self.cache = inject_blocks(self.cache, blocks, ticket.kv)
-        # injected blocks are fully overwritten (K/V and positions) —
-        # a pending freed-position wipe would null real rows
-        self._freed_dirty.difference_update(blocks)
+        if blocks is None:
+            blocks = self._alloc_blocks(ticket.n_blocks)
+            self.cache = inject_blocks(self.cache, blocks, ticket.kv)
+            # injected blocks are fully overwritten (K/V and positions)
+            # — a pending freed-position wipe would null real rows
+            self._freed_dirty.difference_update(blocks)
         slot = free[0]
         req.slot = slot
         req.admit_seq = self._admit_counter
@@ -788,6 +929,89 @@ class ServingEngine:
         self.stats.queue_depth = self.queue_depth()
         # the landed prompt blocks are publishable prefix state here too
         self._maybe_insert_prefix(req)
+
+    # -- streamed import (cross-host handoff) -----------------------------
+    #
+    # Three-phase landing for KV that arrives chunk-by-chunk over a DCN
+    # stream instead of inside one ticket: reserve blocks up front,
+    # inject each per-layer chunk as it clears its wire fingerprint, and
+    # wire the scheduler state only once the whole stream committed. The
+    # reserved blocks are never mapped into any slot's table until
+    # commit, so half-landed state cannot reach attention; a torn stream
+    # aborts and the blocks free (back through the stale-position wipe)
+    # with the pool exactly as before ``begin``.
+
+    def begin_stream_import(self, ticket: SessionTicket
+                            ) -> Dict[str, Any]:
+        """Open a streamed import for ``ticket`` (the stream's *meta*:
+        scheduler state with ``kv`` stripped — the payload follows chunk
+        by chunk via :meth:`stream_inject`). Reserves ``ticket.n_blocks``
+        pool blocks and returns an opaque handle for the other three
+        phases. Raises like :meth:`import_session`'s admission checks;
+        nothing is reserved on failure."""
+        if self._draining:
+            raise RequestRejected(
+                "draining", f"{ticket.uid}: engine is draining")
+        if not self.fits(len(ticket.prompt), ticket.max_new_tokens):
+            raise RequestRejected(
+                "never_fits", f"{ticket.uid}: cannot fit this engine")
+        if ticket.n_blocks <= 0:
+            raise ValueError(
+                f"{ticket.uid}: streamed import needs KV blocks; "
+                "queued-state tickets go through import_session")
+        if not self._free_slots():
+            raise CacheExhaustedError(
+                f"{ticket.uid}: no free slot on this engine")
+        blocks = self._alloc_blocks(ticket.n_blocks)
+        # chunks overwrite every row of these blocks before commit maps
+        # them anywhere — a pending freed-position wipe between the pos
+        # chunk landing and commit would null real positions
+        self._freed_dirty.difference_update(blocks)
+        return {"uid": ticket.uid, "blocks": list(blocks),
+                "ticket": ticket}
+
+    def stream_inject(self, handle: Dict[str, Any], name: str,
+                      layer: int, arr: Any) -> None:
+        """Land one verified chunk into the reserved blocks: tensor
+        ``name`` (``k``/``v``/``k_scale``/``v_scale`` at ``layer``, or
+        the layer-less ``pos``). Chunks may land in any order; each
+        fully overwrites its rows."""
+        idx = jnp.asarray(handle["blocks"], jnp.int32)
+        if name == "pos":
+            self.cache = self.cache.replace(
+                pos=self.cache.pos.at[idx].set(
+                    jnp.asarray(arr, jnp.int32)))
+            return
+        pool = getattr(self.cache, name)
+        self.cache = self.cache.replace(**{
+            name: pool.at[layer, idx].set(jnp.asarray(arr, pool.dtype))})
+
+    def commit_stream_import(self, handle: Dict[str, Any]) -> None:
+        """Atomically publish a completed stream: wire the scheduler
+        state onto the (already-populated) reserved blocks. Re-checks
+        admission — the engine may have started draining or filled its
+        slots since ``begin`` — and raises without publishing anything;
+        the caller must then :meth:`abort_stream_import`."""
+        if self._draining:
+            raise RequestRejected(
+                "draining",
+                f"{handle['uid']}: engine is draining")
+        self._land_session(handle["ticket"], blocks=handle["blocks"])
+
+    def abort_stream_import(self, handle: Dict[str, Any]) -> None:
+        """Tear down a failed stream: free every reserved block (they
+        were never mapped into a table, so nothing else references
+        them). Idempotence is the caller's job — abort once."""
+        self._freed_dirty.update(self.allocator.free(handle["blocks"]))
+
+    def handoff_ready(self, request_id: str) -> bool:
+        """True once ``request_id`` has finished prefill *and* produced
+        its first token here — the earliest point where exporting it
+        ships a complete prompt KV and an honest ``ttft_s``."""
+        for req in self._slots:
+            if req is not None and req.uid == request_id:
+                return req.decoding and bool(req.generated)
+        return False
 
     def export_prefixes(self, max_blocks: Optional[int] = None
                         ) -> Optional[Dict[str, Any]]:

@@ -54,14 +54,31 @@ owns the request lifecycle end to end:
   ``import_session``), so zero tokens re-prefill and greedy outputs
   stay bit-identical across the move.
 
+* **cross-host fabric** — :class:`RouterConfig.fabric` splits the fleet
+  into two independently-scaled tiers (``p*`` prefill, ``d*`` decode) on
+  separate hosts: admissions land on the prefill tier; once a request
+  finishes prefill and produces its first token, its session is exported
+  and *streamed* to the least-loaded decode replica through a
+  :class:`~.transport.KVStreamTransport` over a simulated
+  :class:`~.transport.DcnLink` (chunked, fingerprinted, NACK/retransmit
+  with bounded backoff — see :mod:`.transport`), overlapping the
+  transfer with the decode tier's ongoing steps. A committed stream
+  resumes decode with zero re-prefill; a torn stream (retransmit budget
+  exhausted, e.g. under ``link_partition``) frees every
+  partially-landed block and falls back to resubmit-from-prompt on the
+  prefill tier (``no_handoff``), so availability stays 1.0 and greedy
+  outputs stay bit-identical either way.
+
 Chaos drills inject faults through :meth:`FaultPlan.consult` with
 ``op="step"`` and ``path=<replica name>`` — the plan *returns* directives
 (``crash`` / ``exhaust`` / ``preempt`` / latency seconds) instead of
 raising/sleeping, so injected latency is virtual and drills are
 deterministic under fake clocks; the fleet-level tick consults
-``op="scale"``, ``path="fleet"`` for ``scale_burst`` directives. See
-:func:`chaos_drill`, :func:`elastic_chaos_drill` and ``bench.py
---router`` / ``--elastic``.
+``op="scale"``, ``path="fleet"`` for ``scale_burst`` directives, and the
+fabric's link consults ``op="link"``, ``path=<route>`` for the
+``link_*`` kinds. See :func:`chaos_drill`, :func:`elastic_chaos_drill`,
+:func:`fabric_chaos_drill` and ``bench.py --router`` / ``--elastic`` /
+``--disagg-fabric``.
 """
 
 from __future__ import annotations
@@ -85,6 +102,7 @@ from .aot_cache import AotExecutableCache
 from .engine import (EngineConfig, RequestRejected, ServingEngine,
                      observe_request_metrics)
 from .paging import CacheExhaustedError
+from .transport import DcnLink, KVStreamTransport, StreamConfig
 
 
 class ServingPreempted(SystemExit):
@@ -139,6 +157,27 @@ class ScalePolicy:
     occupancy_high: float = 0.85    # worst replica's pool occupancy
     hysteresis_steps: int = 3
     cooldown_steps: int = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class FabricConfig:
+    """Two-tier cross-host topology: ``prefill_replicas`` hosts named
+    ``p0..`` take every admission; ``decode_replicas`` hosts named
+    ``d0..`` take streamed session handoffs once prefill completes.
+    ``stream`` parameterizes the shared DCN link and the per-stream
+    reliability knobs (:class:`~.transport.StreamConfig`);
+    ``prefill_scale`` / ``decode_scale`` are *independent* autoscale
+    policies — the whole point of disaggregation is that the two tiers
+    size to different signals (prefill to admission queue, decode to
+    slot/pool occupancy). ``None`` keeps a tier's size fixed. With a
+    fabric configured, ``RouterConfig.num_replicas`` and ``scale`` are
+    ignored."""
+
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    stream: StreamConfig = StreamConfig()
+    prefill_scale: Optional[ScalePolicy] = None
+    decode_scale: Optional[ScalePolicy] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -199,6 +238,10 @@ class RouterConfig:
     # through the circuit breaker and adopts the shadow's tokens.
     # 0 = off. Shadows ride outside admission: no stats, no budget.
     integrity_shadow_every: int = 0
+    # cross-host serving fabric: a two-tier prefill/decode topology with
+    # streamed KV handoff over a simulated DCN link (see FabricConfig
+    # and inference/transport.py). None = classic single-tier fleet.
+    fabric: Optional[FabricConfig] = None
 
 
 @dataclasses.dataclass
@@ -239,6 +282,13 @@ class RouterStats:
     integrity_mismatches: int = 0   # shadow/primary token divergences
     slo_breaches: int = 0           # objectives entering sustained breach
     slo_scale_ups: int = 0          # scale-ups the SLO layer demanded
+    handoffs: int = 0               # sessions committed over the fabric
+    handoff_aborts: int = 0         # torn streams (fell back to re-prefill)
+    handoff_chunks: int = 0         # chunks across committed streams
+    handoff_retries: int = 0        # chunk retransmissions (all streams)
+    handoff_bytes: int = 0          # wire bytes incl headers/retransmits
+    handoff_wire_payload_bytes: int = 0   # first-copy payload bytes
+    handoff_fp32_payload_bytes: int = 0   # same payload at fp32 (baseline)
     ttft_s: List[float] = dataclasses.field(default_factory=list)
 
     def availability(self) -> float:
@@ -270,6 +320,14 @@ class RouterStats:
             "integrity_mismatches": self.integrity_mismatches,
             "slo_breaches": self.slo_breaches,
             "slo_scale_ups": self.slo_scale_ups,
+            "handoffs": self.handoffs,
+            "handoff_aborts": self.handoff_aborts,
+            "handoff_chunks": self.handoff_chunks,
+            "handoff_retries": self.handoff_retries,
+            "handoff_bytes": self.handoff_bytes,
+            "handoff_wire_ratio": (
+                self.handoff_fp32_payload_bytes
+                / max(1, self.handoff_wire_payload_bytes)),
             "rejected_by_reason": dict(self.rejected_by_reason),
             "tenant_shed": dict(self.tenant_shed),
             "ttft_p50_ms": float(np.percentile(ttft, 50)) * 1e3,
@@ -324,6 +382,8 @@ class _RouterRequest:
     shadow_of: Optional[str] = None  # uid of the primary this re-decodes
     avoid_replica: Optional[str] = None  # don't place on the primary
     expect_tokens: Optional[List[int]] = None  # primary's recorded tokens
+    no_handoff: bool = False        # torn-stream fallback: finish where
+    #                                 placed, never re-enter the fabric
 
     @property
     def total_tokens(self) -> int:
@@ -340,6 +400,7 @@ class _Replica:
     ok_steps: int = 0               # clean steps while in probation
     generation: int = 0             # bumped per engine replacement, so
     corrupt_bit: Optional[int] = None  # armed chaos bitflip (SDC drill)
+    tier: str = "serve"             # "serve" | fabric: "prefill"/"decode"
     assigned: Dict[str, _RouterRequest] = dataclasses.field(  # obs series
         default_factory=dict)       # from before a revival stay distinct
 
@@ -398,20 +459,46 @@ class ReplicaRouter:
             raise ValueError(
                 f"unknown placement {cfg.placement!r}: want 'jsq' or "
                 f"'prefix'")
-        if engines is not None:
-            if len(engines) != cfg.num_replicas:
+        # cross-host fabric state (None / empty outside fabric mode)
+        self._fabric = cfg.fabric
+        self._streams: Dict[str, Dict[str, Any]] = {}
+        self._link: Optional[DcnLink] = None
+        self._tier_scale = {t: {"cooldown": 0, "up": 0, "down": 0}
+                            for t in ("prefill", "decode")}
+        if self._fabric is not None:
+            if engines is not None:
                 raise ValueError(
-                    f"got {len(engines)} engines for "
-                    f"num_replicas={cfg.num_replicas}")
-            engines = list(engines)
+                    "engines= injection is incompatible with a two-tier "
+                    "fabric: the router builds tiered replicas itself")
+            fb = self._fabric
+            self._link = DcnLink(bandwidth=fb.stream.bandwidth,
+                                 latency_s=fb.stream.latency_s,
+                                 chaos=chaos)
+            self.replicas = [
+                _Replica(name=f"p{i}", engine=self._new_engine(f"p{i}"),
+                         monitor=ReplicaMonitor(cfg), tier="prefill")
+                for i in range(fb.prefill_replicas)] + [
+                _Replica(name=f"d{i}", engine=self._new_engine(f"d{i}"),
+                         monitor=ReplicaMonitor(cfg), tier="decode")
+                for i in range(fb.decode_replicas)]
+            self._tier_seq = {"prefill": fb.prefill_replicas,
+                              "decode": fb.decode_replicas}
         else:
-            engines = [self._new_engine(f"r{i}")
-                       for i in range(cfg.num_replicas)]
-        self.replicas = [
-            _Replica(name=f"r{i}", engine=eng, monitor=ReplicaMonitor(cfg))
-            for i, eng in enumerate(engines)]
-        for eng in engines:
-            eng._standalone_obs = False  # router owns request retirement
+            if engines is not None:
+                if len(engines) != cfg.num_replicas:
+                    raise ValueError(
+                        f"got {len(engines)} engines for "
+                        f"num_replicas={cfg.num_replicas}")
+                engines = list(engines)
+            else:
+                engines = [self._new_engine(f"r{i}")
+                           for i in range(cfg.num_replicas)]
+            self.replicas = [
+                _Replica(name=f"r{i}", engine=eng,
+                         monitor=ReplicaMonitor(cfg))
+                for i, eng in enumerate(engines)]
+            for eng in engines:
+                eng._standalone_obs = False  # router owns retirement
         self._replica_seq = cfg.num_replicas  # next fresh replica name
         # declarative SLO layer (see RouterConfig.slo)
         self.slo = SloMonitor(cfg.slo) if cfg.slo is not None else None
@@ -453,7 +540,7 @@ class ReplicaRouter:
         return [r for r in self.replicas if r.live]
 
     def has_work(self) -> bool:
-        return bool(self._pending) or any(
+        return bool(self._pending) or bool(self._streams) or any(
             r.assigned for r in self.replicas)
 
     def _policy(self, tenant: str) -> TenantPolicy:
@@ -593,6 +680,12 @@ class ReplicaRouter:
 
     def _choose_replica(self, req: _RouterRequest) -> Optional[_Replica]:
         live = self.live_replicas()
+        if self._fabric is not None:
+            # every admission prefills on the prefill tier — including
+            # torn-stream fallbacks, which then finish there colocated
+            # (no_handoff) instead of re-entering the fabric. Decode
+            # replicas only ever receive committed streams.
+            live = [r for r in live if r.tier == "prefill"]
         if not live:
             return None
         if req.avoid_replica is not None:
@@ -719,6 +812,7 @@ class ReplicaRouter:
         """Trip the circuit breaker: evict/salvage in-flight requests to
         pending, mark the replica down for a probation window."""
         self.stats.failovers += 1
+        self._abort_streams_to(rep, why)
         reg = get_registry()
         if reg.enabled:
             reg.counter("nxd_router_failovers_total",
@@ -815,9 +909,12 @@ class ReplicaRouter:
                 self._requeue(req, None, lost_generated=0)
                 continue
             dest = None
+            # same tier first (a fabric decode session belongs on the
+            # decode tier), most free blocks within a tier
             for cand in sorted(
                     (r for r in self.live_replicas() if r is not rep),
-                    key=lambda r: -r.engine.pool_free_blocks()):
+                    key=lambda r: (r.tier != rep.tier,
+                                   -r.engine.pool_free_blocks())):
                 try:
                     cand.engine.import_session(ticket)
                     dest = cand
@@ -846,12 +943,137 @@ class ReplicaRouter:
                        reason=why, sessions=moved)
         return moved
 
+    # -- cross-host fabric (streamed prefill→decode handoff) ---------------
+
+    def _choose_decode_dest(self) -> Optional[_Replica]:
+        """Least-loaded live decode replica, or None (the session then
+        simply keeps decoding on its prefill replica — degradation, not
+        an outage)."""
+        cands = [r for r in self.live_replicas() if r.tier == "decode"]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (self._score(r), r.name))
+
+    def _begin_handoffs(self, rep: _Replica) -> int:
+        """Export every handoff-ready session on prefill replica ``rep``
+        and open a stream toward the decode tier. The transfer overlaps
+        whatever the decode tier is already stepping; the request stays
+        un-assigned while its bytes fly (the stream owns it)."""
+        started = 0
+        now = self._now()
+        tracer = get_tracer()
+        for uid, req in list(rep.assigned.items()):
+            if req.no_handoff or req.shadow_of is not None:
+                continue
+            if uid in rep.engine.results \
+                    or not rep.engine.handoff_ready(uid):
+                continue
+            dest = self._choose_decode_dest()
+            if dest is None:
+                continue
+            ticket = rep.engine.export_session(uid)
+            del rep.assigned[uid]
+            if tracer.enabled and ticket.trace is not None:
+                # keep the live trace here while the bytes fly, so the
+                # transfer is a real phase in the request span; the
+                # precommit hook folds it back into the landing ticket
+                tracer.request_import(ticket.trace)
+                tracer.request_phase_begin(uid, "handoff")
+            route = f"{rep.name}->{dest.name}/{uid}"
+            tr = KVStreamTransport(
+                ticket, dest.engine, self._link, route,
+                self._fabric.stream,
+                on_precommit=self._finish_handoff_trace)
+            self._streams[route] = {"tr": tr, "req": req, "dest": dest,
+                                    "src": rep.name}
+            tr.start(now)
+            started += 1
+        return started
+
+    def _finish_handoff_trace(self, tr: KVStreamTransport
+                              ) -> Optional[Dict[str, Any]]:
+        """Precommit hook: close the handoff phase on the live trace and
+        hand the trace to the committing ticket, so the decode side
+        resumes one continuous span with the transfer inside it."""
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return None
+        uid = tr.ticket.uid
+        tracer.request_phase_end(uid, "handoff")
+        tracer.request_mark(uid, "handoff")
+        return tracer.request_export(uid)
+
+    def _abort_streams_to(self, rep: _Replica, why: str) -> None:
+        """A dying/retiring replica takes its inbound streams with it;
+        the terminal-state sweep in :meth:`_pump_streams` routes each
+        aborted request through the re-prefill fallback."""
+        for ent in self._streams.values():
+            if ent["dest"] is rep and ent["tr"].state == "streaming":
+                ent["tr"].abort(f"destination {rep.name}: {why}")
+
+    def _pump_streams(self) -> int:
+        """Deliver link arrivals to their streams, advance sender
+        timers, and resolve terminal streams: a commit re-assigns the
+        request to its decode replica; an abort re-queues it from the
+        prompt with ``no_handoff`` set (availability over locality) and
+        charges ``reprefilled_tokens`` + ``handoff_aborts``."""
+        if self._fabric is None:
+            return 0
+        now = self._now()
+        activity = 0
+        for route, data in self._link.deliver(now):
+            ent = self._streams.get(route)
+            if ent is not None:
+                ent["tr"].on_wire(data, now)
+                activity += 1
+        tracer = get_tracer()
+        for route, ent in list(self._streams.items()):
+            tr: KVStreamTransport = ent["tr"]
+            state = tr.pump(now)
+            if state == "streaming":
+                continue
+            del self._streams[route]
+            activity += 1
+            req: _RouterRequest = ent["req"]
+            self.stats.handoff_retries += tr.stats.retries
+            self.stats.handoff_bytes += tr.stats.wire_bytes
+            self.stats.handoff_wire_payload_bytes += \
+                tr.stats.wire_payload_bytes
+            self.stats.handoff_fp32_payload_bytes += \
+                tr.stats.fp32_payload_bytes
+            if state == "committed":
+                dest: _Replica = ent["dest"]
+                dest.assigned[req.uid] = req
+                if req.session:
+                    self._sessions[req.session] = dest.name
+                self.stats.handoffs += 1
+                self.stats.handoff_chunks += tr.stats.chunks
+                self.stats.migrated_sessions += 1
+                self.stats.migrated_tokens += tr.ticket.n_cached
+                continue
+            # torn stream: the ticket never landed — what's left of the
+            # request is its prompt. Resubmit colocated, bounded by the
+            # usual retry budget; greedy re-derives the same tokens.
+            self.stats.handoff_aborts += 1
+            self.stats.reprefilled_tokens += min(
+                tr.ticket.n_cached, len(tr.ticket.prompt))
+            req.no_handoff = True
+            if tracer.enabled:
+                # the handoff phase opened at export is still live on
+                # this side; close it before the failover machinery
+                # reopens router_queue
+                tracer.request_phase_end(req.uid, "handoff")
+            self._requeue(req, None,
+                          lost_generated=len(tr.ticket.generated))
+        return activity
+
     def _preempt_replica(self, rep: _Replica) -> None:
         """A SIGTERM-style eviction notice (chaos ``preempt``): unlike a
         crash, the drain window lets every live session migrate out
         before the engine goes away; the replica then sits out the usual
         probation window and revives through the AOT cache."""
         self.stats.preemptions += 1
+        self._abort_streams_to(rep, "preempt")
         self._migrate_sessions(rep, "preempt")
         rep.assigned.clear()
         self._drop_sessions_for(rep)
@@ -864,38 +1086,72 @@ class ReplicaRouter:
         rep.monitor = ReplicaMonitor(self.cfg)
         emit_event("router_preempt", replica=rep.name)
 
-    def scale_up(self, why: str = "manual") -> Optional[str]:
+    def _scale_policy(self, tier: Optional[str]) -> Optional[ScalePolicy]:
+        """The policy governing ``tier`` — the fabric's per-tier policy
+        when two-tier, else the fleet-wide ``cfg.scale``."""
+        if self._fabric is not None and tier is not None:
+            return (self._fabric.prefill_scale if tier == "prefill"
+                    else self._fabric.decode_scale)
+        return self.cfg.scale
+
+    def _tier_live(self, tier: Optional[str]) -> List[_Replica]:
+        live = self.live_replicas()
+        if tier is None:
+            return live
+        return [r for r in live if r.tier == tier]
+
+    def scale_up(self, why: str = "manual",
+                 tier: Optional[str] = None) -> Optional[str]:
         """Add a replica (warm-started from the AOT cache and, when
-        enabled, a shipped prefix trie). Returns its name, or None at
-        the policy's ``max_replicas`` cap."""
-        pol = self.cfg.scale
-        if pol is not None and len(self.live_replicas()) >= \
+        enabled, a shipped prefix trie). With a two-tier fabric, grows
+        ``tier`` (prefill/decode) under that tier's policy. Returns its
+        name, or None at the policy's ``max_replicas`` cap."""
+        if self._fabric is not None and tier is None:
+            tier = "prefill"
+        pol = self._scale_policy(tier)
+        if pol is not None and len(self._tier_live(tier)) >= \
                 pol.max_replicas:
             return None
-        name = f"r{self._replica_seq}"
-        self._replica_seq += 1
+        if self._fabric is not None:
+            name = f"{tier[0]}{self._tier_seq[tier]}"
+            self._tier_seq[tier] += 1
+        else:
+            name = f"r{self._replica_seq}"
+            self._replica_seq += 1
         rep = _Replica(name=name, engine=self._new_engine(name),
-                       monitor=ReplicaMonitor(self.cfg))
+                       monitor=ReplicaMonitor(self.cfg),
+                       tier=tier or "serve")
         self.replicas.append(rep)
         self._recompute_budget()
         self.stats.scale_ups += 1
-        self._scale_cooldown = pol.cooldown_steps if pol else 0
-        self._scale_up_streak = self._scale_down_streak = 0
+        if self._fabric is not None:
+            ts = self._tier_scale[tier]
+            ts["cooldown"] = pol.cooldown_steps if pol else 0
+            ts["up"] = ts["down"] = 0
+        else:
+            self._scale_cooldown = pol.cooldown_steps if pol else 0
+            self._scale_up_streak = self._scale_down_streak = 0
         self._warm_prefix(rep)
         emit_event("router_scale_up", replica=name, reason=why,
                    fleet=len(self.live_replicas()),
                    warm=rep.engine.aot_warm())
         return name
 
-    def scale_down(self, why: str = "manual") -> Optional[str]:
+    def scale_down(self, why: str = "manual",
+                   tier: Optional[str] = None) -> Optional[str]:
         """Gracefully retire one replica — fewest live sessions, newest
-        on ties — migrating its sessions to survivors. Returns the
+        on ties — migrating its sessions to survivors. With a two-tier
+        fabric, shrinks ``tier`` under that tier's floor. Returns the
         retired name, or None at the ``min_replicas`` floor."""
-        live = self.live_replicas()
-        floor = self.cfg.scale.min_replicas if self.cfg.scale else 1
+        if self._fabric is not None and tier is None:
+            tier = "prefill"
+        live = self._tier_live(tier)
+        pol = self._scale_policy(tier)
+        floor = pol.min_replicas if pol else 1
         if len(live) <= max(1, floor):
             return None
         victim = min(reversed(live), key=lambda r: len(r.assigned))
+        self._abort_streams_to(victim, "scaled down")
         self._collect(victim)
         self._migrate_sessions(victim, why)
         self._drop_sessions_for(victim)
@@ -904,9 +1160,13 @@ class ReplicaRouter:
         self.replicas.remove(victim)
         self._recompute_budget()
         self.stats.scale_downs += 1
-        pol = self.cfg.scale
-        self._scale_cooldown = pol.cooldown_steps if pol else 0
-        self._scale_up_streak = self._scale_down_streak = 0
+        if self._fabric is not None:
+            ts = self._tier_scale[tier]
+            ts["cooldown"] = pol.cooldown_steps if pol else 0
+            ts["up"] = ts["down"] = 0
+        else:
+            self._scale_cooldown = pol.cooldown_steps if pol else 0
+            self._scale_up_streak = self._scale_down_streak = 0
         emit_event("router_scale_down", replica=victim.name, reason=why,
                    fleet=len(self.live_replicas()))
         return victim.name
@@ -930,7 +1190,16 @@ class ReplicaRouter:
         """One :class:`ScalePolicy` decision: compare the fleet's load
         signals against the thresholds, require ``hysteresis_steps`` of
         agreement, respect the cooldown. No-op without a policy or while
-        draining (a draining fleet must only shrink by completion)."""
+        draining (a draining fleet must only shrink by completion).
+        With a fabric, each tier runs its own decision loop: the prefill
+        tier watches the admission queue, the decode tier watches
+        in-flight handoff streams plus its own occupancy."""
+        if self._fabric is not None:
+            if self._draining:
+                return
+            for tier in ("prefill", "decode"):
+                self._tick_autoscale_tier(tier)
+            return
         pol = self.cfg.scale
         if pol is None or self._draining:
             return
@@ -975,6 +1244,44 @@ class ReplicaRouter:
                                 f",occ={occupancy:.2f}")
         else:
             self._scale_up_streak = self._scale_down_streak = 0
+
+    def _tick_autoscale_tier(self, tier: str) -> None:
+        """One per-tier :class:`ScalePolicy` decision for the fabric.
+        Streak/cooldown state lives in ``_tier_scale[tier]`` so the two
+        tiers breathe independently."""
+        pol = self._scale_policy(tier)
+        if pol is None:
+            return
+        ts = self._tier_scale[tier]
+        if ts["cooldown"] > 0:
+            ts["cooldown"] -= 1
+            return
+        live = self._tier_live(tier)
+        if not live:
+            return
+        pend = (len(self._pending) if tier == "prefill"
+                else len(self._streams))
+        queue = (pend + sum(
+            r.engine.queue_depth() for r in live)) / len(live)
+        occupancy = max(
+            1.0 - r.engine.pool_free_blocks()
+            / max(1, r.engine.allocator.num_blocks) for r in live)
+        hot = queue >= pol.queue_high or occupancy >= pol.occupancy_high
+        cold = queue <= pol.queue_low and occupancy < pol.occupancy_high
+        if hot:
+            ts["up"] += 1
+            ts["down"] = 0
+            if ts["up"] >= pol.hysteresis_steps:
+                self.scale_up(f"obs:{tier}:queue={queue:.1f}"
+                              f",occ={occupancy:.2f}", tier=tier)
+        elif cold:
+            ts["down"] += 1
+            ts["up"] = 0
+            if ts["down"] >= pol.hysteresis_steps:
+                self.scale_down(f"obs:{tier}:queue={queue:.1f}"
+                                f",occ={occupancy:.2f}", tier=tier)
+        else:
+            ts["up"] = ts["down"] = 0
 
     # -- stats -------------------------------------------------------------
 
@@ -1148,7 +1455,9 @@ class ReplicaRouter:
         if self._chaos is not None and not self._draining:
             burst, _ = self._chaos.consult("scale", "fleet")
             if burst == "scale_burst":
-                self.scale_up("chaos_burst")
+                self.scale_up("chaos_burst",
+                              tier=("prefill" if self._fabric is not None
+                                    else None))
         with get_tracer().span("router/place"):
             activity = self._place_pending()
         for rep in list(self.replicas):
@@ -1188,6 +1497,11 @@ class ReplicaRouter:
                 rep.ok_steps += 1
                 if rep.ok_steps >= self.cfg.probation_ok_steps:
                     rep.state = "up"
+        if self._fabric is not None:
+            activity += self._pump_streams()
+            for rep in list(self.replicas):
+                if rep.live and rep.tier == "prefill" and rep.assigned:
+                    activity += self._begin_handoffs(rep)
         if self.slo is not None:
             live_frac = (len(self.live_replicas())
                          / max(1, len(self.replicas)))
@@ -1241,16 +1555,33 @@ class ReplicaRouter:
                          field="pool_free_blocks").set(
                              rep.engine.pool_free_blocks())
 
+    def _idle_gap(self) -> float:
+        """Seconds until the next externally-scheduled event (a pending
+        arrival/backoff, a link delivery, or a stream's retransmit/ACK
+        timer). 0.0 when something is due now or nothing is scheduled."""
+        now = self._now()
+        gaps = [max(r.arrival_time, r.next_try) - now
+                for r in self._pending]
+        if self._link is not None:
+            nxt = self._link.next_deliver()
+            if nxt is not None:
+                gaps.append(nxt - now)
+        for ent in self._streams.values():
+            t = ent["tr"].next_timer()
+            if t is not None:
+                gaps.append(t - now)
+        gaps = [g for g in gaps if g > 0]
+        return min(gaps) if gaps else 0.0
+
     def run(self) -> Dict[str, RouterResult]:
         """Drive :meth:`step` until every admitted request resolves.
-        With a fake clock, waits (future arrivals, backoff) fast-forward;
-        with the real clock they sleep. Raises :class:`ServingPreempted`
-        (exit 75) if a drain was requested and has completed."""
+        With a fake clock, waits (future arrivals, backoff, in-flight
+        handoff bytes) fast-forward; with the real clock they sleep.
+        Raises :class:`ServingPreempted` (exit 75) if a drain was
+        requested and has completed."""
         while self.has_work():
             if self.step() == 0 and self.has_work():
-                gaps = [max(r.arrival_time, r.next_try) - self._now()
-                        for r in self._pending]
-                gap = min(gaps) if gaps else 0.0
+                gap = self._idle_gap()
                 if gap > 0:
                     if self._clock is not time.monotonic:
                         self._t0 -= gap  # fake clock: fast-forward
@@ -1505,4 +1836,108 @@ def elastic_chaos_drill(model_cfg, params, engine_cfg: EngineConfig,
         "aot_cache_hits": aot.hits,
         "aot_cache_misses": aot.misses,
         "max_compile_count": max(compile_counts, default=0),
+    }
+
+
+def fabric_chaos_drill(model_cfg, params, engine_cfg: EngineConfig,
+                       *, n_requests: int = 6, prompt_len: int = 8,
+                       max_new_tokens: int = 5,
+                       plan_spec: str = "",
+                       stream: Optional[StreamConfig] = None,
+                       clock: Optional[Callable[[], float]] = None,
+                       seed: int = 0) -> Dict[str, Any]:
+    """Deterministic two-host fabric drill: disaggregated prefill→decode
+    serving with the KV handoff streamed over a (faulty) DCN link
+    (tests and ``bench.py --disagg-fabric``).
+
+    Runs the request set fault-free on one colocated replica for
+    reference, then on a 1-prefill + 1-decode fabric where ``plan_spec``
+    drives the link's fault surface (``link_drop`` / ``link_corrupt`` /
+    ``link_delay`` / ``link_partition``). Reports availability, handoff
+    wire accounting (bytes, retries, compression ratio vs fp32), the
+    re-prefill fallback cost of torn streams, per-tier compile counts,
+    and bit-identity of every completed output against the reference —
+    plus the pool-leak check: every allocator must be empty when the
+    drill drains."""
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, model_cfg.vocab_size,
+                           (prompt_len,)).tolist()
+               for _ in range(n_requests)]
+    arrivals = np.cumsum(rng.exponential(0.02, n_requests))
+    aot = AotExecutableCache(None)
+    budget = n_requests * (prompt_len + max_new_tokens)
+
+    def _submit_all(router: ReplicaRouter) -> None:
+        for i, (p, at) in enumerate(zip(prompts, arrivals)):
+            router.submit(p, max_new_tokens, uid=f"req{i}",
+                          arrival_time=float(at))
+
+    ref = ReplicaRouter(model_cfg, params, engine_cfg,
+                        RouterConfig(num_replicas=1,
+                                     global_token_budget=budget),
+                        clock=clock, aot_cache=aot)
+    _submit_all(ref)
+    ref_results = ref.run()
+
+    # a slow narrow link so multi-step overlap is real under the fake
+    # clock: ~10 chunks take tens of virtual milliseconds to fly while
+    # the decode tier keeps stepping
+    scfg = stream or StreamConfig(bandwidth=50e3, latency_s=1e-3)
+    chaos = FaultPlan.parse(plan_spec) if plan_spec else None
+    router = ReplicaRouter(
+        model_cfg, params, engine_cfg,
+        RouterConfig(fabric=FabricConfig(prefill_replicas=1,
+                                         decode_replicas=1,
+                                         stream=scfg),
+                     global_token_budget=budget),
+        clock=clock, chaos=chaos, aot_cache=aot)
+    _submit_all(router)
+    while router.has_work():
+        stepped = router.step()
+        if router._clock is not time.monotonic and stepped:
+            # charge a nominal virtual step latency so the stream's
+            # timers (transit, ACK deadlines, backoff) interleave with
+            # decode steps rather than all landing at t=0
+            router._t0 -= 0.05
+        if stepped == 0 and router.has_work():
+            gap = router._idle_gap()
+            if gap > 0:
+                if router._clock is not time.monotonic:
+                    router._t0 -= gap  # fake clock: fast-forward
+                else:
+                    time.sleep(min(gap, 0.05))
+    results = router.results
+
+    completed = [r for r in results.values() if r.status == "completed"]
+    matches = all(
+        results[uid].tokens == ref_results[uid].tokens
+        for uid in ref_results
+        if results.get(uid) is not None
+        and results[uid].status == "completed")
+    tier_compiles = {"prefill": 0, "decode": 0}
+    leaked = 0
+    for rep in router.replicas:
+        if rep.engine is None:
+            continue
+        tier_compiles[rep.tier] = max(tier_compiles.get(rep.tier, 0),
+                                      rep.engine.compile_count())
+        leaked += rep.engine.allocator.num_allocated
+    d = router.stats.to_dict()
+    return {
+        "fabric_availability": d["availability"],
+        "fabric_greedy_match_ref": float(matches),
+        "fabric_completed": len(completed),
+        "fabric_admitted": d["admitted"],
+        "handoffs": d["handoffs"],
+        "handoff_aborts": d["handoff_aborts"],
+        "handoff_chunks": d["handoff_chunks"],
+        "handoff_retries": d["handoff_retries"],
+        "handoff_bytes": d["handoff_bytes"],
+        "handoff_wire_ratio": d["handoff_wire_ratio"],
+        "migrated_tokens": d["migrated_tokens"],
+        "reprefilled_tokens": d["reprefilled_tokens"],
+        "ttft_p99_ms_handoff": d["ttft_p99_ms"],
+        "prefill_compile_count": tier_compiles["prefill"],
+        "decode_compile_count": tier_compiles["decode"],
+        "pool_leak_blocks": leaked,
     }

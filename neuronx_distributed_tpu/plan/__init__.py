@@ -21,7 +21,8 @@ from .calibrate import (CalibrationResult, LinkFit, calibrate,
                         mfu_from_bench)
 from .cost import (CostBreakdown, HardwareSpec, LinkSpec, ModelSpec, Plan,
                    ServingCost, ServingPlan, ServingSpec, TrafficSpec,
-                   cold_start_s, default_hardware, memory_bytes,
+                   cold_start_s, dcn_handoff_bytes, dcn_handoff_s,
+                   default_hardware, memory_bytes,
                    param_count, serving_cost, serving_pool_blocks,
                    serving_search, serving_token_s, step_cost, step_flops,
                    tp_overlap_engagement, wire_bytes_per_element)
@@ -55,6 +56,7 @@ __all__ = [
     "CalibrationResult", "CostBreakdown", "HardwareSpec", "LinkFit",
     "LinkSpec", "ModelSpec", "Plan", "ServingCost", "ServingPlan",
     "ServingSpec", "TrafficSpec", "calibrate", "cold_start_s",
+    "dcn_handoff_bytes", "dcn_handoff_s",
     "default_hardware", "fit_alpha_beta", "fit_mfu",
     "load_bench_history", "memory_bytes", "mfu_from_bench",
     "param_count", "serving_cost", "serving_pool_blocks",

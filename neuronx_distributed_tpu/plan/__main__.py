@@ -94,6 +94,10 @@ def main(argv=None) -> int:
                     help="paged-KV block size for the serving search")
     ap.add_argument("--disaggregated", action="store_true",
                     help="search disaggregated prefill/decode configs")
+    ap.add_argument("--cross-host", action="store_true",
+                    help="rank colocated vs two-tier fabric configs "
+                         "(disagg candidates pay the DCN KV-handoff "
+                         "term)")
     ap.add_argument("--slo-ttft-p99-ms", type=float, default=None,
                     help="TTFT p99 target (ms) the serving config must "
                          "meet")
@@ -205,6 +209,7 @@ def main(argv=None) -> int:
                                slo_tpot_p99_s=tpot_tgt,
                                tp=best.tp, block_size=args.serving_block,
                                disaggregated=args.disaggregated,
+                               cross_host=args.cross_host,
                                top_k=args.top_k)
         print(f"serving plan: rate={traffic.request_rate:g} req/s, "
               f"prompt={traffic.prompt_tokens:g}, "

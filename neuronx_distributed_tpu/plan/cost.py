@@ -638,6 +638,29 @@ def serving_token_s(m: ModelSpec, hw: HardwareSpec, *, context: float = 0.0,
     return flops / (max(1, tp) * hw.flops * hw.mfu)
 
 
+def dcn_handoff_bytes(m: ModelSpec, traffic: TrafficSpec, *,
+                      wire_block: int = 256) -> float:
+    """Wire bytes of one request's prefix KV streamed prefill→decode by
+    ``inference.transport.KVStreamTransport``: 2 (K and V) x layers x
+    kv_heads x head_dim elements per cached token, shipped int8 with
+    per-block fp32 scales (the ``wire_codec`` blockwise layout — the
+    ~4x-below-fp32 "wire ratio")."""
+    elems = (2.0 * m.layers * m.kv_heads * m.head_dim_
+             * traffic.prompt_tokens)
+    return elems * wire_bytes_per_element("int8", wire_block)
+
+
+def dcn_handoff_s(m: ModelSpec, hw: HardwareSpec,
+                  traffic: TrafficSpec, *,
+                  wire_block: int = 256) -> float:
+    """Mean wall time of one cross-host KV handoff over the DCN link:
+    compressed payload over bandwidth plus one ``hw.dcn.latency`` hop
+    per chunk (a K and a V chunk per layer, plus the ticket header)."""
+    n_chunks = 2 * m.layers + 1
+    return (dcn_handoff_bytes(m, traffic, wire_block=wire_block)
+            / hw.dcn.bandwidth + n_chunks * hw.dcn.latency)
+
+
 @dataclass(frozen=True)
 class ServingCost:
     """Modeled steady-state serving behavior for one engine config under
@@ -651,6 +674,8 @@ class ServingCost:
     utilization: float       # max of token-capacity and slot pressure
     concurrency: float       # mean live decode slots (Little's law)
     saturated: bool          # offered load exceeds capacity
+    handoff_s: float = 0.0   # cross-host KV transfer (0 = colocated)
+    handoff_exposed_s: float = 0.0  # transfer not hidden under prefill
 
     def to_dict(self) -> dict:
         return {f.name: getattr(self, f.name)
@@ -660,7 +685,8 @@ class ServingCost:
 def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
                  token_budget: int, max_slots: int,
                  prefill_budget: Optional[int] = None,
-                 quantized: bool = False, tp: int = 1) -> ServingCost:
+                 quantized: bool = False, tp: int = 1,
+                 cross_host: bool = False) -> ServingCost:
     """Steady-state TTFT / TPOT / goodput of one continuous-batching
     engine (``inference.engine.ServingEngine``) under Poisson load.
 
@@ -673,7 +699,13 @@ def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
     decode rows a step can carry, and TTFT stacks an M/G/1-style
     queueing wait ``rho/(1-rho) * step_s`` on top of the prefill
     slicing delay. Saturation (``rho >= 1``) caps goodput at capacity
-    instead of diverging, so search ranking stays total."""
+    instead of diverging, so search ranking stays total.
+
+    With ``cross_host`` the prefill and decode tiers live on different
+    hosts and the KV prefix rides :func:`dcn_handoff_s` over the DCN
+    link; the stream is layer-ordered and overlaps the prefill steps
+    that produce it, so only the *exposed* remainder (transfer beyond
+    the prefill wall time) lands in TTFT."""
     t = traffic
     token_s = serving_token_s(
         m, hw, context=t.prompt_tokens + t.new_tokens / 2.0,
@@ -706,6 +738,12 @@ def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
     wait = rho_q / (1.0 - rho_q) * step_s
     ttft = wait + (prefill_steps + 1) * step_s
 
+    handoff = exposed = 0.0
+    if cross_host:
+        handoff = dcn_handoff_s(m, hw, traffic)
+        exposed = max(0.0, handoff - prefill_steps * step_s)
+        ttft += exposed
+
     if saturated:
         goodput = min(capacity_tps * (t.new_tokens
                                       / max(1e-9, tokens_per_req)),
@@ -714,7 +752,8 @@ def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
         goodput = t.request_rate * t.new_tokens
     return ServingCost(ttft_s=ttft, tpot_s=tpot, tokens_per_s=goodput,
                        step_s=step_s, utilization=rho, concurrency=conc,
-                       saturated=saturated)
+                       saturated=saturated, handoff_s=handoff,
+                       handoff_exposed_s=exposed)
 
 
 def serving_pool_blocks(m: ModelSpec, traffic: TrafficSpec, *,
@@ -748,6 +787,8 @@ class ServingPlan:
                 f"blocks={e['num_blocks']}x{e['block_size']}"]
         if e.get("disaggregated"):
             tags.append(f"disagg/pf={e['prefill_budget']}")
+        if self.router.get("fabric"):
+            tags.append("dcn")
         if e.get("prefix_sharing"):
             tags.append("prefix")
         if e.get("quantized"):
@@ -768,10 +809,17 @@ def serving_search(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
                    budgets: tuple = (4, 8, 16, 32, 64, 128, 256),
                    slots: tuple = (1, 2, 4, 8, 12, 16, 24, 32),
                    disaggregated: bool = False,
+                   cross_host: bool = False,
                    top_k: int = 5) -> list:
     """Enumerate (token_budget, max_slots[, prefill_budget]) engine
     configs for the stated traffic and SLO, score each with
     :func:`serving_cost`, and return the top candidates.
+
+    ``cross_host`` enumerates *both* colocated and two-tier fabric
+    candidates; fabric candidates pay the :func:`dcn_handoff_s` term
+    (exposed remainder only — the stream overlaps prefill) and carry a
+    ``router["fabric"]`` hint, so the ranking itself answers
+    disagg-vs-colocated for the stated traffic mix.
 
     Ranking: SLO-feasible before infeasible, unsaturated before
     saturated, then highest goodput; among configs within 2% of the best
@@ -794,12 +842,19 @@ def serving_search(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
                                kv_bytes=1 if quantized else 2)
             if _kv_pool_bytes(m, spec, tp) > hw.memory_budget:
                 continue
-            pf_opts = ([None] if not disaggregated
-                       else [max(ms, budget // 4)])
+            if cross_host:
+                # both topologies compete in one ranking
+                pf_opts = [None, max(ms, budget // 4)]
+            elif disaggregated:
+                pf_opts = [max(ms, budget // 4)]
+            else:
+                pf_opts = [None]
             for pf in pf_opts:
+                fabric = cross_host and pf is not None
                 cost = serving_cost(m, hw, traffic, token_budget=budget,
                                     max_slots=ms, prefill_budget=pf,
-                                    quantized=quantized, tp=tp)
+                                    quantized=quantized, tp=tp,
+                                    cross_host=fabric)
                 meets = (cost.ttft_s * TTFT_P99_OVER_MEAN <= slo_ttft_p99_s
                          and cost.tpot_s * TPOT_P99_OVER_MEAN
                          <= slo_tpot_p99_s
@@ -824,6 +879,9 @@ def serving_search(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
                         or math.isfinite(slo_tpot_p99_s):
                     router["slo"] = {k: v for k, v in slo.items()
                                      if math.isfinite(v)}
+                if fabric:
+                    router["fabric"] = {"prefill_replicas": 1,
+                                        "decode_replicas": 1}
                 cands.append(ServingPlan(engine=engine, router=router,
                                          cost=cost, meets_slo=meets,
                                          slo=slo))

@@ -39,6 +39,23 @@ name):
   ``preempt``: corruption is injected by the caller, never raised. See
   ``resilience/integrity.py`` and ``bench.py --sdc``.
 
+Link fault kinds (the DCN handoff fabric of ``inference/transport.py``,
+where ``op`` is ``"link"`` and ``path`` is the route, e.g. ``p0->d0``;
+all consult-only — the :class:`~..inference.transport.DcnLink` carrier
+enacts them on the chunk in transit):
+
+* ``link_drop`` — the chunk vanishes in transit (never delivered); the
+  sender heals it through ACK-timeout retransmission.
+* ``link_corrupt`` — one bit of the chunk payload flips in transit
+  (``bit=<n>`` or drawn from the plan RNG); the receiver's fingerprint
+  check NACKs it and the sender retransmits.
+* ``link_delay`` — the chunk arrives ``latency=<s>`` late (virtual time;
+  out-of-order arrival at the receiver, duplicate retransmits possible).
+* ``link_partition`` — the link goes down for ``latency=<s>`` seconds
+  (indefinitely when unset): in-flight chunks are lost and later sends
+  die silently, so the sender's bounded retransmit budget exhausts, the
+  stream aborts, and the router falls back to local re-prefill.
+
 The router consults the plan through :meth:`FaultPlan.consult`, which
 *returns* the directive instead of raising/sleeping, so injected latency is
 virtual (deterministic under fake clocks) and the caller decides how a
@@ -94,14 +111,15 @@ class FaultRule:
     op: str = "*"
     path: str = "*"
     kind: str = "transient"  # transient|permanent|latency|crash|exhaust
-    prob: float = 1.0        # |preempt|scale_burst|bitflip
+    prob: float = 1.0        # |preempt|scale_burst|bitflip|link_*
     after: int = 0
     times: int = -1
     latency_s: float = 0.0
     bit: int = -1            # bitflip position; -1 = draw from plan RNG
 
     _KINDS = ("transient", "permanent", "latency", "crash", "exhaust",
-              "preempt", "scale_burst", "bitflip")
+              "preempt", "scale_burst", "bitflip",
+              "link_drop", "link_corrupt", "link_delay", "link_partition")
 
     def __post_init__(self) -> None:
         if self.kind not in self._KINDS:
@@ -208,9 +226,14 @@ class FaultPlan:
                     latency_s = max(latency_s, rule.latency_s)
                 elif kind is None:
                     kind = rule.kind
-                    if rule.kind == "bitflip":
+                    if rule.kind in ("bitflip", "link_corrupt"):
                         detail["bit"] = (rule.bit if rule.bit >= 0
                                          else self._rng.getrandbits(20))
+                    if rule.kind in ("link_delay", "link_partition"):
+                        # the rule's latency payload rides in detail: a
+                        # delay's added transit time / a partition's
+                        # healing window (0 = partitioned indefinitely)
+                        detail["latency_s"] = rule.latency_s
         return kind, latency_s, detail
 
     def consult(self, op: str, path: str) -> Tuple[Optional[str], float]:
@@ -258,10 +281,11 @@ class FaultPlan:
 
             raise CacheExhaustedError(
                 f"chaos: injected pool-exhaustion storm on {op}({path!r})")
-        # preempt / scale_burst / bitflip are consult-only directives:
-        # they model orchestrator signals (eviction notice, load spike)
-        # or in-band corruption the caller must inject itself, not
-        # storage failures, so apply() has nothing to raise for them.
+        # preempt / scale_burst / bitflip and the link_* kinds are
+        # consult-only directives: they model orchestrator signals
+        # (eviction notice, load spike) or in-band transit faults the
+        # caller must enact itself (the DcnLink carrier), not storage
+        # failures, so apply() has nothing to raise for them.
 
 
 class ChaosCheckpointStorage(BaseCheckpointStorage):

@@ -1,0 +1,49 @@
+"""Fixture: chunk transport handling that voids the bounded-retransmit
+contract.
+
+A ``while True`` retransmit loop floods the link with no attempt cap and
+no backoff, and a broad except swallows chunk send/recv failures — the
+anti-patterns ``KVStreamTransport``'s ``max_chunk_attempts`` + NACK +
+exponential-backoff machinery exists to prevent.
+"""
+
+
+def flood_until_acked(link, route, chunk):
+    while True:                           # no cap, no pacing: floods
+        link.send(route, chunk.wire, 0.0)
+        if chunk.acked:
+            return
+
+
+def quiet_pump(link, stream, now):
+    try:
+        data = link.recv(now)
+    except Exception:                     # swallows ChunkError et al.
+        return None
+    try:
+        stream.send(data, now)
+    except:                               # bare: corrupt chunk vanishes
+        pass
+
+
+def fine_bounded_retransmit(link, route, chunk, cfg, clock):
+    # capped attempts + exponential backoff does NOT fire
+    for attempt in range(cfg.max_chunk_attempts):
+        try:
+            link.send(route, chunk.wire, clock())
+            return True
+        except link.ChunkError:
+            clock.backoff_sleep(cfg.backoff_base_s * 2 ** attempt)
+    raise RuntimeError("retransmit budget exhausted")
+
+
+def fine_attempt_counter(link, route, chunk, cfg):
+    # an attempt counter is a termination signal the rule trusts
+    attempts = 0
+    while True:
+        attempts += 1
+        if attempts > cfg.max_chunk_attempts:
+            raise RuntimeError("retransmit budget exhausted")
+        link.send(route, chunk.wire, 0.0)
+        if chunk.acked:
+            return

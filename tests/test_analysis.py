@@ -285,6 +285,20 @@ def test_serving_resilience_scoped_to_inference_paths():
     assert [f.rule for f in flagged] == ["serving-resilience"]
 
 
+def test_transport_retry_fires_on_fixture():
+    fs = _lint(os.path.join("inference", "bad_transport_retry.py"))
+    assert _rules(fs) == {"serving-resilience"}
+    msgs = " | ".join(f.message for f in fs if not f.suppressed)
+    assert "unbounded retransmit" in msgs
+    assert "max_chunk_attempts" in msgs
+    assert ".recv(...)" in msgs and ".send(...)" in msgs
+    assert "ChunkIntegrityError" in msgs
+    # exactly three findings: flood loop + two swallowed handlers — the
+    # capped/backed-off and attempt-counter forms stay quiet
+    assert len([f for f in fs if not f.suppressed]) == 3
+    assert not any(f.line > 27 for f in fs if not f.suppressed)
+
+
 def test_elasticity_fires_on_fixture():
     fs = _lint(os.path.join("inference", "bad_elasticity.py"))
     assert _rules(fs) == {"elasticity"}
