@@ -137,7 +137,7 @@ import jax.numpy as jnp  # noqa: E402
 def main(chaos_spec=None, serving=False, overlap=False, router=False,
          prefix_heavy=False, plan_mode=False, obs_mode=False,
          elastic=False, sdc=False, moe=False, lint_mode=False,
-         disagg_fabric=False):
+         disagg_fabric=False, speculative=False):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -294,6 +294,21 @@ def main(chaos_spec=None, serving=False, overlap=False, router=False,
 
             traceback.print_exc()
             print(f"bench: router metric failed: {e!r}", file=sys.stderr)
+
+    # speculative-decoding drill (docs/serving.md "Speculative
+    # decoding"): opt-in via --speculative; ragged Poisson arrivals
+    # served spec-on (self-draft = accept ceiling) vs spec-off on the
+    # same engine config; decode tokens/s ratio, mean accept length,
+    # greedy match rate
+    if speculative:
+        try:
+            aux.update(speculative_metric(platform))
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: speculative metric failed: {e!r}",
+                  file=sys.stderr)
 
     # elastic-fleet drill (docs/serving.md "Elastic fleet"): opt-in via
     # --elastic; the full scale cycle (preempt -> live session migration,
@@ -848,6 +863,137 @@ def serving_metric(platform: str) -> dict:
         f"serving_pool_occupancy_{tag}": {
             "value": round(rep["pool_occupancy_mean"], 4), "unit": "frac",
             "vs_baseline": 1.0},
+    }
+
+
+def speculative_metric(platform: str) -> dict:
+    """Speculative-decoding serving drill (docs/serving.md).
+
+    The same ragged Poisson-arrival workload is served twice on one
+    engine config — speculation off (one token per slot per step) and
+    speculation on with an EARLY-EXIT draft: the target's residual tail
+    (every layer past the first ``draft_layers``) has its o_proj /
+    down_proj contributions zeroed, so the full-depth target computes
+    bit-identically to its shallow prefix and the cheap draft's greedy
+    choices are always ratified — the accept-rate ceiling with a draft
+    that is genuinely cheaper than the target (the LayerSkip /
+    self-speculative construction). Reports the decode tokens/s ratio
+    (acceptance criterion: >=1.5x at this accept rate), the measured
+    mean accept length, and the greedy match rate (fraction of requests
+    whose token streams are bit-identical between the two runs — must
+    be 1.0: speculation is an execution strategy, not an
+    approximation)."""
+    import dataclasses as _dc
+
+    import numpy as np
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference.engine import (EngineConfig,
+                                                          EngineStats,
+                                                          ServingEngine)
+    from neuronx_distributed_tpu.inference.speculative import (
+        SpeculationConfig)
+    from neuronx_distributed_tpu.models import llama
+    from neuronx_distributed_tpu.parallel import mesh as ps
+
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel()
+    if platform == "cpu":
+        cfg = llama.LlamaConfig(
+            vocab_size=1024, hidden_size=256, intermediate_size=704,
+            num_layers=12, num_heads=8, num_kv_heads=8, max_seq_len=512)
+        n_req, max_slots, budget = 8, 4, 16
+        plen_range, new_range = (4, 17), (24, 49)
+        block_size, num_blocks, spec_k = 8, 192, 6
+        draft_layers = 2
+    else:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=16, num_heads=8, num_kv_heads=8, max_seq_len=4096)
+        n_req, max_slots, budget = 16, 8, 64
+        plen_range, new_range = (16, 65), (48, 129)
+        block_size, num_blocks, spec_k = 16, 768, 6
+        draft_layers = 2
+    params = meta.unbox(llama.LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    # early-exit surgery: layers >= draft_layers contribute exactly 0.0
+    # to the residual stream, so target(h) == draft(h) bitwise
+    layers = params["params"]["model"]["layers"]["layer"]
+    layers["attn"]["o_proj"]["kernel"] = (
+        layers["attn"]["o_proj"]["kernel"].at[draft_layers:].set(0.0))
+    layers["mlp"]["down"]["kernel"] = (
+        layers["mlp"]["down"]["kernel"].at[draft_layers:].set(0.0))
+    draft_cfg = _dc.replace(cfg, num_layers=draft_layers)
+    draft_params = jax.tree_util.tree_map(lambda x: x, params)
+    draft_params["params"]["model"]["layers"] = {
+        "layer": jax.tree_util.tree_map(
+            lambda x: x[:draft_layers],
+            params["params"]["model"]["layers"]["layer"])}
+    rng = np.random.RandomState(0)
+    reqs = [(rng.randint(0, cfg.vocab_size,
+                         (rng.randint(*plen_range),)).tolist(),
+             int(rng.randint(*new_range))) for _ in range(n_req)]
+    # short Poisson gaps: the drill measures decode throughput, so the
+    # arrival span must not dominate the makespan
+    arrivals = np.concatenate(
+        [[0.0], rng.exponential(0.005, n_req).cumsum()[:-1]])
+
+    base = dict(block_size=block_size, num_blocks=num_blocks,
+                max_slots=max_slots,
+                max_blocks_per_seq=-(-cfg.max_seq_len // block_size),
+                token_budget=budget, kv_dtype=cfg.dtype)
+
+    def drill(ecfg, **eng_kw):
+        eng = ServingEngine(cfg, params, ecfg, **eng_kw)
+        eng.submit(reqs[0][0], reqs[0][1], uid="warm")  # compile + warm
+        eng.run()
+        eng.stats, eng.results = EngineStats(), {}
+        eng._t0 = eng._clock()
+        for i, ((p, n), at) in enumerate(zip(reqs, arrivals)):
+            eng.submit(p, n, uid=f"r{i}", arrival_time=float(at))
+        results = eng.run()
+        done = {u: r for u, r in results.items()
+                if r.status == "completed"}
+        makespan = max(r.finish_s for r in done.values())
+        tps = sum(len(r.tokens) for r in done.values()) / makespan
+        leaked = (eng.allocator.num_allocated
+                  if hasattr(eng, "allocator") else 0)
+        return eng, done, tps, leaked
+
+    eng0, done0, tps0, _ = drill(EngineConfig(**base))
+    spec = SpeculationConfig(speculation_length=spec_k)
+    eng1, done1, tps1, leaked = drill(
+        EngineConfig(speculation=spec, **base),
+        draft_cfg=draft_cfg, draft_params=draft_params)
+
+    rep = eng1.stats.report()
+    match = float(np.mean([done1[u].tokens == done0[u].tokens
+                           for u in done0 if u in done1]))
+    speedup = tps1 / max(1e-9, tps0)
+    compile_ok = eng1.compile_count() == 1
+    print(f"bench: speculative drill spec-on {tps1:.1f} tok/s vs "
+          f"spec-off {tps0:.1f} tok/s ({speedup:.2f}x), accept_mean "
+          f"{rep['spec_accept_mean']:.2f}/{spec_k}, match "
+          f"{match:.2f}, compile_count==1 {compile_ok}, leaked "
+          f"{leaked} blocks", file=sys.stderr)
+    tag = f"{platform}1"
+    return {
+        f"speculative_decode_tokens_per_s_{tag}": {
+            "value": round(tps1, 2), "unit": "tokens/sec",
+            "vs_baseline": round(speedup, 3)},
+        f"speculative_speedup_{tag}": {
+            "value": round(speedup, 3), "unit": "x",
+            "vs_baseline": round(speedup / 1.5, 3)},
+        f"speculative_accept_mean_{tag}": {
+            "value": round(rep["spec_accept_mean"], 3),
+            "unit": "drafts/round",
+            "vs_baseline": round(rep["spec_accept_mean"] / spec_k, 3)},
+        f"speculative_match_rate_{tag}": {
+            "value": round(match, 4), "unit": "frac",
+            "vs_baseline": round(match, 4)},
+        f"speculative_leaked_blocks_{tag}": {
+            "value": int(leaked), "unit": "blocks",
+            "vs_baseline": 1.0 if leaked == 0 else 0.0},
     }
 
 
@@ -2206,6 +2352,12 @@ if __name__ == "__main__":
              "engine vs static batched generate under a ragged Poisson "
              "arrival workload; docs/serving.md)")
     _p.add_argument(
+        "--speculative", action="store_true",
+        help="also run the speculative-decoding drill (ragged Poisson "
+             "arrivals served spec-on vs spec-off on one engine config; "
+             "reports decode tokens/s speedup, mean accept length, and "
+             "greedy match rate; docs/serving.md)")
+    _p.add_argument(
         "--router", action="store_true",
         help="also run the multi-replica failover drill (chaos plan kills "
              "a replica mid-decode; reports availability, failovers, and "
@@ -2276,4 +2428,5 @@ if __name__ == "__main__":
          prefix_heavy=_args.prefix_heavy, plan_mode=_args.plan,
          obs_mode=_args.obs, elastic=_args.elastic, sdc=_args.sdc,
          moe=_args.moe, lint_mode=_args.lint,
-         disagg_fabric=_args.disagg_fabric)
+         disagg_fabric=_args.disagg_fabric,
+         speculative=_args.speculative)

@@ -149,6 +149,7 @@ def _ensure_rules_loaded() -> None:
                    rules_resilience,  # noqa: F401
                    rules_serving_resilience,  # noqa: F401
                    rules_slo,  # noqa: F401
+                   rules_speculation,  # noqa: F401
                    rules_tp_overlap,  # noqa: F401
                    rules_trace_safety)  # noqa: F401
 

@@ -1,0 +1,199 @@
+"""Rule ``speculation-trace``: fixed-shape speculation stays fixed-shape.
+
+The speculative-decoding integration (docs/serving.md "Speculative
+decoding") holds the engine's one-executable invariant only because every
+accept-rate-dependent decision is made with fixed-shape device arithmetic
+(``jnp.where`` masks over all ``B * (k + 1)`` tree rows) and the round's
+verdict crosses to the host exactly once, as one batched fetch. Two code
+shapes quietly break that:
+
+* **Python control flow over a traced accept value.** ``if accepted > 2:``
+  or ``for _ in range(accept_len):`` inside a draft/verify function makes
+  the *trace* depend on the accept mask — under ``jit`` it either raises a
+  ``TracerBoolConversionError`` or, worse, silently specializes and
+  recompiles per accept pattern, destroying ``compile_count() == 1``
+  across accept-rate swings. The fix is a mask (``jnp.where``,
+  ``lax.select``) or an explicit host conversion (``int(...)``) at the
+  round boundary.
+
+* **A host sync inside the speculation round.** ``np.asarray`` /
+  ``jax.device_get`` / ``.block_until_ready()`` / ``.item()`` inside a
+  round function serializes draft, verify, and bookkeeping — the
+  round-trip per draft token that tree verification exists to avoid. The
+  engine fetches ``(emit, accept_len, best_branch)`` once per round, in
+  ``step()``, outside the round helpers.
+
+Scope: functions whose name smells speculative (``spec``/``draft``/
+``verify``/``medusa``) for the control-flow check, and round-named
+functions for the host-sync check, in ``inference/`` paths. Names
+assigned from ``int(...)``/``float(...)``/``bool(...)`` in the same
+function are treated as host scalars and exempt — the wrapper is exactly
+the documented conversion point.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Set
+
+from .core import Finding, LintContext, register
+
+#: value names that read as an accept/reject verdict of a verify pass
+_ACCEPT_RE = re.compile(
+    r"(^|_)(accept(ed|s)?|accepts?_len|alen|reject(ed|s)?|best_node|"
+    r"bstar)($|_)")
+
+#: function names in speculation's blast radius (control-flow check)
+_SPEC_FN_RE = re.compile(r"spec|draft|verify|medusa", re.IGNORECASE)
+
+#: function names that ARE the speculation round (host-sync check)
+_ROUND_FN_RE = re.compile(r"(^|_)round", re.IGNORECASE)
+
+_HOST_CASTS = ("int", "float", "bool")
+
+#: calls that force a device->host transfer mid-round
+_SYNC_FUNCS = ("asarray", "array", "device_get", "block_until_ready",
+               "item")
+
+
+def _tail(node: ast.AST):
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _accept_names(expr: ast.AST, casted: Set[int]) -> List[str]:
+    """Accept-named values referenced by ``expr`` that are not wrapped
+    in a host cast (``int(...)`` etc., collected in ``casted``)."""
+    out: List[str] = []
+    for node in ast.walk(expr):
+        for cand in (_tail(node),
+                     _tail(node.value)
+                     if isinstance(node, ast.Subscript) else None):
+            if (cand and _ACCEPT_RE.search(cand)
+                    and id(node) not in casted):
+                out.append(cand)
+                break
+    return out
+
+
+def _casted_nodes(expr: ast.AST) -> Set[int]:
+    """ids of every node living inside an int()/float()/bool() call."""
+    out: Set[int] = set()
+    for node in ast.walk(expr):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in _HOST_CASTS):
+            out.update(id(n) for a in node.args for n in ast.walk(a))
+    return out
+
+
+def _host_assigned(fn: ast.AST) -> Set[str]:
+    """Names bound from a host cast anywhere in the function — these are
+    Python scalars, so branching on them is trace-safe."""
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id in _HOST_CASTS):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+def _sync_call(call: ast.Call):
+    """The offending name when ``call`` is a mid-round host sync."""
+    f = call.func
+    name = _tail(f)
+    if name not in _SYNC_FUNCS:
+        return None
+    if name in ("asarray", "array"):
+        # only np.asarray/np.array/numpy.* — a bare asarray() is ambiguous
+        base = _tail(f.value) if isinstance(f, ast.Attribute) else None
+        if base not in ("np", "numpy"):
+            return None
+        return f"{base}.{name}"
+    if name == "device_get":
+        base = _tail(f.value) if isinstance(f, ast.Attribute) else None
+        if base not in ("jax",):
+            return None
+        return "jax.device_get"
+    if name in ("block_until_ready", "item"):
+        # method spelling: x.block_until_ready() / x.item()
+        if isinstance(f, ast.Attribute):
+            return f".{name}()"
+    return None
+
+
+@register(
+    "speculation-trace",
+    "Python control flow over a traced accept value in a speculation "
+    "function (branch count depends on the accept mask: recompile "
+    "hazard under the fixed-shape step), or a host sync inside the "
+    "speculation round (serializes the round tree verification exists "
+    "to batch) — use jnp.where masks, and fetch the verdict once at "
+    "the round boundary",
+    scope=("inference",))
+def check(ctx: LintContext) -> Iterator[Finding]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        spec_fn = _SPEC_FN_RE.search(fn.name) is not None
+        round_fn = _ROUND_FN_RE.search(fn.name) is not None
+        if not spec_fn and not round_fn:
+            continue
+        host_names = _host_assigned(fn)
+        for node in ast.walk(fn):
+            if spec_fn and isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                casted = _casted_nodes(node.test)
+                hits = [n for n in _accept_names(node.test, casted)
+                        if n not in host_names]
+                if hits:
+                    yield Finding(
+                        ctx.path, node.lineno, node.col_offset,
+                        "speculation-trace",
+                        f"branching on accept value `{hits[0]}` in "
+                        f"`{fn.name}` — under jit the branch count "
+                        "depends on the traced accept mask, so the "
+                        "executable specializes per accept pattern and "
+                        "compile_count()==1 dies on the first "
+                        "accept-rate swing; keep the shape fixed with "
+                        "jnp.where/lax.select over all tree rows, or "
+                        "host-convert once with int(...) at the round "
+                        "boundary")
+            if spec_fn and isinstance(node, ast.For):
+                it = node.iter
+                if (isinstance(it, ast.Call)
+                        and isinstance(it.func, ast.Name)
+                        and it.func.id == "range"):
+                    casted = _casted_nodes(it)
+                    hits = [n for n in _accept_names(it, casted)
+                            if n not in host_names]
+                    if hits:
+                        yield Finding(
+                            ctx.path, node.lineno, node.col_offset,
+                            "speculation-trace",
+                            f"loop trip count from accept value "
+                            f"`{hits[0]}` in `{fn.name}` — a "
+                            "range() over a traced accept length "
+                            "unrolls differently per accept pattern "
+                            "(recompile hazard); mask the fixed "
+                            "k+1-row window instead")
+            if round_fn and isinstance(node, ast.Call):
+                sync = _sync_call(node)
+                if sync is not None:
+                    yield Finding(
+                        ctx.path, node.lineno, node.col_offset,
+                        "speculation-trace",
+                        f"host sync `{sync}` inside speculation round "
+                        f"`{fn.name}` — the round's verdict must cross "
+                        "to the host exactly once (one batched fetch "
+                        "after verify); a sync inside the round "
+                        "serializes draft/verify/bookkeeping into the "
+                        "per-token round-trip speculation exists to "
+                        "amortize")

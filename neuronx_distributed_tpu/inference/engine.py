@@ -49,9 +49,12 @@ from .aot_cache import AotExecutableCache, AotWorker, source_fingerprint
 from .kv_cache import PAD_POSITION
 from .paging import (PAYLOAD_BLOCK_AXES, BlockAllocator, CacheExhaustedError,
                      PrefixCache, cow_copy_blocks, extract_blocks,
-                     init_paged_kv_cache, init_quantized_paged_kv_cache,
-                     inject_blocks)
+                     flat_write_indices, init_paged_kv_cache,
+                     init_quantized_paged_kv_cache, inject_blocks,
+                     mask_pool_positions)
 from .sampling import SamplingConfig, sample
+from .speculative import (SpeculationConfig, branch_of_nodes,
+                          build_medusa_tree, medusa_accept_longest)
 
 
 @jax.jit
@@ -95,6 +98,15 @@ class EngineConfig:
     # or token_budget) handing KV off through the shared pool.
     disaggregated: bool = False
     prefill_budget: Optional[int] = None
+    # speculative decoding: draft branches propose k tokens per slot per
+    # round into COW lane clones of the slot's blocks; one target forward
+    # tree-verifies every branch; rejected branches free atomically. The
+    # packed worker, the draft worker and the verify worker each see one
+    # fixed shape, so speculation keeps compile_count()==1 whatever the
+    # accept rate does. Requires greedy sampling; incompatible with
+    # disaggregated (speculation is a decode-side feature of the packed
+    # step).
+    speculation: Optional[SpeculationConfig] = None
     # SDC defense on the migration path: export_session fingerprints the
     # shipped KV blocks (host-side int32 bit-folds over the extracted
     # payload) and import_session verifies them before touching the pool.
@@ -148,6 +160,9 @@ class _RequestState:
     chain: Optional[int] = None     # trie chain hash for continued insert
     trie_blocks: int = 0            # prompt blocks walked/inserted so far
     trie_dead: bool = False         # stop inserting (collision/eviction)
+    spec_rounds: int = 0            # speculation rounds this request ran
+    spec_accepted: int = 0          # draft tokens accepted across rounds
+    spec_ok: bool = True            # False: draft KV cold (imported KV)
 
     @property
     def prompt_len(self) -> int:
@@ -172,6 +187,10 @@ class _RequestState:
         self.chain = None
         self.trie_blocks = 0
         self.trie_dead = False
+        self.spec_rounds = 0
+        self.spec_accepted = 0
+        # a restart re-prefills, which re-warms the draft pool too
+        self.spec_ok = True
 
 
 #: SessionTicket wire format magic — same shape as the AOT cache's
@@ -370,6 +389,8 @@ class RequestResult:
     ttft_s: Optional[float] = None
     finish_s: Optional[float] = None
     tpot_s: Optional[float] = None  # mean time per token after the first
+    accept_rate: Optional[float] = None  # accepted/offered draft tokens
+                                         # (None: never speculated)
 
 
 @dataclasses.dataclass
@@ -388,6 +409,8 @@ class EngineStats:
     migrated_out: int = 0           # sessions shipped via export_session
     migrated_tokens: int = 0        # cached tokens landed without prefill
     integrity_rejects: int = 0      # tickets refused: KV fingerprint bad
+    spec_rounds: int = 0            # (slot, round) speculation attempts
+    spec_accepted_tokens: int = 0   # draft tokens accepted by the target
     ttft_s: List[float] = dataclasses.field(default_factory=list)
     step_latency_s: List[float] = dataclasses.field(default_factory=list)
     occupancy: List[float] = dataclasses.field(default_factory=list)
@@ -420,6 +443,9 @@ class EngineStats:
             "shared_block_fraction": (float(np.mean(self.shared_fraction))
                                       if self.shared_fraction else 0.0),
             "cow_copies": self.cow_copies,
+            "spec_rounds": self.spec_rounds,
+            "spec_accept_mean": (self.spec_accepted_tokens
+                                 / max(1, self.spec_rounds)),
         }
 
     def to_dict(self) -> Dict[str, float]:
@@ -433,6 +459,7 @@ class EngineStats:
         d["migrated_in"] = self.migrated_in
         d["migrated_out"] = self.migrated_out
         d["migrated_tokens"] = self.migrated_tokens
+        d["spec_accepted_tokens"] = self.spec_accepted_tokens
         return d
 
 
@@ -446,7 +473,9 @@ class ServingEngine:
                  clock: Optional[Callable[[], float]] = None,
                  aot_cache: Optional[AotExecutableCache] = None,
                  name: Optional[str] = None,
-                 forward_fn: Optional[Callable] = None):
+                 forward_fn: Optional[Callable] = None,
+                 draft_cfg: Optional[LlamaConfig] = None,
+                 draft_params=None):
         self.model_cfg = model_cfg
         self.params = params
         self.ecfg = engine_cfg
@@ -475,8 +504,53 @@ class ServingEngine:
         self._queue: Deque[_RequestState] = deque()
         self._slots: List[Optional[_RequestState]] = (
             [None] * engine_cfg.max_slots)
+        # speculative decoding: the draft model defaults to the target
+        # itself (self-draft — the mechanical-ceiling configuration the
+        # drills use; a real deployment passes a small draft_cfg/params).
+        # Lanes: S speculating slots x B branches each get their own
+        # block-table row past max_slots, so draft/verify rows route into
+        # per-branch COW clones while every non-speculating slot is
+        # untouched.
+        spec = engine_cfg.speculation
+        self._spec = spec
+        self._spec_on = bool(spec.start_on) if spec else False
+        if spec is not None:
+            if not engine_cfg.sampling.greedy:
+                raise ValueError(
+                    "speculation requires greedy sampling (the accept "
+                    "rule compares the target's greedy choice)")
+            if engine_cfg.disaggregated:
+                raise ValueError(
+                    "speculation runs inside the packed worker; "
+                    "disaggregated prefill/decode is not supported")
+            self._draft_cfg = draft_cfg or model_cfg
+            self._draft_params = (draft_params if draft_params is not None
+                                  else params)
+            if draft_cfg is None:
+                self._draft_forward_fn = forward_fn
+            else:
+                from ..models.mixtral import (MixtralConfig,
+                                              mixtral_forward_with_cache)
+
+                self._draft_forward_fn = (
+                    mixtral_forward_with_cache
+                    if isinstance(draft_cfg, MixtralConfig)
+                    else llama_forward_with_cache)
+            k, nb = spec.speculation_length, spec.num_branches
+            self._spec_slots = spec.max_spec_slots or min(
+                engine_cfg.max_slots,
+                max(1, engine_cfg.token_budget // (nb * (k + 1))))
+            self._table_rows = (engine_cfg.max_slots
+                                + self._spec_slots * nb)
+            self._spec_buffers = build_medusa_tree(spec.tree_choices())
+            self._spec_branch_of = branch_of_nodes(spec)
+        else:
+            self._draft_cfg = None
+            self._draft_params = None
+            self._spec_slots = 0
+            self._table_rows = engine_cfg.max_slots
         self._tables = np.full(
-            (engine_cfg.max_slots, engine_cfg.max_blocks_per_seq), -1,
+            (self._table_rows, engine_cfg.max_blocks_per_seq), -1,
             np.int32)
         self._slot_blocks: List[List[int]] = (
             [[] for _ in range(engine_cfg.max_slots)])
@@ -492,6 +566,7 @@ class ServingEngine:
             PrefixCache(self.allocator, engine_cfg.block_size)
             if engine_cfg.prefix_sharing else None)
         self.cache = self._init_cache()
+        self.dcache = self._init_draft_cache()
         if engine_cfg.disaggregated:
             # two workers, two jit/AOT instances: each sees exactly one
             # input shape, so each compiles exactly once
@@ -508,6 +583,12 @@ class ServingEngine:
                 "packed", engine_cfg.token_budget)
             self._prefill_fn = self._decode_fn = None
             workers = {"packed": self._step_fn}
+        self._spec_draft_fn = self._spec_verify_fn = None
+        if spec is not None:
+            self._spec_draft_fn = self._build_worker("spec_draft", 0)
+            self._spec_verify_fn = self._build_worker("spec_verify", 0)
+            workers["spec_draft"] = self._spec_draft_fn
+            workers["spec_verify"] = self._spec_verify_fn
         # observability: per-worker compile trackers (any compile beyond
         # the first alerts through the event channel — the no-recompile
         # invariant made observable) + phase spans in step(). All of it
@@ -528,14 +609,16 @@ class ServingEngine:
 
     def _init_cache(self):
         e, m = self.ecfg, self.model_cfg
+        # speculation widens the table with lane rows; the pool itself
+        # (num_blocks) is unchanged — lanes borrow blocks per round
         if e.quantized:
             cache = init_quantized_paged_kv_cache(
                 m.num_layers, e.num_blocks, e.block_size, m.num_kv_heads,
-                m.head_dim_, e.max_slots, e.max_blocks_per_seq)
+                m.head_dim_, self._table_rows, e.max_blocks_per_seq)
         else:
             cache = init_paged_kv_cache(
                 m.num_layers, e.num_blocks, e.block_size, m.num_kv_heads,
-                m.head_dim_, e.max_slots, e.max_blocks_per_seq,
+                m.head_dim_, self._table_rows, e.max_blocks_per_seq,
                 dtype=e.kv_dtype or m.dtype)
         # commit to the sharding the jitted step will leave its outputs
         # on (replicated over the active mesh, else the default device):
@@ -552,21 +635,183 @@ class ServingEngine:
         self._sharding = sharding
         return jax.device_put(cache, sharding)
 
+    def _init_draft_cache(self):
+        """The draft model's own pool, mirroring the target pool's block
+        geometry exactly (same num_blocks / block_size / tables): block
+        ids, COW clones, frees and the stale-position wipe apply to both
+        pools in lockstep, so one host allocator governs both."""
+        if self._spec is None:
+            return None
+        e, d = self.ecfg, self._draft_cfg
+        if e.quantized:
+            dc = init_quantized_paged_kv_cache(
+                d.num_layers, e.num_blocks, e.block_size, d.num_kv_heads,
+                d.head_dim_, self._table_rows, e.max_blocks_per_seq)
+        else:
+            dc = init_paged_kv_cache(
+                d.num_layers, e.num_blocks, e.block_size, d.num_kv_heads,
+                d.head_dim_, self._table_rows, e.max_blocks_per_seq,
+                dtype=e.kv_dtype or d.dtype)
+        return jax.device_put(dc, self._sharding)
+
     def _build_step(self):
         model_cfg, sampling = self.model_cfg, self.ecfg.sampling
         forward = self._forward_fn
+        # donation gives in-place pool update on TPU; CPU donation only
+        # warns, so keep it off there
+        on_accel = jax.default_backend() in ("tpu", "axon")
+        if self._spec is None:
+            def step_fn(params, cache, tokens, positions, slot_ids, rng):
+                logits, cache = forward(
+                    model_cfg, params, tokens, positions, cache,
+                    slot_ids=slot_ids)
+                toks = sample(logits[0], rng, sampling)
+                return toks, cache
 
-        def step_fn(params, cache, tokens, positions, slot_ids, rng):
+            return jax.jit(step_fn,
+                           donate_argnums=(1,) if on_accel else ())
+
+        # speculation: the packed step also runs the draft model over the
+        # same rows, so the draft pool stays warm for every token the
+        # target caches (prefill included) — the draft never re-reads
+        # context it hasn't written
+        draft_cfg = self._draft_cfg
+        draft_forward = self._draft_forward_fn
+
+        def spec_step_fn(params, draft_params, cache, dcache, tokens,
+                         positions, slot_ids, rng):
             logits, cache = forward(
                 model_cfg, params, tokens, positions, cache,
                 slot_ids=slot_ids)
+            _, dcache = draft_forward(
+                draft_cfg, draft_params, tokens, positions, dcache,
+                slot_ids=slot_ids)
             toks = sample(logits[0], rng, sampling)
-            return toks, cache
+            return toks, cache, dcache
 
-        # donation gives in-place pool update on TPU; CPU donation only
-        # warns, so keep it off there
-        donate = (1,) if jax.default_backend() in ("tpu", "axon") else ()
-        return jax.jit(step_fn, donate_argnums=donate)
+        return jax.jit(spec_step_fn,
+                       donate_argnums=(2, 3) if on_accel else ())
+
+    def _build_spec_draft(self):
+        """The draft worker: one jitted call proposes ``k`` tokens for
+        each of ``B`` branches of each speculating slot. Depth 0 writes
+        the committed token's draft K/V into every lane clone and splits
+        branches via top-B; a ``lax.scan`` walks depths 1..k. The scan
+        runs through depth ``k`` so the last drafted token's K/V lands
+        too (its own proposal is discarded) — the
+        ``speculation_length``-boundary lesson from
+        :func:`..speculative.make_speculation_round_fn`."""
+        spec, e = self._spec, self.ecfg
+        k, nb, s = spec.speculation_length, spec.num_branches, \
+            self._spec_slots
+        dcfg, forward = self._draft_cfg, self._draft_forward_fn
+        base = e.max_slots
+
+        def draft_fn(draft_params, dcache, committed, pos):
+            lanes = base + jnp.arange(s * nb, dtype=jnp.int32)
+            pos0 = jnp.repeat(pos, nb)                       # [S*B]
+            tok0 = jnp.repeat(committed, nb)
+            logits, dcache = forward(
+                dcfg, draft_params, tok0[None, :], pos0[None, :], dcache,
+                slot_ids=lanes)
+            # branch split: lane (s, b) continues from the b-th most
+            # likely draft token (rows of one slot are identical — read
+            # lane b=0's row)
+            _, top = jax.lax.top_k(logits[0], nb)            # [S*B, B]
+            d1 = top.reshape(s, nb, nb)[:, 0, :].reshape(s * nb)
+
+            def body(carry, d):
+                dc, tok = carry
+                p = jnp.where(pos0 < PAD_POSITION, pos0 + d, PAD_POSITION)
+                lg, dc = forward(dcfg, draft_params, tok[None, :],
+                                 p[None, :], dc, slot_ids=lanes)
+                nxt = jnp.argmax(lg[0], axis=-1)
+                return (dc, nxt), tok
+
+            (dcache, _), toks = jax.lax.scan(
+                body, (dcache, d1), jnp.arange(1, k + 1))
+            drafted = jnp.swapaxes(toks, 0, 1).reshape(s, nb, k)
+            return drafted, dcache
+
+        on_accel = jax.default_backend() in ("tpu", "axon")
+        return jax.jit(draft_fn, donate_argnums=(1,) if on_accel else ())
+
+    def _build_spec_verify(self):
+        """The verify worker: ONE target forward tree-attends every
+        branch of every speculating slot ([committed, d_1..d_k] per lane
+        — in-step causal attention over the lane's packed rows), accepts
+        the deepest target-consistent path via
+        :func:`..speculative.medusa_accept_longest`, and atomically
+        un-publishes every rejected row's stored position in BOTH pools
+        (one fixed-shape scatter each — the COW-lane rollback). Returns
+        per-slot ``(emit [k+1], accept_len, best_branch)``; the host
+        adopts the winner lane's blocks and frees the losers."""
+        spec, e = self._spec, self.ecfg
+        k, nb, s = spec.speculation_length, spec.num_branches, \
+            self._spec_slots
+        cfg, forward = self.model_cfg, self._forward_fn
+        buffers, branch_of = self._spec_buffers, self._spec_branch_of
+        base = e.max_slots
+        rows = s * nb * (k + 1)
+
+        def verify_fn(params, cache, dcache, committed, drafted, pos):
+            offs = jnp.arange(k + 1)
+            lane_tok = jnp.concatenate(
+                [jnp.repeat(committed, nb).reshape(s, nb, 1), drafted],
+                axis=2)                                      # [S, B, k+1]
+            lane_pos = jnp.broadcast_to(jnp.where(
+                pos[:, None, None] < PAD_POSITION,
+                pos[:, None, None] + offs[None, None, :], PAD_POSITION),
+                (s, nb, k + 1))
+            lanes = (base + jnp.arange(s * nb)).reshape(s, nb)
+            slot_ids = jnp.broadcast_to(
+                lanes[:, :, None], (s, nb, k + 1)).reshape(rows)
+            positions = lane_pos.reshape(1, rows)
+            logits, cache = forward(
+                cfg, params, lane_tok.reshape(1, rows), positions, cache,
+                slot_ids=slot_ids)
+            lg = logits[0].reshape(s, nb, k + 1, logits.shape[-1])
+            # tree node order matches SpeculationConfig.tree_choices():
+            # root, then branch-major chains — node (b, d) at 1 + b*k+d-1
+            tree_logits = jnp.concatenate(
+                [lg[:, 0, :1], lg[:, :, 1:].reshape(s, nb * k, -1)],
+                axis=1)
+            tree_tokens = jnp.concatenate(
+                [committed[:, None], drafted.reshape(s, nb * k)], axis=1)
+            best, alen = medusa_accept_longest(tree_logits, tree_tokens,
+                                               buffers)
+            bonus = jnp.take_along_axis(
+                jnp.argmax(tree_logits, axis=-1), best[:, None],
+                axis=1)[:, 0]
+            bstar = jnp.maximum(branch_of[best], 0)
+            sel = jnp.take_along_axis(
+                drafted, bstar[:, None, None], axis=1)[:, 0]  # [S, k]
+            jj = offs[None, :]
+            emit = jnp.where(jj < alen[:, None],
+                             jnp.pad(sel, ((0, 0), (0, 1))),
+                             bonus[:, None])
+            # rollback: un-publish every row outside the accepted path of
+            # the winning branch, in both pools (same tables, same flat
+            # indices — the pools share block geometry by construction)
+            brow = jnp.broadcast_to(
+                jnp.arange(nb)[None, :, None], (s, nb, k + 1))
+            keep = ((brow == bstar[:, None, None])
+                    & (offs[None, None, :] <= alen[:, None, None]))
+            tok_tables = cache.block_tables[
+                jnp.clip(slot_ids, 0, cache.max_slots - 1)]
+            flat_idx = flat_write_indices(tok_tables, positions[0],
+                                          cache.block_size,
+                                          cache.capacity)
+            reject = (~keep).reshape(rows)
+            cache = cache.replace(pos=mask_pool_positions(
+                cache.pos, flat_idx, reject))
+            dcache = dcache.replace(pos=mask_pool_positions(
+                dcache.pos, flat_idx, reject))
+            return cache, dcache, emit, alen, bstar
+
+        on_accel = jax.default_backend() in ("tpu", "axon")
+        return jax.jit(verify_fn,
+                       donate_argnums=(1, 2) if on_accel else ())
 
     def _build_worker(self, worker: str, width: int):
         """One serving worker: the jitted step, or — with an AOT cache —
@@ -575,14 +820,23 @@ class ServingEngine:
         folds all of :meth:`_worker_fingerprint` plus the packed width;
         the first replica per key compiles, every later replica (a
         scale-up, a probation revival, a restarted process with a disk
-        cache) loads the serialized executable instead."""
-        jitted = self._build_step()
+        cache) loads the serialized executable instead. The speculation
+        workers (``spec_draft``/``spec_verify``) have fixed widths of
+        their own (folded into the fingerprint via the speculation
+        config), so ``width`` is 0 for them."""
+        if worker == "spec_draft":
+            jitted = self._build_spec_draft()
+        elif worker == "spec_verify":
+            jitted = self._build_spec_verify()
+        else:
+            jitted = self._build_step()
         if self._aot is None:
             return jitted
         key = self._aot.key_for("engine-step", worker, width,
                                 *self._worker_fingerprint())
         compiled, from_cache = self._aot.compile_or_load(
-            key, jitted, self._example_args(width))
+            key, jitted, self._spec_example_args(worker)
+            if worker.startswith("spec_") else self._example_args(width))
         return AotWorker(compiled, from_cache)
 
     def _worker_fingerprint(self) -> Tuple[Any, ...]:
@@ -595,11 +849,20 @@ class ServingEngine:
             (jax.tree_util.keystr(path), tuple(x.shape), str(x.dtype))
             for path, x in jax.tree_util.tree_flatten_with_path(
                 self.params)[0])
+        spec_fp: Tuple[Any, ...] = ()
+        if self._spec is not None:
+            spec_fp = (repr(self._spec), self._spec_slots,
+                       repr(self._draft_cfg), tuple(
+                           (jax.tree_util.keystr(path), tuple(x.shape),
+                            str(x.dtype))
+                           for path, x in
+                           jax.tree_util.tree_flatten_with_path(
+                               self._draft_params)[0]))
         return (repr(self.model_cfg), e.block_size, e.num_blocks,
                 e.max_slots, e.max_blocks_per_seq, e.quantized,
                 str(e.kv_dtype), repr(e.sampling),
                 source_fingerprint(self._forward_fn, sample),
-                params_spec)
+                params_spec) + spec_fp
 
     def _example_args(self, width: int):
         """Abstract-equivalent inputs for AOT lowering: exactly the
@@ -608,8 +871,24 @@ class ServingEngine:
         tokens = jnp.zeros((1, width), jnp.int32)
         positions = jnp.full((1, width), PAD_POSITION, jnp.int32)
         slot_ids = jnp.full((width,), self.ecfg.max_slots, jnp.int32)
+        if self._spec is not None:
+            return (self.params, self._draft_params, self.cache,
+                    self.dcache, tokens, positions, slot_ids, self._rng)
         return (self.params, self.cache, tokens, positions, slot_ids,
                 self._rng)
+
+    def _spec_example_args(self, worker: str):
+        """AOT lowering inputs for the two speculation workers (all-pad
+        round — avals only)."""
+        spec, s = self._spec, self._spec_slots
+        committed = jnp.zeros((s,), jnp.int32)
+        pos = jnp.full((s,), PAD_POSITION, jnp.int32)
+        if worker == "spec_draft":
+            return (self._draft_params, self.dcache, committed, pos)
+        drafted = jnp.zeros(
+            (s, spec.num_branches, spec.speculation_length), jnp.int32)
+        return (self.params, self.cache, self.dcache, committed, drafted,
+                pos)
 
     def worker_compile_counts(self) -> Dict[str, int]:
         """Per-worker compile counts: ``{"packed": n}`` or, when
@@ -622,7 +901,11 @@ class ServingEngine:
         if self.ecfg.disaggregated:
             return {"prefill": size(self._prefill_fn),
                     "decode": size(self._decode_fn)}
-        return {"packed": size(self._step_fn)}
+        counts = {"packed": size(self._step_fn)}
+        if self._spec is not None:
+            counts["spec_draft"] = size(self._spec_draft_fn)
+            counts["spec_verify"] = size(self._spec_verify_fn)
+        return counts
 
     def compile_count(self) -> int:
         """Number of distinct compilations of the serving step (the
@@ -712,6 +995,20 @@ class ServingEngine:
         """Unallocated KV blocks in the pool (occupancy = 1 - free/total)."""
         return self.allocator.num_blocks - self.allocator.num_allocated
 
+    @property
+    def speculating(self) -> bool:
+        """Whether decode steps currently run speculation rounds."""
+        return self._spec is not None and self._spec_on
+
+    def set_speculation(self, on: bool) -> None:
+        """Toggle speculation at a step boundary (the router's SLO
+        auto-toggle hook). Toggling only changes *which* compiled workers
+        the host invokes — never any traced shape — so flapping it does
+        not recompile anything. A no-op on engines built without a
+        :class:`~.speculative.SpeculationConfig`."""
+        if self._spec is not None:
+            self._spec_on = bool(on)
+
     def prefix_lookup(self, prompt: Sequence[int]) -> int:
         """How many tokens of ``prompt`` this engine's prefix cache
         already holds (0 without ``prefix_sharing``) — the router's
@@ -766,6 +1063,8 @@ class ServingEngine:
         engine spun up without compiling anything."""
         fns = ([self._prefill_fn, self._decode_fn]
                if self.ecfg.disaggregated else [self._step_fn])
+        if self._spec is not None:
+            fns += [self._spec_draft_fn, self._spec_verify_fn]
         return all(getattr(fn, "from_cache", False) for fn in fns)
 
     def export_session(self, request_id: str) -> SessionTicket:
@@ -918,6 +1217,11 @@ class ServingEngine:
         self._admit_counter += 1
         req.admit_time = now
         req.n_cached = int(ticket.n_cached)
+        # tickets ship only the TARGET pool's KV: the draft pool has no
+        # rows for the imported context, so speculating on this request
+        # would draft from holes. It decodes normally (spec_ok flips back
+        # if it is ever preempted and re-prefilled here).
+        req.spec_ok = False
         if ticket.ttft_s is not None:
             req.first_token_time = req.arrival_time + ticket.ttft_s
         for i, blk in enumerate(blocks):
@@ -1195,14 +1499,17 @@ class ServingEngine:
         self._queue.appendleft(victim)
         self.stats.preempted += 1
 
-    def _build_schedule(self):
+    def _build_schedule(self, skip=frozenset()):
         """Pack this step's rows: (req, token, position, produce) — one
         decode row per decoding slot, then prefill chunks. Preempts
         (youngest first) when a decode row can't get its next block;
         prefill chunks merely truncate. Returns ``(decode_rows,
         prefill_rows)``: packed mode shares one ``token_budget`` across
         both lists; disaggregated mode gives each worker its own width
-        (decode = ``max_slots``, prefill = ``prefill_budget``)."""
+        (decode = ``max_slots``, prefill = ``prefill_budget``). ``skip``
+        (request ids) excludes this round's speculation participants —
+        their decode advances through the draft/verify workers instead
+        of a packed decode row."""
         e = self.ecfg
         if e.disaggregated:
             decode_budget = e.max_slots
@@ -1216,7 +1523,8 @@ class ServingEngine:
                 decode_rows = []
                 for req in sorted(
                         (s for s in self._slots
-                         if s is not None and s.decoding),
+                         if s is not None and s.decoding
+                         and id(s) not in skip),
                         key=lambda r: r.admit_seq):
                     if len(decode_rows) >= decode_budget:
                         break
@@ -1264,9 +1572,13 @@ class ServingEngine:
             keep = np.zeros((m,), np.int32)
             for i, (s, d, k) in enumerate(chunk):
                 src[i], dst[i], keep[i] = s, d, k
-            self.cache = cow_copy_blocks(
-                self.cache, jnp.asarray(src), jnp.asarray(dst),
-                jnp.asarray(keep))
+            src, dst, keep = (jnp.asarray(src), jnp.asarray(dst),
+                              jnp.asarray(keep))
+            self.cache = cow_copy_blocks(self.cache, src, dst, keep)
+            if self.dcache is not None:
+                # both pools share block ids: the same clone list keeps
+                # the draft pool's view of every block bit-consistent
+                self.dcache = cow_copy_blocks(self.dcache, src, dst, keep)
         self._pending_cow.clear()
 
     def _run_worker(self, fn, rows, width: int, rng):
@@ -1279,9 +1591,15 @@ class ServingEngine:
             tokens[0, i] = tok
             positions[0, i] = pos
             slot_ids[i] = req.slot
-        sampled, self.cache = fn(
-            self.params, self.cache, jnp.asarray(tokens),
-            jnp.asarray(positions), jnp.asarray(slot_ids), rng)
+        if self._spec is not None:
+            sampled, self.cache, self.dcache = fn(
+                self.params, self._draft_params, self.cache, self.dcache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(slot_ids), rng)
+        else:
+            sampled, self.cache = fn(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(slot_ids), rng)
         return np.asarray(sampled)
 
     def _maybe_insert_prefix(self, req: _RequestState) -> None:
@@ -1303,6 +1621,137 @@ class ServingEngine:
             req.chain = chain
             req.trie_blocks += 1
 
+    # -- speculation round lifecycle (host side) --------------------------
+
+    def _begin_spec_round(self) -> List[Optional[Tuple]]:
+        """Pick this round's speculating slots (oldest decoding first)
+        and allocate their branch lanes: each lane's table row is a copy
+        of the slot's row with every block the round will write replaced
+        by a branch-private clone (COW for live blocks, fresh allocations
+        for not-yet-mapped tail blocks). Prefix blocks below the write
+        window stay shared by reference. A slot that cannot get its lane
+        blocks simply decodes normally this step — lane allocation never
+        preempts anyone. Returns a dense list indexed by lane group
+        (``None`` = unused group)."""
+        if not self.speculating:
+            return []
+        spec, e = self._spec, self.ecfg
+        k, nb, bs = spec.speculation_length, spec.num_branches, \
+            e.block_size
+        round_state: List[Optional[Tuple]] = []
+        for req in sorted((s for s in self._slots
+                           if s is not None and s.decoding and s.spec_ok
+                           and len(s.generated) < s.max_new_tokens),
+                          key=lambda r: r.admit_seq):
+            if len(round_state) >= self._spec_slots:
+                break
+            pos = req.n_cached
+            blk0, blk_last = pos // bs, (pos + k) // bs
+            if (blk_last >= e.max_blocks_per_seq
+                    or pos + k >= self.model_cfg.max_seq_len):
+                continue        # no lane room at the table/context end
+            mapped = [(bi, int(self._tables[req.slot, bi]))
+                      for bi in range(blk0, blk_last + 1)]
+            try:
+                blocks = self._alloc_blocks(nb * len(mapped))
+            except CacheExhaustedError:
+                continue        # pool pressure: decode normally instead
+            it = iter(blocks)
+            lane_blocks: List[List[int]] = []
+            for b in range(nb):
+                lane = e.max_slots + len(round_state) * nb + b
+                self._tables[lane, :] = self._tables[req.slot, :]
+                blks = []
+                for bi, cur in mapped:
+                    dst = next(it)
+                    if cur >= 0:
+                        # branch-private clone: rows below pos are the
+                        # shared committed prefix, rows >= pos are this
+                        # lane's to write (the slot's own block stays
+                        # untouched until adoption)
+                        self._pending_cow.append((cur, dst, pos))
+                        self._freed_dirty.discard(dst)
+                        self.stats.cow_copies += 1
+                    self._tables[lane, bi] = dst
+                    blks.append(dst)
+                lane_blocks.append(blks)
+            round_state.append((req, lane_blocks, blk0, blk_last))
+        return round_state
+
+    def _filter_spec_round(self, round_state):
+        """Drop participants whose slot the scheduling pass preempted
+        after lane allocation, freeing their lanes (positions wiped
+        through the usual freed-block hygiene). Keeps ``None`` holes so
+        surviving entries stay aligned with their lane rows."""
+        out: List[Optional[Tuple]] = []
+        for entry in round_state:
+            if entry is None:
+                out.append(None)
+                continue
+            req, lane_blocks = entry[0], entry[1]
+            if req.slot is not None and self._slots[req.slot] is req:
+                out.append(entry)
+            else:
+                for blks in lane_blocks:
+                    self._freed_dirty.update(self.allocator.free(blks))
+                out.append(None)
+        return out
+
+    def _land_spec_round(self, round_state, emit, alen, bstar,
+                         now: float) -> None:
+        """Adopt each participant's verification verdict: swap the
+        winning branch's lane blocks into the slot's table, free the
+        displaced originals plus every losing branch in ONE allocator
+        call (atomic — pool accounting never observes a half-freed
+        round), append the accepted tokens + bonus, and retire on
+        EOS/max_new as usual. Device values arrive as host ints exactly
+        once per round (the single fetch in :meth:`step`)."""
+        spec, e = self._spec, self.ecfg
+        k, nb = spec.speculation_length, spec.num_branches
+        for i, entry in enumerate(round_state):
+            if entry is None:
+                continue
+            req, lane_blocks, blk0, blk_last = entry
+            a = max(0, min(int(alen[i]), k))
+            b = max(0, min(int(bstar[i]), nb - 1))
+            sb = self._slot_blocks[req.slot]
+            drop: List[int] = []
+            for j, bi in enumerate(range(blk0, blk_last + 1)):
+                old = int(self._tables[req.slot, bi])
+                if old >= 0:
+                    drop.append(old)
+                    sb.remove(old)
+                win = lane_blocks[b][j]
+                self._tables[req.slot, bi] = win
+                sb.append(win)
+            for bb in range(nb):
+                if bb != b:
+                    drop.extend(lane_blocks[bb])
+            self._freed_dirty.update(self.allocator.free(drop))
+            req.spec_rounds += 1
+            req.spec_accepted += a
+            self.stats.spec_rounds += 1
+            self.stats.spec_accepted_tokens += a
+            done = False
+            n_emit = 0
+            for tok in (int(t) for t in emit[i, :a + 1]):
+                req.generated.append(tok)
+                n_emit += 1
+                self.stats.tokens_generated += 1
+                if req.first_token_time is None:
+                    req.first_token_time = now
+                    self.stats.ttft_s.append(now - req.arrival_time)
+                if (len(req.generated) >= req.max_new_tokens
+                        or (e.eos_id is not None
+                            and tok == e.eos_id)):
+                    done = True
+                    break
+            req.n_cached += n_emit
+            if done:
+                self._retire(req, now)
+        # lane rows only route one round's writes; park them afterwards
+        self._tables[e.max_slots:, :] = -1
+
     def step(self) -> int:
         """One serving step. Returns the number of live rows packed
         (0 = nothing was runnable). Packed mode runs one fixed-shape
@@ -1312,9 +1761,13 @@ class ServingEngine:
         tracer = get_tracer()
         with tracer.span("engine/admission"):
             self._admit()
-            decode_rows, prefill_rows = self._build_schedule()
+            round_state = self._begin_spec_round()
+            decode_rows, prefill_rows = self._build_schedule(
+                {id(x[0]) for x in round_state if x is not None})
+            round_state = self._filter_spec_round(round_state)
         rows = decode_rows + prefill_rows
-        if not rows:
+        spec_live = [x for x in round_state if x is not None]
+        if not rows and not spec_live:
             return 0
         t_start = self._now()
         if self.stats.first_step_t is None:
@@ -1325,19 +1778,26 @@ class ServingEngine:
             mask = np.zeros((self.ecfg.num_blocks,), np.bool_)
             mask[list(self._freed_dirty)] = True
             self._freed_dirty.clear()
+            fmask = jnp.asarray(mask)
             self.cache = self.cache.replace(pos=_clear_freed_positions(
-                self.cache.pos, jnp.asarray(mask)))
+                self.cache.pos, fmask))
+            if self.dcache is not None:
+                self.dcache = self.dcache.replace(
+                    pos=_clear_freed_positions(self.dcache.pos, fmask))
         # committed to the cache's sharding: the disaggregated decode
         # worker otherwise sees two sharding keys for its cache operand
         # (prefill's committed output vs a fresh uncommitted replace)
         # and compiles twice
-        self.cache = self.cache.replace(
-            block_tables=jax.device_put(jnp.asarray(self._tables),
-                                        self._sharding),
-            lengths=jax.device_put(jnp.asarray(
-                np.asarray([0 if s is None else s.n_cached
-                            for s in self._slots], np.int32)),
-                self._sharding))
+        lengths = np.zeros((self._table_rows,), np.int32)
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                lengths[i] = s.n_cached
+        tbl = jax.device_put(jnp.asarray(self._tables), self._sharding)
+        lens = jax.device_put(jnp.asarray(lengths), self._sharding)
+        self.cache = self.cache.replace(block_tables=tbl, lengths=lens)
+        if self.dcache is not None:
+            self.dcache = self.dcache.replace(block_tables=tbl,
+                                              lengths=lens)
         self._rng, sub = jax.random.split(self._rng)
         if self.ecfg.disaggregated:
             sampled = np.zeros((len(rows),), np.int32)
@@ -1353,9 +1813,39 @@ class ServingEngine:
                         self._decode_fn, decode_rows, self.ecfg.max_slots,
                         sub)[:len(decode_rows)]
         else:
-            with tracer.span("engine/packed"):
-                sampled = self._run_worker(
-                    self._step_fn, rows, self.ecfg.token_budget, sub)
+            sampled = np.zeros((0,), np.int32)
+            if rows:
+                with tracer.span("engine/packed"):
+                    sampled = self._run_worker(
+                        self._step_fn, rows, self.ecfg.token_budget, sub)
+        emit = alen = bstar = None
+        if spec_live:
+            # one speculation round: draft proposes k tokens per branch
+            # into the lane clones, one target forward tree-verifies
+            # every branch, and the rejected rows are already
+            # un-published when the worker returns
+            sw = self._spec_slots
+            committed = np.zeros((sw,), np.int32)
+            posv = np.full((sw,), PAD_POSITION, np.int32)
+            for i, entry in enumerate(round_state):
+                if entry is None:
+                    continue
+                req = entry[0]
+                committed[i] = req.tokens[req.n_cached]
+                posv[i] = req.n_cached
+            cm, pv = jnp.asarray(committed), jnp.asarray(posv)
+            with tracer.span("engine/spec_draft"):
+                drafted, self.dcache = self._spec_draft_fn(
+                    self._draft_params, self.dcache, cm, pv)
+            with tracer.span("engine/spec_verify"):
+                (self.cache, self.dcache, emit_d, alen_d,
+                 bstar_d) = self._spec_verify_fn(
+                     self.params, self.cache, self.dcache, cm, drafted,
+                     pv)
+            # the round's ONE host sync: three small arrays, fetched
+            # together after both workers were dispatched
+            emit, alen, bstar = (np.asarray(emit_d), np.asarray(alen_d),
+                                 np.asarray(bstar_d))
         if self.prefix_cache is not None and prefill_rows:
             for req in {id(r[0]): r[0] for r in prefill_rows}.values():
                 self._maybe_insert_prefix(req)
@@ -1371,7 +1861,9 @@ class ServingEngine:
                 [(req.uid, "decode_step", step_us) for req in
                  {id(r[0]): r[0] for r in decode_rows}.values()]
                 + [(req.uid, "prefill_slice", step_us) for req in
-                   {id(r[0]): r[0] for r in prefill_rows}.values()])
+                   {id(r[0]): r[0] for r in prefill_rows}.values()]
+                + [(x[0].uid, "decode_step", step_us)
+                   for x in spec_live])
         with tracer.span("engine/retirement"):
             for i, (req, _, pos, produce) in enumerate(rows):
                 if req.decoding and pos == req.n_cached:
@@ -1388,6 +1880,9 @@ class ServingEngine:
                         or (self.ecfg.eos_id is not None
                             and tok == self.ecfg.eos_id)):
                     self._retire(req, now)
+            if spec_live:
+                self._land_spec_round(round_state, emit, alen, bstar,
+                                      now)
         self.stats.steps += 1
         self.stats.step_latency_s.append(now - t_start)
         self.stats.last_step_t = now
@@ -1398,7 +1893,7 @@ class ServingEngine:
             / max(1, self.allocator.num_allocated))
         self.stats.queue_depth = self.queue_depth()
         self._publish_obs(now - t_start)
-        return len(rows)
+        return len(rows) + len(spec_live)
 
     #: EngineStats scalar fields bridged into ``nxd_engine_stats`` each
     #: step. Derived percentiles (ttft_p50 etc.) stay in
@@ -1409,7 +1904,8 @@ class ServingEngine:
         "steps", "completed", "rejected", "preempted", "resubmitted",
         "queue_depth", "tokens_generated", "cow_copies",
         "prefix_hit_tokens", "prefill_tokens", "migrated_in",
-        "migrated_out", "migrated_tokens")
+        "migrated_out", "migrated_tokens", "spec_rounds",
+        "spec_accepted_tokens")
 
     def _publish_obs(self, step_latency_s: float) -> None:
         """Bridge :class:`EngineStats` into registry gauges and poll the
@@ -1466,10 +1962,13 @@ class ServingEngine:
         tpot = ((now - req.first_token_time) / (n_gen - 1)
                 if req.first_token_time is not None and n_gen > 1
                 else None)
+        k = self._spec.speculation_length if self._spec else 0
         self.results[req.uid] = RequestResult(
             uid=req.uid, prompt_len=req.prompt_len,
             tokens=list(req.generated), status="completed",
-            ttft_s=ttft, finish_s=now, tpot_s=tpot)
+            ttft_s=ttft, finish_s=now, tpot_s=tpot,
+            accept_rate=(req.spec_accepted / (req.spec_rounds * k)
+                         if req.spec_rounds and k else None))
         if self._standalone_obs:
             observe_request_metrics(
                 "completed", replica=self.name or "engine",

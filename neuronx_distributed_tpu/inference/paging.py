@@ -310,6 +310,20 @@ def write_pool_positions(pos: jax.Array, positions: jax.Array,
     return flat.reshape(nb, bs)
 
 
+def mask_pool_positions(pos: jax.Array, flat_idx: jax.Array,
+                        reject: jax.Array) -> jax.Array:
+    """Atomically un-publish pool rows: set the stored position of every
+    ``flat_idx[i]`` with ``reject[i]`` back to PAD_POSITION, so those
+    K/V rows can never pass the causal mask again. This is the
+    speculation rollback — rejected draft-branch rows vanish in one
+    fixed-shape scatter. Rows whose ``flat_idx`` is already out of bounds
+    (pad rows, ``== capacity``) are dropped either way."""
+    nb, bs = pos.shape
+    idx = jnp.where(reject, flat_idx, nb * bs)
+    flat = pos.reshape(nb * bs).at[idx].set(PAD_POSITION, mode="drop")
+    return flat.reshape(nb, bs)
+
+
 # ---------------------------------------------------------------------------
 # Prefix sharing: a host-side trie over full prompt blocks. KV for a token
 # depends only on (token, position, params), so two prompts with a common

@@ -282,6 +282,7 @@ class RouterStats:
     integrity_mismatches: int = 0   # shadow/primary token divergences
     slo_breaches: int = 0           # objectives entering sustained breach
     slo_scale_ups: int = 0          # scale-ups the SLO layer demanded
+    spec_toggles: int = 0           # SLO-driven speculation flips
     handoffs: int = 0               # sessions committed over the fabric
     handoff_aborts: int = 0         # torn streams (fell back to re-prefill)
     handoff_chunks: int = 0         # chunks across committed streams
@@ -320,6 +321,7 @@ class RouterStats:
             "integrity_mismatches": self.integrity_mismatches,
             "slo_breaches": self.slo_breaches,
             "slo_scale_ups": self.slo_scale_ups,
+            "spec_toggles": self.spec_toggles,
             "handoffs": self.handoffs,
             "handoff_aborts": self.handoff_aborts,
             "handoff_chunks": self.handoff_chunks,
@@ -424,10 +426,15 @@ class ReplicaRouter:
                  clock: Optional[Callable[[], float]] = None,
                  preemption_guard: Optional[PreemptionGuard] = None,
                  chaos: Optional[FaultPlan] = None,
-                 aot_cache: Optional[AotExecutableCache] = None):
+                 aot_cache: Optional[AotExecutableCache] = None,
+                 draft_cfg=None, draft_params=None):
         self.model_cfg = model_cfg
         self.params = params
         self.ecfg = engine_cfg
+        # speculative decoding: optional separate draft model shared by
+        # every replica (None = self-draft with the target weights)
+        self._draft_cfg = draft_cfg
+        self._draft_params = draft_params
         self.cfg = cfg
         self.stats = RouterStats()
         self.results: Dict[str, RouterResult] = {}
@@ -444,7 +451,8 @@ class ReplicaRouter:
         # engine counters absorbed from crashed (discarded) engines, so
         # aggregate prefix stats survive failover
         self._eng_acc = {"prefix_hit_tokens": 0, "prefill_tokens": 0,
-                         "cow_copies": 0}
+                         "cow_copies": 0, "spec_rounds": 0,
+                         "spec_accepted_tokens": 0}
         # one executable cache for the whole fleet: replica 0 compiles
         # each worker shape once, every later construction — scale-up,
         # probation revival — loads (memory-only by default; hand in a
@@ -508,7 +516,8 @@ class ReplicaRouter:
     def _new_engine(self, name: Optional[str] = None) -> ServingEngine:
         eng = ServingEngine(self.model_cfg, self.params, self.ecfg,
                             clock=self._clock, aot_cache=self._aot,
-                            name=name)
+                            name=name, draft_cfg=self._draft_cfg,
+                            draft_params=self._draft_params)
         eng._standalone_obs = False  # router owns request retirement
         return eng
 
@@ -580,8 +589,9 @@ class ReplicaRouter:
             self._reject(req, "never_fits",
                          f"{uid}: cannot fit any replica even alone")
         credit = self._prefix_credit(req)
-        load = (self._committed + req.total_tokens - credit) / max(
-            1, self._budget)
+        spec_extra = self._spec_draft_surcharge(req)
+        load = (self._committed + req.total_tokens - credit
+                + spec_extra) / max(1, self._budget)
         if load > 1.0:
             self._reject(req, "over_budget",
                          f"global budget: load would be {load:.2f}")
@@ -598,7 +608,7 @@ class ReplicaRouter:
                 req.max_new_tokens = capped
                 req.degraded = True
                 self.stats.degraded += 1
-        req.charged_tokens = max(0, req.total_tokens - credit)
+        req.charged_tokens = max(0, req.total_tokens - credit) + spec_extra
         if not self._bucket_take(tenant, req.charged_tokens):
             self._reject(req, "tenant_throttled",
                          f"tenant {tenant!r} token bucket empty")
@@ -625,6 +635,39 @@ class ReplicaRouter:
             return 0
         return max((rep.engine.prefix_lookup(req.prompt)
                     for rep in self.live_replicas()), default=0)
+
+    def _fleet_speculating(self) -> bool:
+        return any(rep.engine is not None and rep.engine.speculating
+                   for rep in self.live_replicas())
+
+    def _spec_accept_hat(self) -> float:
+        """Fleet-wide measured mean accept length, optimistic (= k) until
+        real rounds exist — optimism under-prices early traffic instead
+        of spuriously shedding it before any accept-rate signal."""
+        spec = self.ecfg.speculation
+        rounds = self._eng_acc["spec_rounds"]
+        acc = self._eng_acc["spec_accepted_tokens"]
+        for rep in self.replicas:
+            if rep.engine is not None:
+                rounds += rep.engine.stats.spec_rounds
+                acc += rep.engine.stats.spec_accepted_tokens
+        if rounds <= 0:
+            return float(spec.speculation_length)
+        return acc / rounds
+
+    def _spec_draft_surcharge(self, req: _RouterRequest) -> int:
+        """Admission price for speculation's extra verify rows. A
+        speculating fleet spends ``B*(k+1)`` packed rows to land
+        ``a_hat+1`` tokens, so each landed token costs
+        ``B*(k+1)/(a_hat+1)`` rows instead of 1 — charge the overage on
+        the decode portion so admission sees real row pressure, not the
+        optimistic one-row-per-token fiction."""
+        spec = self.ecfg.speculation
+        if spec is None or not self._fleet_speculating():
+            return 0
+        k, nb = spec.speculation_length, spec.num_branches
+        overhead = nb * (k + 1) / (self._spec_accept_hat() + 1.0)
+        return int(req.max_new_tokens * max(0.0, overhead - 1.0))
 
     def _is_sheddable(self, tenant: str) -> bool:
         """Shed tenants strictly below the highest configured priority;
@@ -1291,13 +1334,19 @@ class ReplicaRouter:
         self._eng_acc["prefix_hit_tokens"] += eng.stats.prefix_hit_tokens
         self._eng_acc["prefill_tokens"] += eng.stats.prefill_tokens
         self._eng_acc["cow_copies"] += eng.stats.cow_copies
+        self._eng_acc["spec_rounds"] += eng.stats.spec_rounds
+        self._eng_acc["spec_accepted_tokens"] += (
+            eng.stats.spec_accepted_tokens)
 
     def engine_aggregate(self) -> Dict[str, float]:
-        """Prefix-sharing metrics aggregated across replicas (live
-        engines plus counters absorbed from crashed ones)."""
+        """Prefix-sharing and speculation metrics aggregated across
+        replicas (live engines plus counters absorbed from crashed
+        ones)."""
         hit = self._eng_acc["prefix_hit_tokens"]
         pre = self._eng_acc["prefill_tokens"]
         cow = self._eng_acc["cow_copies"]
+        rounds = self._eng_acc["spec_rounds"]
+        acc = self._eng_acc["spec_accepted_tokens"]
         fracs: List[float] = []
         for rep in self.replicas:
             if rep.engine is None:
@@ -1306,12 +1355,17 @@ class ReplicaRouter:
             hit += s.prefix_hit_tokens
             pre += s.prefill_tokens
             cow += s.cow_copies
+            rounds += s.spec_rounds
+            acc += s.spec_accepted_tokens
             fracs.extend(s.shared_fraction)
         return {
             "prefix_hit_rate": hit / max(1, hit + pre),
             "shared_block_fraction": (float(np.mean(fracs))
                                       if fracs else 0.0),
             "cow_copies": cow,
+            "spec_rounds": rounds,
+            "spec_accepted_tokens": acc,
+            "spec_accept_mean": acc / max(1, rounds),
         }
 
     def stats_dict(self) -> Dict[str, Any]:
@@ -1509,6 +1563,20 @@ class ReplicaRouter:
             newly = set(status.breached) - self._slo_active_prev
             self.stats.slo_breaches += len(newly)
             self._slo_active_prev = set(status.breached)
+            spec = self.ecfg.speculation
+            if spec is not None and spec.slo_adaptive:
+                # auto-toggle: speculation burns ~B*(k+1) rows per landed
+                # token, so keep it OFF while TPOT is comfortable and
+                # switch it ON only when the decode objective is in
+                # sustained breach (host-only flip: no recompile)
+                want = "tpot_p99_s" in status.breached
+                for rep in self.live_replicas():
+                    eng = rep.engine
+                    if eng is not None and eng.speculating != want:
+                        eng.set_speculation(want)
+                        self.stats.spec_toggles += 1
+                        emit_event("spec_toggle", scope="router",
+                                   replica=rep.name, on=want)
         self._tick_autoscale()
         self.stats.steps += 1
         self._publish_obs()

@@ -79,6 +79,57 @@ def build_medusa_tree(tree_choices: Tuple[Tuple[int, ...], ...]
         head_of_node=jnp.asarray(head, jnp.int32))
 
 
+@dataclass(frozen=True)
+class SpeculationConfig:
+    """Engine-facing speculation knobs (the serving engine's analogue of
+    the reference's draft-group / speculation builder configuration).
+
+    ``speculation_length`` (k): drafted tokens per branch per round;
+    ``num_branches`` (B): independent first-token branches verified by one
+    tree-attention target forward; ``max_spec_slots``: cap on slots that
+    speculate in one round (None → derived from the engine's token
+    budget); ``slo_adaptive``: let the router toggle speculation from the
+    SLO monitor's TPOT verdict; ``start_on``: initial toggle state;
+    ``draft_cost_ratio``: draft-step cost relative to a target step, used
+    only by the planner term.
+    """
+
+    speculation_length: int = 4
+    num_branches: int = 1
+    max_spec_slots: Optional[int] = None
+    slo_adaptive: bool = False
+    start_on: bool = True
+    draft_cost_ratio: float = 0.15
+
+    def __post_init__(self):
+        if self.speculation_length < 1:
+            raise ValueError("speculation_length must be >= 1")
+        if self.num_branches < 1:
+            raise ValueError("num_branches must be >= 1")
+
+    @property
+    def tree_size(self) -> int:
+        """Nodes in the uniform verification tree (root + B chains of k)."""
+        return 1 + self.num_branches * self.speculation_length
+
+    def tree_choices(self) -> Tuple[Tuple[int, ...], ...]:
+        """Uniform tree paths: fan of ``num_branches`` at depth 1, chains
+        below — branch-major, depth-minor, so node ``(b, d)`` sits at
+        index ``1 + b * k + (d - 1)`` in :func:`build_medusa_tree`'s
+        node order."""
+        k, nb = self.speculation_length, self.num_branches
+        return tuple((b,) + (0,) * (d - 1)
+                     for b in range(nb) for d in range(1, k + 1))
+
+
+def branch_of_nodes(spec: SpeculationConfig) -> jax.Array:
+    """``[T]`` branch index per tree node (-1 for the root) for the
+    uniform tree of :meth:`SpeculationConfig.tree_choices`."""
+    k = spec.speculation_length
+    idx = jnp.arange(spec.tree_size)
+    return jnp.where(idx == 0, -1, (idx - 1) // k)
+
+
 def medusa_accept_longest(tree_logits: jax.Array,
                           tree_tokens: jax.Array,
                           buffers: MedusaBuffers) -> Tuple[jax.Array,
@@ -170,7 +221,13 @@ def make_speculation_round_fn(cfg, draft_cfg, speculation_length: int,
 
     def round_fn(params, draft_params, tcache, dcache, committed, pos,
                  filled, out):
-        # 1. draft K tokens autoregressively
+        # 1. draft K tokens autoregressively. The scan runs K+1 steps, not
+        # K: step j writes token j's K/V into the draft cache and proposes
+        # token j+1, so an all-accepted round (accepted == K) needs the
+        # extra step to land draft token K's K/V — otherwise the next
+        # round drafts from a cache with a hole at ``pos + K`` and accept
+        # rates collapse even when the draft agrees with the target. The
+        # K+1-th *proposal* is discarded.
         def draft_step(c, _):
             dc, tok, p = c
             logits, dc = llama_forward_with_cache(
@@ -179,8 +236,8 @@ def make_speculation_round_fn(cfg, draft_cfg, speculation_length: int,
             return (dc, nxt, p + 1), nxt
 
         (dcache, _, _), drafted = lax.scan(
-            draft_step, (dcache, committed, pos), None, length=k)
-        drafted = jnp.swapaxes(drafted, 0, 1)              # [B, K]
+            draft_step, (dcache, committed, pos), None, length=k + 1)
+        drafted = jnp.swapaxes(drafted, 0, 1)[:, :k]       # [B, K]
 
         # 2. one target forward over [committed, drafts]
         block = jnp.concatenate([committed[:, None], drafted], axis=1)
@@ -191,8 +248,12 @@ def make_speculation_round_fn(cfg, draft_cfg, speculation_length: int,
 
         # 3. accept/reject, 4. slot-masking rollback, 5. emit
         accepted, greedy = verify_draft_greedy(logits, drafted)
+        # the draft cache holds K+1 rows this round ([committed, d_1..d_K]);
+        # keep row j iff j <= accepted (row 0, the committed token, always
+        # survives; row K survives only on a fully-accepted round)
         tcache = _mask_rejected_slots(tcache, t_index, k + 1, accepted)
-        dcache = _mask_rejected_slots(dcache, dcache.index - k, k, accepted)
+        dcache = _mask_rejected_slots(dcache, dcache.index - (k + 1), k + 1,
+                                      accepted)
         out, _, filled = _emit_and_scatter(out, filled, drafted, greedy,
                                            accepted, max_new_tokens)
         new_committed = jnp.take_along_axis(greedy, accepted[:, None],
@@ -226,7 +287,9 @@ def speculative_generate(cfg, params, draft_cfg, draft_params, input_ids,
     if bucket > s:
         input_ids = jnp.pad(input_ids, ((0, 0), (0, bucket - s)))
 
-    slack = max_new_tokens * (k + 1) + k + 1
+    # both caches advance K+1 rows per round (the draft runs an extra
+    # scan step to land its last token's K/V — see round_fn)
+    slack = max_new_tokens * (k + 1) + k + 2
     tcache = init_kv_cache(cfg.num_layers, b, bucket + slack,
                            cfg.num_kv_heads, cfg.head_dim_,
                            dtype=kv_dtype or cfg.dtype)

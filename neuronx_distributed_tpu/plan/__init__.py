@@ -20,7 +20,8 @@ from .calibrate import (CalibrationResult, LinkFit, calibrate,
                         fit_alpha_beta, fit_mfu, load_bench_history,
                         mfu_from_bench)
 from .cost import (CostBreakdown, HardwareSpec, LinkSpec, ModelSpec, Plan,
-                   ServingCost, ServingPlan, ServingSpec, TrafficSpec,
+                   ServingCost, ServingPlan, ServingSpec, SpeculationSpec,
+                   TrafficSpec,
                    cold_start_s, dcn_handoff_bytes, dcn_handoff_s,
                    default_hardware, memory_bytes,
                    param_count, serving_cost, serving_pool_blocks,
@@ -55,7 +56,8 @@ def handpicked_plan(devices: int, *, platform: str = "cpu",
 __all__ = [
     "CalibrationResult", "CostBreakdown", "HardwareSpec", "LinkFit",
     "LinkSpec", "ModelSpec", "Plan", "ServingCost", "ServingPlan",
-    "ServingSpec", "TrafficSpec", "calibrate", "cold_start_s",
+    "ServingSpec", "SpeculationSpec", "TrafficSpec", "calibrate",
+    "cold_start_s",
     "dcn_handoff_bytes", "dcn_handoff_s",
     "default_hardware", "fit_alpha_beta", "fit_mfu",
     "load_bench_history", "memory_bytes", "mfu_from_bench",

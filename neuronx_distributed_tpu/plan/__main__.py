@@ -13,9 +13,9 @@ import argparse
 import sys
 from typing import Optional
 
-from . import (ModelSpec, ServingSpec, TrafficSpec, calibrate,
-               default_hardware, handpicked_plan, refine, render_kwargs,
-               search, serving_search, step_cost)
+from . import (ModelSpec, ServingSpec, SpeculationSpec, TrafficSpec,
+               calibrate, default_hardware, handpicked_plan, refine,
+               render_kwargs, search, serving_search, step_cost)
 from .cost import TPOT_P99_OVER_MEAN, TTFT_P99_OVER_MEAN
 from .emit import plan_to_config, plan_to_yaml_dict
 
@@ -92,6 +92,20 @@ def main(argv=None) -> int:
                     "(enables prefix sharing in the emitted config)")
     ap.add_argument("--serving-block", type=int, default=8,
                     help="paged-KV block size for the serving search")
+    ap.add_argument("--serving-spec-k", type=int, default=None,
+                    metavar="K", help="model speculative decoding with "
+                    "draft chains of depth K (adds the accept-rate-"
+                    "parameterized speculation term to the search)")
+    ap.add_argument("--serving-spec-branches", type=int, default=1,
+                    metavar="B", help="speculation tree branches "
+                    "(default 1)")
+    ap.add_argument("--serving-spec-accept", type=float, default=0.6,
+                    metavar="RATE", help="expected draft accept rate in "
+                    "[0,1]; calibrate from the engine's measured "
+                    "spec_accept_mean / K (default 0.6)")
+    ap.add_argument("--serving-spec-draft-cost", type=float, default=0.15,
+                    metavar="RATIO", help="draft-model step wall relative "
+                    "to the target step (default 0.15)")
     ap.add_argument("--disaggregated", action="store_true",
                     help="search disaggregated prefill/decode configs")
     ap.add_argument("--cross-host", action="store_true",
@@ -204,12 +218,20 @@ def main(argv=None) -> int:
                     if args.slo_ttft_p99_ms is not None else _math.inf)
         tpot_tgt = (args.slo_tpot_p99_ms / 1e3
                     if args.slo_tpot_p99_ms is not None else _math.inf)
+        spec_term = None
+        if args.serving_spec_k is not None:
+            spec_term = SpeculationSpec(
+                length=args.serving_spec_k,
+                branches=args.serving_spec_branches,
+                accept_rate=args.serving_spec_accept,
+                draft_cost_ratio=args.serving_spec_draft_cost)
         plans = serving_search(spec, hw, traffic,
                                slo_ttft_p99_s=ttft_tgt,
                                slo_tpot_p99_s=tpot_tgt,
                                tp=best.tp, block_size=args.serving_block,
                                disaggregated=args.disaggregated,
                                cross_host=args.cross_host,
+                               speculation=spec_term,
                                top_k=args.top_k)
         print(f"serving plan: rate={traffic.request_rate:g} req/s, "
               f"prompt={traffic.prompt_tokens:g}, "
@@ -218,7 +240,12 @@ def main(argv=None) -> int:
               + (f", ttft_p99<={ttft_tgt * 1e3:g}ms"
                  if _math.isfinite(ttft_tgt) else "")
               + (f", tpot_p99<={tpot_tgt * 1e3:g}ms"
-                 if _math.isfinite(tpot_tgt) else ""))
+                 if _math.isfinite(tpot_tgt) else "")
+              + (f", spec k={spec_term.length} b={spec_term.branches} "
+                 f"accept={spec_term.accept_rate:g} "
+                 f"(mean accept {spec_term.accept_mean:g}, "
+                 f"{spec_term.row_efficiency:.2f} tok/row)"
+                 if spec_term is not None else ""))
         if not plans:
             print("serving plan: no feasible engine config "
                   "(pool never fits — raise --hbm-gb)")

@@ -609,6 +609,66 @@ class TrafficSpec:
         return max(0.0, self.prompt_tokens - self.shared_prefix_tokens)
 
 
+@dataclass(frozen=True)
+class SpeculationSpec:
+    """Accept-rate-parameterized speculation term (jax-free mirror of
+    ``inference.speculative.SpeculationConfig``): a speculating slot
+    burns ``branches * (length + 1)`` verify rows per round to land
+    ``accept_rate * length + 1`` tokens, and the draft model's chained
+    forwards stretch the step wall by ``draft_cost_ratio``. Calibrate
+    ``accept_rate`` from measured walls — the engine reports
+    ``spec_accept_mean`` (mean accepted tokens per round) in
+    ``EngineStats.report()`` / ``ReplicaRouter.engine_aggregate()``;
+    divide by ``length`` to get the rate."""
+
+    length: int = 4                 # draft chain depth k
+    branches: int = 1               # tree branches B
+    accept_rate: float = 0.6        # accepted fraction of the k drafts
+    draft_cost_ratio: float = 0.15  # draft wall relative to target step
+
+    def __post_init__(self) -> None:
+        if self.length < 1:
+            raise ValueError("length must be >= 1")
+        if self.branches < 1:
+            raise ValueError("branches must be >= 1")
+        if not 0.0 <= self.accept_rate <= 1.0:
+            raise ValueError("accept_rate must be in [0, 1]")
+        if self.draft_cost_ratio < 0:
+            raise ValueError("draft_cost_ratio must be >= 0")
+
+    @classmethod
+    def from_accept_mean(cls, length: int, accept_mean: float,
+                         branches: int = 1,
+                         draft_cost_ratio: float = 0.15
+                         ) -> "SpeculationSpec":
+        """Build from the engine's measured ``spec_accept_mean``."""
+        return cls(length=length, branches=branches,
+                   accept_rate=min(1.0, max(0.0, accept_mean / length)),
+                   draft_cost_ratio=draft_cost_ratio)
+
+    @property
+    def accept_mean(self) -> float:
+        return self.accept_rate * self.length
+
+    @property
+    def tokens_per_round(self) -> float:
+        """Landed tokens per verify round: accepted drafts + the bonus
+        token the target emits even on full rejection."""
+        return self.accept_mean + 1.0
+
+    @property
+    def rows_per_round(self) -> int:
+        """Packed verify rows one speculating slot occupies."""
+        return self.branches * (self.length + 1)
+
+    @property
+    def row_efficiency(self) -> float:
+        """Landed tokens per verify row — the factor by which
+        speculation discounts (or taxes, when < plain decode's 1.0)
+        the engine's row capacity."""
+        return self.tokens_per_round / self.rows_per_round
+
+
 #: dequant tax on a quantized KV pool: the packed step spends extra
 #: element-wise work unpacking int8 KV before attention.
 QUANTIZED_COMPUTE_OVERHEAD = 1.1
@@ -686,7 +746,9 @@ def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
                  token_budget: int, max_slots: int,
                  prefill_budget: Optional[int] = None,
                  quantized: bool = False, tp: int = 1,
-                 cross_host: bool = False) -> ServingCost:
+                 cross_host: bool = False,
+                 speculation: Optional[SpeculationSpec] = None
+                 ) -> ServingCost:
     """Steady-state TTFT / TPOT / goodput of one continuous-batching
     engine (``inference.engine.ServingEngine``) under Poisson load.
 
@@ -705,26 +767,46 @@ def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
     hosts and the KV prefix rides :func:`dcn_handoff_s` over the DCN
     link; the stream is layer-ordered and overlaps the prefill steps
     that produce it, so only the *exposed* remainder (transfer beyond
-    the prefill wall time) lands in TTFT."""
+    the prefill wall time) lands in TTFT.
+
+    With ``speculation`` each decode slot lands
+    ``spec.tokens_per_round`` tokens per step (mean accepted drafts +
+    the bonus token) but occupies ``spec.rows_per_round`` verify rows,
+    and the chained draft forwards stretch the step wall by
+    ``draft_cost_ratio`` — the same row-pricing the router's admission
+    surcharge applies, so the planner and the admission controller
+    agree on what a speculated token costs."""
     t = traffic
     token_s = serving_token_s(
         m, hw, context=t.prompt_tokens + t.new_tokens / 2.0,
         tp=tp, quantized=quantized)
     prompt_eff = t.unique_prompt_tokens
     tokens_per_req = prompt_eff + t.new_tokens
-    demand_tps = t.request_rate * tokens_per_req
+    # speculation: tokens landed per slot-step and verify rows burned
+    # per landed decode token (plain decode: 1 and 1)
+    spec_tok = speculation.tokens_per_round if speculation else 1.0
+    row_tax = (1.0 / speculation.row_efficiency) if speculation else 1.0
+    demand_tps = t.request_rate * (prompt_eff + t.new_tokens * row_tax)
 
     # padded width: a step pays for the whole budget, occupied or not
     step_s = hw.serve_overhead_s + token_s * token_budget
+    if speculation is not None:
+        step_s *= 1.0 + speculation.draft_cost_ratio
     capacity_tps = token_budget / step_s
 
     decode_rows = float(min(max_slots, token_budget))
-    # Little's law on the decode phase: a slot holds new_tokens steps.
-    # slot_demand <= decode_rows -> every live request advances each
-    # step (tpot = step_s); beyond that slots queue and TPOT stretches.
-    slot_demand = t.request_rate * t.new_tokens * step_s
+    if speculation is not None:
+        # a speculating slot needs rows_per_round rows of verify width
+        decode_rows = float(min(
+            max_slots,
+            max(1, token_budget // speculation.rows_per_round)))
+    # Little's law on the decode phase: a slot holds
+    # new_tokens / spec_tok steps. slot_demand <= decode_rows -> every
+    # live request advances each step (tpot = step_s / spec_tok);
+    # beyond that slots queue and TPOT stretches.
+    slot_demand = t.request_rate * (t.new_tokens / spec_tok) * step_s
     conc = min(slot_demand, decode_rows)
-    tpot = step_s * max(1.0, slot_demand / decode_rows)
+    tpot = step_s / spec_tok * max(1.0, slot_demand / decode_rows)
     rho = max(demand_tps / capacity_tps, slot_demand / decode_rows)
     saturated = rho >= 1.0
 
@@ -745,9 +827,13 @@ def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
         ttft += exposed
 
     if saturated:
-        goodput = min(capacity_tps * (t.new_tokens
-                                      / max(1e-9, tokens_per_req)),
-                      decode_rows / step_s)
+        # capacity in *landed* tokens: row capacity discounted by the
+        # decode row tax, and the slot ceiling credits spec_tok landed
+        # tokens per slot-step
+        row_demand = prompt_eff + t.new_tokens * row_tax
+        goodput = min(capacity_tps * (t.new_tokens * row_tax
+                                      / max(1e-9, row_demand)) / row_tax,
+                      decode_rows * spec_tok / step_s)
     else:
         goodput = t.request_rate * t.new_tokens
     return ServingCost(ttft_s=ttft, tpot_s=tpot, tokens_per_s=goodput,
@@ -793,6 +879,10 @@ class ServingPlan:
             tags.append("prefix")
         if e.get("quantized"):
             tags.append("q8kv")
+        if e.get("speculation"):
+            sp = e["speculation"]
+            tags.append(f"spec=k{sp['speculation_length']}"
+                        f"b{sp['num_branches']}")
         return " ".join(tags)
 
     def to_dict(self) -> dict:
@@ -810,6 +900,7 @@ def serving_search(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
                    slots: tuple = (1, 2, 4, 8, 12, 16, 24, 32),
                    disaggregated: bool = False,
                    cross_host: bool = False,
+                   speculation: Optional[SpeculationSpec] = None,
                    top_k: int = 5) -> list:
     """Enumerate (token_budget, max_slots[, prefill_budget]) engine
     configs for the stated traffic and SLO, score each with
@@ -854,7 +945,8 @@ def serving_search(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
                 cost = serving_cost(m, hw, traffic, token_budget=budget,
                                     max_slots=ms, prefill_budget=pf,
                                     quantized=quantized, tp=tp,
-                                    cross_host=fabric)
+                                    cross_host=fabric,
+                                    speculation=speculation)
                 meets = (cost.ttft_s * TTFT_P99_OVER_MEAN <= slo_ttft_p99_s
                          and cost.tpot_s * TPOT_P99_OVER_MEAN
                          <= slo_tpot_p99_s
@@ -872,6 +964,10 @@ def serving_search(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
                 if pf is not None:
                     engine["disaggregated"] = True
                     engine["prefill_budget"] = pf
+                if speculation is not None:
+                    engine["speculation"] = dict(
+                        speculation_length=speculation.length,
+                        num_branches=speculation.branches)
                 slo = dict(ttft_p99_s=slo_ttft_p99_s,
                            tpot_p99_s=slo_tpot_p99_s)
                 router = {}

@@ -1,0 +1,51 @@
+"""Fixture: speculation-trace violations (traced accept branching and
+mid-round host syncs). Lives under ``inference/`` so the scoped rule
+applies. Parsed, never imported."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def verify_branches(tree_logits, accepted, drafted):
+    if accepted > 2:                        # BAD: traced accept branch
+        drafted = drafted[:3]
+    n = jnp.where(accepted > 0, 1, 0)
+    while accepted < n:                     # BAD: traced accept loop
+        n = n - 1
+    return drafted
+
+
+def draft_expand(tokens, accept_len):
+    out = []
+    for i in range(accept_len):             # BAD: trip count from accept
+        out.append(tokens[i])
+    return out
+
+
+def spec_round_step(cache, verdict):
+    alen = np.asarray(verdict.accept_len)   # BAD: host sync in round
+    jax.device_get(verdict.emit)            # BAD: host sync in round
+    verdict.best.block_until_ready()        # BAD: host sync in round
+    return cache, alen
+
+
+def fine_verify(tree_logits, accepted, buffers):
+    keep = jnp.where(accepted > 0, 1, 0)    # ok: fixed-shape mask
+    accepted_n = int(accepted)              # ok: explicit host convert
+    if accepted_n > 2:                      # ok: branching on host int
+        keep = keep + 1
+    return keep
+
+
+def fine_land(emit, alen):
+    a = int(alen)                           # ok: the documented boundary
+    return [int(t) for t in emit[:a + 1]]
+
+
+def unrelated_loop(items, accepted_jobs):
+    # not a speculation-named function: the rule stays out of the way
+    if accepted_jobs > 2:
+        return items[:2]
+    return items

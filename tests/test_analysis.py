@@ -373,6 +373,57 @@ def test_slo_self_gate_inference_package():
     assert fs == [], [f"{f.path}:{f.line} {f.message}" for f in fs]
 
 
+def test_speculation_trace_fires_on_fixture():
+    fs = _lint(os.path.join("inference", "bad_spec_round.py"))
+    assert _rules(fs) == {"speculation-trace"}
+    live = [f for f in fs if not f.suppressed]
+    # two traced branches, one traced trip count, three host syncs;
+    # none of the ok: masked/host-converted/unrelated cases
+    assert len(live) == 6
+    msgs = " | ".join(f.message for f in live)
+    assert "`accepted`" in msgs and "`accept_len`" in msgs
+    assert "np.asarray" in msgs and "jax.device_get" in msgs \
+        and ".block_until_ready()" in msgs
+    assert not any(f.line > 30 for f in live)
+
+
+def test_speculation_trace_scoped_and_host_casts_exempt():
+    bad = ("def verify_round(accepted, rows):\n"
+           "    if accepted > 2:\n"
+           "        rows = rows[:2]\n"
+           "    return rows\n")
+    # outside inference/ accept-mask control flow is not this rule's call
+    assert analyze_source(bad, "mymodel/trainer/loop.py",
+                          axes=DEFAULT_AXES) == []
+    assert [f.rule for f in analyze_source(
+        bad, "mymodel/inference/engine.py",
+        axes=DEFAULT_AXES)] == ["speculation-trace"]
+    # the documented round boundary — one int() fetch — stays quiet,
+    # as do non-speculation function names entirely
+    ok = ("def verify_round(accepted, rows):\n"
+          "    n = int(accepted)\n"
+          "    if n > 2:\n"
+          "        rows = rows[:2]\n"
+          "    return rows if int(accepted) else []\n"
+          "def schedule(accepted_jobs):\n"
+          "    if accepted_jobs > 2:\n"
+          "        return 1\n"
+          "    return 0\n")
+    assert analyze_source(ok, "mymodel/inference/engine.py",
+                          axes=DEFAULT_AXES) == []
+
+
+def test_speculation_trace_self_gate_inference_package():
+    """The speculation integration itself must hold its own invariant:
+    no traced-accept branching, no mid-round host syncs."""
+    pkg = os.path.join(REPO, "neuronx_distributed_tpu", "inference")
+    paths = [os.path.join(pkg, f) for f in sorted(os.listdir(pkg))
+             if f.endswith(".py")]
+    fs = [f for f in analyze_paths(paths)
+          if f.rule == "speculation-trace" and not f.suppressed]
+    assert fs == [], [f"{f.path}:{f.line} {f.message}" for f in fs]
+
+
 def test_paging_refcount_fires_on_fixture():
     fs = _lint(os.path.join("inference", "bad_refcount_bypass.py"))
     assert _rules(fs) == {"paging-refcount"}
@@ -557,7 +608,7 @@ def test_cli_nonzero_on_fixture_corpus():
                          "comm-compression", "tp-overlap",
                          "serving-resilience", "paging-refcount", "plan",
                          "observability", "elasticity", "integrity",
-                         "slo"}
+                         "slo", "speculation-trace"}
 
 
 def test_cli_zero_on_clean_file():

@@ -277,3 +277,52 @@ def test_sdc_serving_drill(tiny_model):
     assert out["sdc_serving_quarantines"] >= 1
     assert out["sdc_serving_shadows"] >= 1
     assert out["sdc_serving_greedy_match_ref"] == 1.0
+
+
+@pytest.mark.slow
+def test_slo_auto_toggle_flips_speculation_and_prices_admission(tiny_model):
+    """SLO-adaptive speculation: a sustained TPOT breach makes the router
+    toggle speculation ON fleet-wide (counted + no recompile), rounds run
+    and aggregate, and the admission surcharge tracks the fleet's
+    observed accept rate — zero at perfect accept, ``B(k+1)/(a+1) - 1``
+    per requested token when drafts stop landing."""
+    import types
+
+    from neuronx_distributed_tpu.inference.speculative import (
+        SpeculationConfig)
+    from neuronx_distributed_tpu.obs.slo import SloPolicy
+
+    cfg, params = tiny_model
+    k = 2
+    ecfg = _ecfg(num_blocks=32,
+                 speculation=SpeculationConfig(speculation_length=k,
+                                               slo_adaptive=True,
+                                               start_on=False))
+    router = ReplicaRouter(
+        cfg, params, ecfg,
+        RouterConfig(num_replicas=1,
+                     slo=SloPolicy(name="unit", tpot_p99_s=1e-9,
+                                   min_samples=1, breach_patience=1,
+                                   window=16)))
+    eng = router.replicas[0].engine
+    assert not eng.speculating            # start_on=False
+    for i, p in enumerate(_prompts(cfg, 4, seed=11)):
+        router.submit(p, 6, uid=f"req{i}")
+    res = router.run()
+    assert all(r.status == "completed" for r in res.values())
+    assert router.stats.spec_toggles >= 1
+    assert eng.speculating                # breach never recovers: stays on
+    assert eng.compile_count() == 1
+    agg = router.engine_aggregate()
+    assert agg["spec_rounds"] > 0
+    assert agg["spec_accept_mean"] == float(k)   # self-draft: full accept
+    # admission pricing: perfect accept => overhead B(k+1)/(k+1) = 1, no
+    # surcharge...
+    req = types.SimpleNamespace(max_new_tokens=8)
+    assert router._spec_draft_surcharge(req) == 0
+    # ...accept rate collapsing toward zero => overhead tends to
+    # B(k+1)/(0+1) = k+1 rows per landed token, so the surcharge tends
+    # to max_new * k (floored: the live engine's accepted tokens keep
+    # a_hat an epsilon above zero)
+    router._eng_acc["spec_rounds"] += 10 ** 6
+    assert router._spec_draft_surcharge(req) == 8 * k - 1

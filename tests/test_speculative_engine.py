@@ -1,0 +1,284 @@
+"""Speculative decoding inside the serving engine.
+
+The invariants under test, in rough dependency order:
+
+* ``verify_draft_greedy`` / ``medusa_accept_longest`` boundary: a fully
+  accepted round emits the target's bonus token exactly once, and a fully
+  rejected round emits exactly the target's correction (the
+  ``speculation_length``-boundary regression).
+* Speculation never changes greedy output: with a self-draft (accept = k
+  every round) AND with a garbage draft (accept ~ 0), the engine's tokens
+  are bit-identical to a plain engine's.
+* ``compile_count() == 1`` holds across accept-rate swings and across
+  SLO-style ``set_speculation`` toggles — speculation adds workers, never
+  recompiles one.
+* Branch lanes reference the slot's committed prefix blocks and clone
+  only the round's write window (COW); landing a verdict swaps the winner
+  in and frees losers + displaced originals atomically; 100+ mixed-accept
+  rounds leak zero pool blocks.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+
+from neuronx_distributed_tpu.inference.engine import (EngineConfig,
+                                                      ServingEngine)
+from neuronx_distributed_tpu.inference.speculative import (
+    SpeculationConfig, build_medusa_tree, medusa_accept_longest,
+    verify_draft_greedy)
+from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
+                                                  tiny_config)
+from neuronx_distributed_tpu.parallel import mesh as ps
+
+
+@pytest.fixture
+def tiny_model():
+    ps.initialize_model_parallel()
+    cfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32,
+                      num_layers=2)
+    params = meta.unbox(LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    return cfg, params
+
+
+def _ecfg(**kw):
+    base = dict(block_size=4, num_blocks=48, max_slots=2,
+                max_blocks_per_seq=16, token_budget=12,
+                kv_dtype=jnp.float32)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _spec_engine(tiny_model, k=3, nb=1, draft="self", **ekw):
+    """Engine with speculation on. ``draft="self"`` reuses the target
+    weights (greedy drafts always match: accept = k), ``draft="garbage"``
+    uses independently initialized weights (accept ~ 0)."""
+    cfg, params = tiny_model
+    spec = SpeculationConfig(speculation_length=k, num_branches=nb)
+    kw = {}
+    if draft == "garbage":
+        kw = dict(draft_cfg=cfg,
+                  draft_params=meta.unbox(LlamaForCausalLM(cfg).init(
+                      jax.random.key(99), jnp.zeros((1, 8), jnp.int32))))
+    return ServingEngine(cfg, params, _ecfg(speculation=spec, **ekw), **kw)
+
+
+def _prompt(seed, n, vocab):
+    return np.random.RandomState(seed).randint(0, vocab, (n,)).tolist()
+
+
+def _solo(tiny_model, prompt, max_new):
+    eng = ServingEngine(*tiny_model, _ecfg())
+    eng.submit(prompt, max_new_tokens=max_new, uid="ref")
+    return eng.run()["ref"].tokens
+
+
+# ---------------------------------------------------------------------------
+# satellite: the k-boundary regression in the verify helpers
+# ---------------------------------------------------------------------------
+
+def _onehot_logits(tokens, vocab):
+    """[1, N, V] logits whose greedy choice at position j is tokens[j]."""
+    return jax.nn.one_hot(jnp.asarray([tokens]), vocab)
+
+
+def test_verify_draft_greedy_full_accept_emits_bonus_once():
+    """All k drafts accepted: the emitted round is the k drafts plus the
+    target's bonus token at position k — once, not duplicated at the
+    accept boundary."""
+    vocab, k = 16, 3
+    greedy = [5, 9, 2, 7]                      # target's choice per slot
+    logits = _onehot_logits(greedy, vocab)     # [1, k+1, V]
+    accepted, nxt = verify_draft_greedy(logits, jnp.asarray([greedy[:k]]))
+    assert int(accepted[0]) == k
+    # emit rule: drafts at j < accepted, target greedy at j == accepted —
+    # so the full row is exactly greedy, ending in the single bonus token
+    emit = [int(nxt[0, j]) for j in range(k + 1)]
+    assert emit == greedy
+    assert emit.count(7) == 1
+
+
+def test_verify_draft_greedy_full_reject_emits_correction_once():
+    vocab, k = 16, 3
+    greedy = [5, 9, 2, 7]
+    logits = _onehot_logits(greedy, vocab)
+    drafts = [(g + 1) % vocab for g in greedy[:k]]   # mismatch everywhere
+    accepted, nxt = verify_draft_greedy(logits, jnp.asarray([drafts]))
+    assert int(accepted[0]) == 0
+    # only position 0 lands: the target's correction, exactly once
+    assert int(nxt[0, 0]) == greedy[0]
+
+
+def test_medusa_accept_longest_full_accept_and_reject():
+    """Tree form of the same boundary: a fully consistent chain accepts
+    to depth k (best node = the leaf), a root-inconsistent chain accepts
+    depth 0 (best node = root, next token comes from the root's greedy)."""
+    vocab, k = 16, 3
+    spec = SpeculationConfig(speculation_length=k, num_branches=1)
+    buffers = build_medusa_tree(spec.tree_choices())
+    # root committed token 3; chain drafts t1,t2,t3; target greedy at the
+    # node tree [root, n1, n2, n3] is [t1, t2, t3, bonus]
+    tree_tokens = jnp.asarray([[3, 5, 9, 2]])
+    logits = _onehot_logits([5, 9, 2, 7], vocab)
+    best, alen = medusa_accept_longest(logits, tree_tokens, buffers)
+    assert int(alen[0]) == k
+    assert int(best[0]) == k            # deepest chain node
+    # break the chain at the first draft: nothing below the root survives
+    bad = tree_tokens.at[0, 1].set(6)
+    best, alen = medusa_accept_longest(logits, bad, buffers)
+    assert int(alen[0]) == 0
+    assert int(best[0]) == 0
+
+
+# ---------------------------------------------------------------------------
+# tentpole: engine output is bit-identical at any accept rate
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_self_draft_full_accept_bit_identical(tiny_model):
+    """Self-draft: every round accepts all k drafts, multiple tokens land
+    per step, and the tokens are exactly the plain engine's."""
+    cfg, _ = tiny_model
+    prompt = _prompt(0, 7, cfg.vocab_size)
+    ref = _solo(tiny_model, prompt, 12)
+    eng = _spec_engine(tiny_model, k=3)
+    eng.submit(prompt, max_new_tokens=12, uid="a")
+    res = eng.run()["a"]
+    assert res.status == "completed"
+    assert res.tokens == ref
+    assert eng.stats.spec_rounds > 0
+    assert res.accept_rate == 1.0
+    assert eng.stats.to_dict()["spec_accept_mean"] == 3.0
+    # fewer steps than tokens: speculation actually landed >1 per round
+    assert eng.stats.steps < len(ref)
+
+
+@pytest.mark.slow
+def test_garbage_draft_zero_accept_bit_identical(tiny_model):
+    """A draft that never matches costs rounds but cannot corrupt output:
+    each round still lands the target's own token (the bonus path)."""
+    cfg, _ = tiny_model
+    prompt = _prompt(1, 6, cfg.vocab_size)
+    ref = _solo(tiny_model, prompt, 10)
+    eng = _spec_engine(tiny_model, k=3, draft="garbage")
+    eng.submit(prompt, max_new_tokens=10, uid="a")
+    res = eng.run()["a"]
+    assert res.status == "completed"
+    assert res.tokens == ref
+    assert eng.stats.spec_rounds > 0
+    assert res.accept_rate is not None and res.accept_rate < 0.5
+
+
+@pytest.mark.slow
+def test_two_requests_speculating_stay_independent(tiny_model):
+    cfg, _ = tiny_model
+    pa, pb = _prompt(2, 9, cfg.vocab_size), _prompt(3, 5, cfg.vocab_size)
+    ra, rb = _solo(tiny_model, pa, 8), _solo(tiny_model, pb, 8)
+    eng = _spec_engine(tiny_model, k=3)
+    eng.submit(pa, max_new_tokens=8, uid="a")
+    eng.submit(pb, max_new_tokens=8, uid="b")
+    res = eng.run()
+    assert res["a"].tokens == ra
+    assert res["b"].tokens == rb
+
+
+# ---------------------------------------------------------------------------
+# tentpole: one executable per worker, whatever the accept rate does
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_compile_once_across_accept_swings_and_toggles(tiny_model):
+    """Accept rate swinging (garbage draft) and the router-style
+    set_speculation flapping change which workers run, never what any
+    worker compiles to."""
+    cfg, _ = tiny_model
+    eng = _spec_engine(tiny_model, k=3, draft="garbage")
+    for i, on in enumerate((True, False, True)):
+        eng.set_speculation(on)
+        assert eng.speculating == on
+        prompt = _prompt(10 + i, 5 + i, cfg.vocab_size)
+        eng.submit(prompt, max_new_tokens=6, uid=f"r{i}")
+        res = eng.run()[f"r{i}"]
+        assert res.tokens == _solo(tiny_model, prompt, 6)
+        assert res.status == "completed"
+    assert eng.compile_count() == 1
+    counts = eng.worker_compile_counts()
+    assert counts["spec_draft"] == 1 and counts["spec_verify"] == 1
+    # the off-request really decoded plain: no round attributed to it
+    assert eng.results["r1"].accept_rate is None
+
+
+# ---------------------------------------------------------------------------
+# tentpole: COW branch lanes over refcounted paged KV
+# ---------------------------------------------------------------------------
+
+def test_branch_lanes_share_prefix_and_land_atomically(tiny_model):
+    """White-box round lifecycle: lanes clone only the write-window
+    blocks (prefix stays shared by reference), and landing a verdict
+    swaps the winner in while freeing losers + displaced originals in one
+    allocator call — net pool usage is unchanged by a round."""
+    cfg, _ = tiny_model
+    k, nb = 3, 2
+    eng = _spec_engine(tiny_model, k=k, nb=nb, token_budget=16)
+    # 9-token prompt: prefill maps 3 blocks, decode position sits inside
+    # block 2 with the round's window spanning into block 3
+    eng.submit(_prompt(4, 9, cfg.vocab_size), max_new_tokens=20, uid="a")
+    eng.step()                                  # prefill
+    base_alloc = eng.allocator.num_allocated
+    rs = eng._begin_spec_round()
+    assert len(rs) == 1 and rs[0] is not None
+    req, lane_blocks, blk0, blk_last = rs[0]
+    e = eng.ecfg
+    n_window = blk_last - blk0 + 1
+    assert eng.allocator.num_allocated == base_alloc + nb * n_window
+    assert eng.stats.cow_copies > 0             # live blocks were cloned
+    for b in range(nb):
+        lane = e.max_slots + b
+        # committed prefix below the write window: shared by reference
+        assert (eng._tables[lane, :blk0]
+                == eng._tables[req.slot, :blk0]).all()
+        # write window: branch-private clones, distinct per branch
+        for bi in range(blk0, blk_last + 1):
+            assert eng._tables[lane, bi] != eng._tables[req.slot, bi]
+    assert set(lane_blocks[0]).isdisjoint(lane_blocks[1])
+    # blocks the sequence grows into this round (previously unmapped)
+    grown = sum(1 for bi in range(blk0, blk_last + 1)
+                if int(eng._tables[req.slot, bi]) < 0)
+    # land: branch 1 wins with all k accepted (+ bonus)
+    win = list(lane_blocks[1])
+    emit = np.asarray([[7, 8, 9, 10]])
+    eng._land_spec_round(rs, emit, np.asarray([k]), np.asarray([1]), 0.0)
+    # atomic: losers + displaced originals freed in the same call the
+    # winner lands, so the pool only grows by the sequence's new tail
+    assert eng.allocator.num_allocated == base_alloc + grown
+    assert [int(eng._tables[req.slot, bi])
+            for bi in range(blk0, blk_last + 1)] == win
+    assert req.generated[-(k + 1):] == [7, 8, 9, 10]
+    assert (eng._tables[e.max_slots:, :] == -1).all()  # lanes parked
+
+
+@pytest.mark.slow
+def test_hundred_mixed_accept_rounds_leak_no_blocks(tiny_model):
+    """100+ rounds of branch-and-roll with a garbage draft (mixed accept
+    lengths, two branches) across overlapping requests: the pool drains
+    to zero and every table row is unmapped."""
+    cfg, _ = tiny_model
+    eng = _spec_engine(tiny_model, k=3, nb=2, draft="garbage",
+                       token_budget=16)
+    for i in range(6):
+        eng.submit(_prompt(20 + i, 4 + (i % 3), cfg.vocab_size),
+                   max_new_tokens=30, uid=f"r{i}")
+        eng.step()
+    res = eng.run()
+    assert {r.status for r in res.values()} == {"completed"}
+    for i in range(6):
+        prompt = _prompt(20 + i, 4 + (i % 3), cfg.vocab_size)
+        assert res[f"r{i}"].tokens == _solo(tiny_model, prompt, 30)
+    assert eng.stats.spec_rounds >= 100
+    assert eng.compile_count() == 1
+    assert eng.allocator.num_allocated == 0     # zero leaked blocks
+    assert (eng._tables == -1).all()
