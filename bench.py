@@ -137,7 +137,7 @@ import jax.numpy as jnp  # noqa: E402
 def main(chaos_spec=None, serving=False, overlap=False, router=False,
          prefix_heavy=False, plan_mode=False, obs_mode=False,
          elastic=False, sdc=False, moe=False, lint_mode=False,
-         disagg_fabric=False, speculative=False):
+         disagg_fabric=False, speculative=False, long_context=False):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -308,6 +308,20 @@ def main(chaos_spec=None, serving=False, overlap=False, router=False,
 
             traceback.print_exc()
             print(f"bench: speculative metric failed: {e!r}",
+                  file=sys.stderr)
+
+    # million-token-tier drill (docs/serving.md "Long-context tier"):
+    # opt-in via --long-context; a pool-overflowing prompt refused at
+    # cp=1 but served by cp=4/cp=8 ring-prefill engines — TTFT scaling,
+    # int8 hop wire ratio, greedy parity, compile_count()==1
+    if long_context:
+        try:
+            aux.update(long_context_metric(platform))
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: long-context metric failed: {e!r}",
                   file=sys.stderr)
 
     # elastic-fleet drill (docs/serving.md "Elastic fleet"): opt-in via
@@ -994,6 +1008,158 @@ def speculative_metric(platform: str) -> dict:
         f"speculative_leaked_blocks_{tag}": {
             "value": int(leaked), "unit": "blocks",
             "vs_baseline": 1.0 if leaked == 0 else 0.0},
+    }
+
+
+def long_context_metric(platform: str) -> dict:
+    """Million-token-tier drill (docs/serving.md "Long-context tier").
+
+    A prompt that OVERFLOWS a single mesh's paged pool is thrown at a
+    cp=1 engine (must refuse: ``RequestRejected(never_fits)`` at the
+    door, ``CacheExhaustedError`` from the allocator itself) and then
+    served by cp=4 and cp=8 context-parallel engines whose global pool
+    is ``cp * num_blocks`` — same model weights, same greedy sampling.
+    Reports TTFT scaling cp4->cp8 (the ring prefill divides the
+    per-rank attention wall), the static ring-hop wire ratio of the
+    int8 codec (acceptance: >=3.5x vs fp32 hops), long-context decode
+    tokens/s at cp=4, greedy parity of a FITTABLE prompt across cp=1 /
+    cp=4-fp32 / cp=4-int8 (must be 1.0 — CP is an execution strategy,
+    not an approximation), and the one-executable invariant
+    (compile_count()==1 after sessions of wildly different lengths)."""
+    import numpy as np
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference.engine import (EngineConfig,
+                                                          EngineStats,
+                                                          RequestRejected,
+                                                          ServingEngine)
+    from neuronx_distributed_tpu.inference.paging import CacheExhaustedError
+    from neuronx_distributed_tpu.models import llama
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.parallel.wire_codec import (
+        wire_bytes_per_element)
+
+    n_dev = len(jax.devices())
+    if platform == "cpu":
+        cfg = llama.LlamaConfig(
+            vocab_size=1024, hidden_size=256, intermediate_size=704,
+            num_layers=4, num_heads=8, num_kv_heads=8, max_seq_len=4096,
+            dtype=jnp.float32, param_dtype=jnp.float32)
+        block_size, num_blocks = 8, 72       # per rank: 576 tokens at cp=1
+        mbps, width = 256, 2048              # width % (8*8) == 0
+        long_plen, long_new = 1536, 32       # 1568 > 576, fits cp>=4
+        short_plen, short_new = 96, 24       # fits everywhere
+    else:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=16, num_heads=8, num_kv_heads=8, max_seq_len=131072)
+        block_size, num_blocks = 32, 1280    # per rank: 40960 tokens at cp=1
+        mbps, width = 4096, 131072
+        long_plen, long_new = 120000, 64     # the 128k-class prompt
+        short_plen, short_new = 512, 32
+    cps = [c for c in (4, 8) if c <= n_dev]
+    if not cps:
+        raise RuntimeError(f"long-context drill needs >=4 devices, "
+                           f"have {n_dev}")
+
+    # params are built MESH-FREE (uncommitted arrays): every engine in
+    # the cp ladder tears the mesh down and rebuilds it at its own
+    # degree, and arrays committed to a destroyed mesh re-key the jit
+    # cache on every step (compile_count explodes)
+    ps.destroy_model_parallel()
+    params = meta.unbox(llama.LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    rng = np.random.RandomState(7)
+    long_prompt = rng.randint(0, cfg.vocab_size, (long_plen,)).tolist()
+    short_prompt = rng.randint(0, cfg.vocab_size, (short_plen,)).tolist()
+    base = dict(block_size=block_size, num_blocks=num_blocks,
+                max_slots=4, max_blocks_per_seq=mbps,
+                token_budget=16, kv_dtype=cfg.dtype)
+
+    def build(cp, wire="int8"):
+        ps.destroy_model_parallel()
+        if cp > 1:
+            ps.initialize_model_parallel(context_parallel_size=cp)
+            ecfg = EngineConfig(cp=cp, cp_prefill_width=width,
+                                cp_wire_dtype=wire, **base)
+        else:
+            ps.initialize_model_parallel()
+            ecfg = EngineConfig(**base)
+        return ServingEngine(cfg, params, ecfg)
+
+    def serve(eng, prompt, new, warm=True):
+        if warm:                 # compile on a short session, then reset
+            eng.submit(short_prompt, 4, uid="warm")
+            eng.run()
+            eng.stats, eng.results = EngineStats(), {}
+            eng._t0 = eng._clock()
+        eng.submit(prompt, new, uid="req", arrival_time=0.0)
+        res = eng.run()["req"]
+        assert res.status == "completed", res
+        return res
+
+    # -- cp=1: the long prompt must be REFUSED, not mangled ---------------
+    eng1 = build(1)
+    cp1_rejected = cp1_exhausted = False
+    try:
+        eng1.submit(long_prompt, long_new, uid="long")
+    except RequestRejected as e:
+        cp1_rejected = e.reason == "never_fits"
+    try:        # the pool itself is the binding constraint
+        eng1.allocator.alloc(-(-(long_plen + long_new) // block_size))
+    except CacheExhaustedError:
+        cp1_exhausted = True
+    cp1_oom = 1.0 if (cp1_rejected and cp1_exhausted) else 0.0
+
+    # greedy parity leg 1: a fittable prompt on the single-mesh engine
+    ref = serve(eng1, short_prompt, short_new, warm=False)
+
+    # -- cp ladder: serve the long prompt, time the first token -----------
+    ttft, tps_long, compile_ok, parity = {}, 0.0, True, {}
+    for cp in cps:
+        eng = build(cp)
+        res = serve(eng, long_prompt, long_new)
+        ttft[cp] = float(res.ttft_s)
+        if cp == 4:
+            tps_long = len(res.tokens) / max(1e-9, float(res.finish_s))
+            # mixed session lengths through the same executables
+            short = serve(eng, short_prompt, short_new, warm=False)
+            parity["int8"] = float(short.tokens == ref.tokens)
+        compile_ok = compile_ok and eng.compile_count() == 1
+    eng_fp = build(4, wire="fp32")
+    parity["fp32"] = float(
+        serve(eng_fp, short_prompt, short_new).tokens == ref.tokens)
+    parity_frac = float(np.mean(list(parity.values())))
+
+    scaling = (ttft[4] / max(1e-9, ttft[8])) if 8 in ttft else 1.0
+    wire_ratio = 4.0 / wire_bytes_per_element("int8",
+                                              cfg.cp_wire_block_size)
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel()
+    print(f"bench: long-context drill cp1_oom={cp1_oom:.0f} "
+          f"ttft={{{', '.join(f'cp{c}: {t:.3f}s' for c, t in ttft.items())}}} "
+          f"scaling_cp4/cp8={scaling:.2f}x wire_ratio={wire_ratio:.2f}x "
+          f"long_tokens/s={tps_long:.1f} parity={parity_frac:.2f} "
+          f"compile_count==1 {compile_ok}", file=sys.stderr)
+    tag = f"{platform}1"
+    return {
+        f"long_context_cp1_oom_{tag}": {
+            "value": cp1_oom, "unit": "bool", "vs_baseline": cp1_oom},
+        f"long_context_ttft_scaling_vs_cp_{tag}": {
+            "value": round(scaling, 3), "unit": "x",
+            "vs_baseline": round(scaling, 3)},
+        f"long_context_cp_wire_ratio_{tag}": {
+            "value": round(wire_ratio, 3), "unit": "x",
+            "vs_baseline": round(wire_ratio / 3.5, 3)},
+        f"long_context_tokens_per_s_{tag}": {
+            "value": round(tps_long, 2), "unit": "tokens/sec",
+            "vs_baseline": 1.0},
+        f"long_context_greedy_parity_{tag}": {
+            "value": parity_frac, "unit": "frac",
+            "vs_baseline": parity_frac},
+        f"long_context_compile_once_{tag}": {
+            "value": 1.0 if compile_ok else 0.0, "unit": "bool",
+            "vs_baseline": 1.0 if compile_ok else 0.0},
     }
 
 
@@ -2358,6 +2524,13 @@ if __name__ == "__main__":
              "reports decode tokens/s speedup, mean accept length, and "
              "greedy match rate; docs/serving.md)")
     _p.add_argument(
+        "--long-context", action="store_true",
+        help="also run the million-token-tier drill (a prompt that "
+             "overflows one mesh's paged pool refused at cp=1, served by "
+             "cp=4/cp=8 ring-prefill engines; TTFT scaling vs cp, int8 "
+             "hop wire ratio, greedy parity, compile_count()==1; "
+             "docs/serving.md)")
+    _p.add_argument(
         "--router", action="store_true",
         help="also run the multi-replica failover drill (chaos plan kills "
              "a replica mid-decode; reports availability, failovers, and "
@@ -2429,4 +2602,4 @@ if __name__ == "__main__":
          obs_mode=_args.obs, elastic=_args.elastic, sdc=_args.sdc,
          moe=_args.moe, lint_mode=_args.lint,
          disagg_fabric=_args.disagg_fabric,
-         speculative=_args.speculative)
+         speculative=_args.speculative, long_context=_args.long_context)

@@ -21,7 +21,14 @@ path component): calls to a jit-wrapped callable whose **array operands
 were shaped from per-request values** (``len(requests)`` and friends).
 Each distinct live-request count is a distinct shape, so the step
 retraces as load varies — exactly what the fixed-budget packing of
-:mod:`..inference.engine` exists to avoid.
+:mod:`..inference.engine` exists to avoid. The taint follows array
+*constructors* (``zeros``/``asarray``/...) and shape-producing
+*reshapers* (``reshape``/``split``/``array_split``/``tile``/``repeat``)
+alike — the context-parallel prefill path made the latter an easy trap:
+``np.array_split(prompt, len(prompt) // cp)`` hands the CP worker a
+per-prompt chunk count, one compile per distinct prompt length, where
+the ring prefill's fixed ``cp_prefill_width`` pad exists precisely so
+the chunk grid never moves.
 """
 
 from __future__ import annotations
@@ -43,6 +50,12 @@ _ARRAY_CTORS = frozenset({"array", "asarray", "zeros", "ones", "full",
                           "arange", "linspace", "empty", "eye"})
 
 _ARRAY_ROOTS = frozenset({"np", "numpy", "jnp", "jax"})
+
+#: shape-producing calls (module fns and array methods both spell these):
+#: a len()-tainted operand here yields an array whose shape — or chunk
+#: count, for the splitters — tracks the per-request value
+_SHAPE_METHODS = frozenset({"reshape", "split", "array_split", "tile",
+                            "repeat"})
 
 
 def _is_mutable_value(expr: ast.AST) -> bool:
@@ -182,12 +195,18 @@ def _per_request_shape_hazards(ctx: LintContext) -> Iterator[Finding]:
     derived, mentions_len = _len_taint(ctx.tree)
 
     def shape_from_len(expr: ast.AST) -> bool:
-        if isinstance(expr, ast.Call) and \
-                astutil.tail_name(expr.func) in _ARRAY_CTORS:
+        if not isinstance(expr, ast.Call):
+            return False
+        tail = astutil.tail_name(expr.func)
+        operands = list(expr.args) + [k.value for k in expr.keywords]
+        if tail in _ARRAY_CTORS:
             root = astutil.root_name(expr.func)
             if root in _ARRAY_ROOTS or root is None:
-                operands = list(expr.args) + [k.value for k in expr.keywords]
                 return any(mentions_len(a) for a in operands)
+        if tail in _SHAPE_METHODS:
+            # reshapers carry the taint whether spelled as module fns
+            # (np.array_split(x, n_chunks)) or methods (x.reshape(n, -1))
+            return any(mentions_len(a) for a in operands)
         return False
 
     # names bound to jax.jit(...) results, and names assigned a

@@ -107,6 +107,30 @@ class EngineConfig:
     # disaggregated (speculation is a decode-side feature of the packed
     # step).
     speculation: Optional[SpeculationConfig] = None
+    # context parallelism (the long-context tier): cp>1 shards the paged
+    # pool's *block* dimension over the mesh's "cp" axis — ``num_blocks``
+    # stays PER RANK, so the global pool is ``cp * num_blocks`` and the
+    # servable context grows linearly with the CP degree. Prefill runs
+    # the whole prompt in ONE ring-attention pass (each rank holds its
+    # contiguous sequence slice; KV hops ship quantized per
+    # ``cp_wire_dtype``); decode runs paged attention per rank over its
+    # resident blocks and merges partials with the flash-decoding
+    # max/sum combine. Requires a mesh initialized with
+    # ``context_parallel_size == cp``; incompatible with prefix_sharing
+    # (trie blocks aren't CP-sharded), speculation, quantized pools and
+    # ``disaggregated`` (cp is its own prefill/decode split — cross-host
+    # handoff to plain decode workers goes through export_session /
+    # the streamed transport instead).
+    cp: int = 1
+    # global width of the ring-prefill worker (the longest prompt one
+    # ring pass covers). None -> max_blocks_per_seq * block_size, i.e.
+    # any admissible prompt in one pass. Must split evenly into
+    # cp * block_size chunks.
+    cp_prefill_width: Optional[int] = None
+    # wire dtype for the ring's ppermute KV hops: "int8" (default,
+    # ~3.9x wire reduction) | "fp8" | "fp32" (bitwise fallback — hops
+    # ship unquantized)
+    cp_wire_dtype: str = "int8"
     # SDC defense on the migration path: export_session fingerprints the
     # shipped KV blocks (host-side int32 bit-folds over the extracted
     # payload) and import_session verifies them before touching the pool.
@@ -498,7 +522,66 @@ class ServingEngine:
         # sites so a fleet's replicas don't alias one site
         self.name = name
         self._aot = aot_cache
-        self.allocator = BlockAllocator(engine_cfg.num_blocks)
+        # context parallelism: validate the long-context tier's contract
+        # up front — every restriction here is a config error, not a
+        # runtime surprise three steps into a 512k-token session
+        cp = max(1, int(getattr(engine_cfg, "cp", 1)))
+        self._cp = cp
+        self._cp_width: Optional[int] = None
+        if cp > 1:
+            from ..parallel import mesh as ps
+
+            if engine_cfg.prefix_sharing:
+                raise ValueError(
+                    "EngineConfig(cp>1, prefix_sharing=True): prefix-trie "
+                    "entries pin whole pool blocks, but a CP-sharded pool "
+                    "scatters a sequence's blocks across the cp ranks — a "
+                    "trie hit on one rank would map blocks the other "
+                    "ranks' attention cannot see. The trie is not "
+                    "CP-sharded yet; run the long-context tier with "
+                    "prefix_sharing=False")
+            if engine_cfg.speculation is not None:
+                raise ValueError(
+                    "cp>1 does not support speculative decoding: lane "
+                    "clones assume a single-rank pool")
+            if engine_cfg.disaggregated:
+                raise ValueError(
+                    "cp>1 is already a prefill/decode split (ring prefill "
+                    "worker + combined decode worker); cross-engine "
+                    "disaggregation hands sessions off through "
+                    "export_session / the streamed transport")
+            if engine_cfg.quantized:
+                raise ValueError(
+                    "cp>1 does not support quantized pools yet (the ring "
+                    "prefill writes fp rows)")
+            if self._forward_fn is not llama_forward_with_cache:
+                raise ValueError(
+                    "cp>1 currently serves Llama-family configs only "
+                    "(the ring-prefill path lives in "
+                    "llama_forward_with_cache)")
+            if (not ps.model_parallel_is_initialized()
+                    or ps.get_context_parallel_size() != cp):
+                raise ValueError(
+                    f"EngineConfig(cp={cp}) needs an initialized mesh "
+                    f"with context_parallel_size={cp}; call "
+                    "initialize_model_parallel(context_parallel_size=...) "
+                    "first")
+            width = (engine_cfg.cp_prefill_width
+                     or engine_cfg.max_blocks_per_seq
+                     * engine_cfg.block_size)
+            if width % (cp * engine_cfg.block_size):
+                raise ValueError(
+                    f"cp_prefill_width={width} must split into {cp} "
+                    f"per-rank slices of whole {engine_cfg.block_size}-"
+                    "token blocks")
+            self._cp_width = width
+            # the ring hops read the wire dtype off the model config
+            self.model_cfg = model_cfg = dataclasses.replace(
+                model_cfg, cp_wire_dtype=engine_cfg.cp_wire_dtype)
+        #: global pool size in blocks (== num_blocks at cp=1; the pool's
+        #: block dimension is sharded cp-ways otherwise)
+        self._pool_blocks = cp * engine_cfg.num_blocks
+        self.allocator = BlockAllocator(self._pool_blocks, cp_size=cp)
         self.stats = EngineStats()
         self.results: Dict[str, RequestResult] = {}
         self._queue: Deque[_RequestState] = deque()
@@ -567,7 +650,20 @@ class ServingEngine:
             if engine_cfg.prefix_sharing else None)
         self.cache = self._init_cache()
         self.dcache = self._init_draft_cache()
-        if engine_cfg.disaggregated:
+        if cp > 1:
+            # two workers, two fixed widths: the packed worker decodes
+            # (and could chunk-prefill short prompts) at token_budget,
+            # the ring worker prefills a whole prompt per pass at
+            # cp_prefill_width — each compiles exactly once, so
+            # compile_count() stays 1 across wildly different sessions
+            self._step_fn = self._build_worker(
+                "packed", engine_cfg.token_budget)
+            self._prefill_fn = self._build_worker(
+                "cp_prefill", self._cp_width)
+            self._decode_fn = None
+            workers = {"packed": self._step_fn,
+                       "cp_prefill": self._prefill_fn}
+        elif engine_cfg.disaggregated:
             # two workers, two jit/AOT instances: each sees exactly one
             # input shape, so each compiles exactly once
             self._step_fn = None
@@ -613,13 +709,14 @@ class ServingEngine:
         # (num_blocks) is unchanged — lanes borrow blocks per round
         if e.quantized:
             cache = init_quantized_paged_kv_cache(
-                m.num_layers, e.num_blocks, e.block_size, m.num_kv_heads,
-                m.head_dim_, self._table_rows, e.max_blocks_per_seq)
+                m.num_layers, self._pool_blocks, e.block_size,
+                m.num_kv_heads, m.head_dim_, self._table_rows,
+                e.max_blocks_per_seq)
         else:
             cache = init_paged_kv_cache(
-                m.num_layers, e.num_blocks, e.block_size, m.num_kv_heads,
-                m.head_dim_, self._table_rows, e.max_blocks_per_seq,
-                dtype=e.kv_dtype or m.dtype)
+                m.num_layers, self._pool_blocks, e.block_size,
+                m.num_kv_heads, m.head_dim_, self._table_rows,
+                e.max_blocks_per_seq, dtype=e.kv_dtype or m.dtype)
         # commit to the sharding the jitted step will leave its outputs
         # on (replicated over the active mesh, else the default device):
         # an uncommitted first-step cache has a different sharding key
@@ -633,7 +730,23 @@ class ServingEngine:
         else:
             sharding = jax.devices()[0]
         self._sharding = sharding
-        return jax.device_put(cache, sharding)
+        cache = jax.device_put(cache, sharding)
+        if self._cp > 1:
+            # the pool itself shards block-wise over cp: rank r
+            # physically holds global blocks [r*num_blocks,
+            # (r+1)*num_blocks) — exactly the allocator's rank slices.
+            # Tables and lengths stay replicated (tiny, host-written).
+            P = jax.sharding.PartitionSpec
+            mesh = ps.get_mesh()
+
+            def ns(spec):
+                return jax.sharding.NamedSharding(mesh, spec)
+
+            cache = cache.replace(
+                k=jax.device_put(cache.k, ns(P(None, ps.CP_AXIS))),
+                v=jax.device_put(cache.v, ns(P(None, ps.CP_AXIS))),
+                pos=jax.device_put(cache.pos, ns(P(ps.CP_AXIS))))
+        return cache
 
     def _init_draft_cache(self):
         """The draft model's own pool, mirroring the target pool's block
@@ -654,12 +767,81 @@ class ServingEngine:
                 dtype=e.kv_dtype or d.dtype)
         return jax.device_put(dc, self._sharding)
 
+    def _cp_cache_specs(self):
+        """The CP cache's shard_map spec pytree: pool tensors split
+        block-wise over ``cp``, tables/lengths replicated. Built by
+        substituting specs for arrays in the live cache pytree, so it
+        tracks the cache's exact structure."""
+        from ..parallel import mesh as ps
+        P = jax.sharding.PartitionSpec
+        return self.cache.replace(
+            k=P(None, ps.CP_AXIS), v=P(None, ps.CP_AXIS),
+            pos=P(ps.CP_AXIS), block_tables=P(), lengths=P())
+
+    @staticmethod
+    def _cp_local_tables(tables, rank, blocks_per_rank):
+        """Global block ids -> this rank's pool-shard indices (``-1``
+        where another rank owns the block, so gathers position-mask out
+        and K/V scatters drop — each row lands exactly once, on its
+        owner)."""
+        loc = tables - rank * blocks_per_rank
+        ok = (tables >= 0) & (loc >= 0) & (loc < blocks_per_rank)
+        return jnp.where(ok, loc, -1)
+
+    def _build_cp_step(self, prefill: bool):
+        """One CP worker under ``shard_map`` over the ``cp`` axis.
+
+        Decode/packed (``prefill=False``): every rank runs the full
+        token batch against its local pool shard (tables rewritten to
+        rank-local ids) and the per-rank paged partials merge inside
+        attention with the flash-decoding max/sum combine — one gather
+        plus three small collectives per layer; activations and sampled
+        tokens come out replicated.
+
+        Ring prefill (``prefill=True``): tokens/positions arrive
+        sharded along the sequence, each rank prefills its contiguous
+        prompt slice with ring attention (KV hops quantized per the
+        model config's ``cp_wire_dtype``) and writes K/V rows into the
+        blocks its pool shard owns; sampled tokens come out sharded so
+        the host reads exactly the ``prompt_len - 1`` entry."""
+        from ..parallel import mesh as ps
+        model_cfg, sampling = self.model_cfg, self.ecfg.sampling
+        forward = self._forward_fn
+        nloc = self.ecfg.num_blocks
+        P = jax.sharding.PartitionSpec
+        cache_specs = self._cp_cache_specs()
+
+        def cp_step(params, cache, tokens, positions, slot_ids, rng):
+            r = jax.lax.axis_index(ps.CP_AXIS)
+            tbl = cache.block_tables
+            local = cache.replace(
+                block_tables=self._cp_local_tables(tbl, r, nloc))
+            kw = {"cp_prefill": True} if prefill else {}
+            logits, new_cache = forward(
+                model_cfg, params, tokens, positions, local,
+                slot_ids=slot_ids, **kw)
+            toks = sample(logits[0], rng, sampling)
+            return toks, new_cache.replace(block_tables=tbl)
+
+        row = P(None, ps.CP_AXIS) if prefill else P()
+        flat = P(ps.CP_AXIS) if prefill else P()
+        fn = ps.shard_map(
+            cp_step,
+            in_specs=(P(), cache_specs, row, row, flat, P()),
+            out_specs=(flat, cache_specs))
+        # no donation: the CPU/tier-1 path doesn't donate either, and
+        # shard_map + donation of the sharded pool needs per-backend
+        # care that the TPU tier picks up via the AOT path
+        return jax.jit(fn)
+
     def _build_step(self):
         model_cfg, sampling = self.model_cfg, self.ecfg.sampling
         forward = self._forward_fn
         # donation gives in-place pool update on TPU; CPU donation only
         # warns, so keep it off there
         on_accel = jax.default_backend() in ("tpu", "axon")
+        if self._cp > 1:
+            return self._build_cp_step(prefill=False)
         if self._spec is None:
             def step_fn(params, cache, tokens, positions, slot_ids, rng):
                 logits, cache = forward(
@@ -828,6 +1010,8 @@ class ServingEngine:
             jitted = self._build_spec_draft()
         elif worker == "spec_verify":
             jitted = self._build_spec_verify()
+        elif worker == "cp_prefill":
+            jitted = self._build_cp_step(prefill=True)
         else:
             jitted = self._build_step()
         if self._aot is None:
@@ -858,11 +1042,14 @@ class ServingEngine:
                            for path, x in
                            jax.tree_util.tree_flatten_with_path(
                                self._draft_params)[0]))
+        cp_fp: Tuple[Any, ...] = ()
+        if self._cp > 1:
+            cp_fp = (self._cp, self._cp_width, e.cp_wire_dtype)
         return (repr(self.model_cfg), e.block_size, e.num_blocks,
                 e.max_slots, e.max_blocks_per_seq, e.quantized,
                 str(e.kv_dtype), repr(e.sampling),
                 source_fingerprint(self._forward_fn, sample),
-                params_spec) + spec_fp
+                params_spec) + spec_fp + cp_fp
 
     def _example_args(self, width: int):
         """Abstract-equivalent inputs for AOT lowering: exactly the
@@ -898,6 +1085,9 @@ class ServingEngine:
                 return int(fn._cache_size())
             except Exception:  # pragma: no cover - jit internals moved
                 return -1
+        if self._cp > 1:
+            return {"packed": size(self._step_fn),
+                    "cp_prefill": size(self._prefill_fn)}
         if self.ecfg.disaggregated:
             return {"prefill": size(self._prefill_fn),
                     "decode": size(self._decode_fn)}
@@ -918,15 +1108,25 @@ class ServingEngine:
     def _now(self) -> float:
         return self._clock() - self._t0
 
+    def max_model_len(self) -> int:
+        """Longest request (prompt + new tokens) this engine can ever
+        serve: the model's rope/context bound, the block-table width,
+        and the pool — where cp>1 lifts the pool cap to the GLOBAL
+        ``cp * num_blocks`` blocks (a single mesh's slice is no longer
+        the ceiling; that is the whole point of the long-context
+        tier)."""
+        e = self.ecfg
+        return min(self.model_cfg.max_seq_len,
+                   e.max_blocks_per_seq * e.block_size,
+                   self._pool_blocks * e.block_size)
+
     def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
         """Whether a request of this size could ever run on this engine
         (alone, with the whole pool to itself)."""
-        e = self.ecfg
         total = int(prompt_len) + int(max_new_tokens)
-        blocks_needed = -(-total // e.block_size)
-        return (prompt_len > 0 and total <= self.model_cfg.max_seq_len
-                and blocks_needed <= e.max_blocks_per_seq
-                and blocks_needed <= e.num_blocks)
+        if self._cp > 1 and prompt_len > self._cp_width:
+            return False    # one ring pass must cover the whole prompt
+        return prompt_len > 0 and total <= self.max_model_len()
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                uid: Optional[str] = None,
@@ -1061,8 +1261,12 @@ class ServingEngine:
     def aot_warm(self) -> bool:
         """True when every worker loaded from the AOT cache — this
         engine spun up without compiling anything."""
-        fns = ([self._prefill_fn, self._decode_fn]
-               if self.ecfg.disaggregated else [self._step_fn])
+        if self._cp > 1:
+            fns = [self._step_fn, self._prefill_fn]
+        elif self.ecfg.disaggregated:
+            fns = [self._prefill_fn, self._decode_fn]
+        else:
+            fns = [self._step_fn]
         if self._spec is not None:
             fns += [self._spec_draft_fn, self._spec_verify_fn]
         return all(getattr(fn, "from_cache", False) for fn in fns)
@@ -1275,12 +1479,17 @@ class ServingEngine:
                 "ticket": ticket}
 
     def stream_inject(self, handle: Dict[str, Any], name: str,
-                      layer: int, arr: Any) -> None:
+                      layer: int, arr: Any,
+                      blocks: Optional[Sequence[int]] = None) -> None:
         """Land one verified chunk into the reserved blocks: tensor
         ``name`` (``k``/``v``/``k_scale``/``v_scale`` at ``layer``, or
         the layer-less ``pos``). Chunks may land in any order; each
-        fully overwrites its rows."""
-        idx = jnp.asarray(handle["blocks"], jnp.int32)
+        fully overwrites its rows. ``blocks`` (indices into the
+        reserved block list) lands a CP shard chunk — one source rank's
+        resident slice of the slab — instead of the whole slab."""
+        sel = (handle["blocks"] if blocks is None
+               else [handle["blocks"][int(i)] for i in blocks])
+        idx = jnp.asarray(sel, jnp.int32)
         if name == "pos":
             self.cache = self.cache.replace(
                 pos=self.cache.pos.at[idx].set(
@@ -1511,7 +1720,11 @@ class ServingEngine:
         their decode advances through the draft/verify workers instead
         of a packed decode row."""
         e = self.ecfg
-        if e.disaggregated:
+        if self._cp > 1:
+            decode_budget = e.token_budget
+            prefill_budget = 0      # prompts go through the ring worker
+            shared_budget = False
+        elif e.disaggregated:
             decode_budget = e.max_slots
             prefill_budget = e.prefill_budget or e.token_budget
             shared_budget = False
@@ -1534,6 +1747,8 @@ class ServingEngine:
                 break
             except CacheExhaustedError:
                 self._preempt_youngest(req)
+        if self._cp > 1:
+            return decode_rows, self._build_cp_prefill_rows()
         prefill_rows = []
         used = len(decode_rows) if shared_budget else 0
         for req in sorted((s for s in self._slots
@@ -1556,6 +1771,49 @@ class ServingEngine:
             self.stats.prefill_tokens += chunk
         return decode_rows, prefill_rows
 
+    def _build_cp_prefill_rows(self):
+        """One whole-prompt ring pass per step: take the oldest
+        not-yet-prefilled slot, allocate EVERY prompt block rank-strictly
+        (block ``b`` of the sequence lands on the rank whose token slice
+        writes it — the ring worker's scatter drops the row everywhere
+        else), and emit its rows for the ``cp_prefill`` worker. A prompt
+        whose per-rank slices don't all fit right now simply waits
+        (head-of-line; decode traffic retiring frees blocks) — deferral
+        over preemption keeps the long-context tier livelock-free."""
+        for req in sorted((s for s in self._slots
+                           if s is not None and not s.decoding),
+                          key=lambda r: r.admit_seq):
+            if not self._cp_alloc_prompt(req):
+                return []
+            rows = [(req, req.prompt[pos], pos,
+                     pos == req.prompt_len - 1)
+                    for pos in range(req.prompt_len)]
+            req.n_cached = req.prompt_len
+            self.stats.prefill_tokens += req.prompt_len
+            return rows
+        return []
+
+    def _cp_alloc_prompt(self, req: _RequestState) -> bool:
+        """Rank-strict allocation of all of ``req``'s prompt blocks, or
+        nothing: sequence block ``b`` (positions ``[b*bs, (b+1)*bs)``)
+        belongs to the rank whose contiguous ``cp_prefill_width/cp``
+        token slice covers it. All-or-nothing so a deferred prompt never
+        holds a partial claim."""
+        e = self.ecfg
+        w_loc = self._cp_width // self._cp
+        n_blocks = -(-req.prompt_len // e.block_size)
+        per_rank: Dict[int, List[int]] = {}
+        for b in range(n_blocks):
+            per_rank.setdefault((b * e.block_size) // w_loc, []).append(b)
+        free = self.allocator.free_per_rank()
+        if any(len(bs) > free[r] for r, bs in per_rank.items()):
+            return False
+        for r, bs in per_rank.items():
+            for b, blk in zip(bs, self.allocator.alloc(len(bs), rank=r)):
+                self._tables[req.slot, b] = blk
+                self._slot_blocks[req.slot].append(blk)
+        return True
+
     def _apply_pending_cow(self) -> None:
         """Run the copy-on-write clones registered during scheduling as
         fixed-shape ``[max_slots]`` batches (pad entries: dst ==
@@ -1568,7 +1826,7 @@ class ServingEngine:
         for start in range(0, len(self._pending_cow), m):
             chunk = self._pending_cow[start:start + m]
             src = np.zeros((m,), np.int32)
-            dst = np.full((m,), self.ecfg.num_blocks, np.int32)
+            dst = np.full((m,), self._pool_blocks, np.int32)
             keep = np.zeros((m,), np.int32)
             for i, (s, d, k) in enumerate(chunk):
                 src[i], dst[i], keep[i] = s, d, k
@@ -1775,7 +2033,7 @@ class ServingEngine:
         with tracer.span("engine/cow"):
             self._apply_pending_cow()
         if self._freed_dirty:
-            mask = np.zeros((self.ecfg.num_blocks,), np.bool_)
+            mask = np.zeros((self._pool_blocks,), np.bool_)
             mask[list(self._freed_dirty)] = True
             self._freed_dirty.clear()
             fmask = jnp.asarray(mask)
@@ -1799,18 +2057,24 @@ class ServingEngine:
             self.dcache = self.dcache.replace(block_tables=tbl,
                                               lengths=lens)
         self._rng, sub = jax.random.split(self._rng)
-        if self.ecfg.disaggregated:
+        if self.ecfg.disaggregated or self._cp > 1:
+            cp = self._cp > 1
+            p_width = (self._cp_width if cp
+                       else self.ecfg.prefill_budget
+                       or self.ecfg.token_budget)
+            d_fn = self._step_fn if cp else self._decode_fn
+            d_width = self.ecfg.token_budget if cp else self.ecfg.max_slots
             sampled = np.zeros((len(rows),), np.int32)
             if prefill_rows:          # prefill first: TTFT, and new KV
-                with tracer.span("engine/prefill"):
+                with tracer.span("engine/cp_prefill" if cp
+                                 else "engine/prefill"):
                     sampled[len(decode_rows):] = self._run_worker(
-                        self._prefill_fn, prefill_rows,
-                        self.ecfg.prefill_budget or self.ecfg.token_budget,
+                        self._prefill_fn, prefill_rows, p_width,
                         sub)[:len(prefill_rows)]
             if decode_rows:           # ... lands before decode reads
                 with tracer.span("engine/decode"):
                     sampled[:len(decode_rows)] = self._run_worker(
-                        self._decode_fn, decode_rows, self.ecfg.max_slots,
+                        d_fn, decode_rows, d_width,
                         sub)[:len(decode_rows)]
         else:
             sampled = np.zeros((0,), np.int32)
@@ -1887,7 +2151,7 @@ class ServingEngine:
         self.stats.step_latency_s.append(now - t_start)
         self.stats.last_step_t = now
         self.stats.occupancy.append(
-            self.allocator.num_allocated / self.ecfg.num_blocks)
+            self.allocator.num_allocated / self.allocator.num_blocks)
         self.stats.shared_fraction.append(
             self.allocator.num_shared
             / max(1, self.allocator.num_allocated))

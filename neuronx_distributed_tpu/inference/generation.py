@@ -26,10 +26,16 @@ from .sampling import SamplingConfig, sample
 DECODE_BUCKETS = (64, 256, 1024)
 
 
-def pick_bucket(length: int, buckets: Sequence[int]) -> int:
+def pick_bucket(length: int, buckets: Sequence[int], cp: int = 1) -> int:
     """Smallest bucket >= length (reference: bucketed input shapes,
-    ``model_builder.py:495``)."""
-    ordered = sorted(buckets)
+    ``model_builder.py:495``).
+
+    ``cp > 1`` scales every bucket boundary by the context-parallel
+    degree: the bucket table describes what ONE mesh's slice holds, and
+    a CP group holds ``cp`` slices — so a 128k prompt that busts the
+    single-mesh buckets lands in a regular bucket at cp=4 instead of
+    raising."""
+    ordered = sorted(b * max(1, int(cp)) for b in buckets)
     for b in ordered:
         if b >= length:
             return b

@@ -679,7 +679,9 @@ def serving_state_spec(model_cfg, engine_cfg) -> Dict[str, Any]:
     spec: Dict[str, Any] = {
         "kind": "paged",
         "num_layers": model_cfg.num_layers,
-        "num_blocks": engine_cfg.num_blocks,
+        # num_blocks is per cp rank; the bundle rebuilds the GLOBAL pool
+        "num_blocks": max(1, getattr(engine_cfg, "cp", 1))
+        * engine_cfg.num_blocks,
         "block_size": engine_cfg.block_size,
         "num_kv_heads": model_cfg.num_kv_heads,
         "head_dim": model_cfg.head_dim_,
@@ -710,13 +712,14 @@ def register_serving_workers(builder: ModelBuilder, model_cfg, engine_cfg,
     from .paging import init_paged_kv_cache, init_quantized_paged_kv_cache
 
     e, m = engine_cfg, model_cfg
+    cp = max(1, getattr(e, "cp", 1))
     if e.quantized:
         cache = init_quantized_paged_kv_cache(
-            m.num_layers, e.num_blocks, e.block_size, m.num_kv_heads,
+            m.num_layers, cp * e.num_blocks, e.block_size, m.num_kv_heads,
             m.head_dim_, e.max_slots, e.max_blocks_per_seq)
     else:
         cache = init_paged_kv_cache(
-            m.num_layers, e.num_blocks, e.block_size, m.num_kv_heads,
+            m.num_layers, cp * e.num_blocks, e.block_size, m.num_kv_heads,
             m.head_dim_, e.max_slots, e.max_blocks_per_seq,
             dtype=e.kv_dtype or m.dtype)
 
@@ -730,6 +733,53 @@ def register_serving_workers(builder: ModelBuilder, model_cfg, engine_cfg,
                 jax.ShapeDtypeStruct((1, width), jnp.int32),
                 jax.ShapeDtypeStruct((1, width), jnp.int32),
                 jax.ShapeDtypeStruct((width,), jnp.int32))
+
+    if cp > 1:
+        # the long-context tier's two workers, the same shard_mapped
+        # programs ServingEngine(cp=...) jits: ring prefill over
+        # sequence-sharded rows, combined paged decode over the
+        # block-sharded pool. Registered here so a CP serving process
+        # cold-starts through the AOT path like any other worker.
+        import dataclasses as _dc
+
+        from ..parallel import mesh as ps
+        from jax.sharding import PartitionSpec as P
+
+        cp_cfg = _dc.replace(
+            model_cfg, cp_wire_dtype=getattr(e, "cp_wire_dtype", "int8"))
+        nloc = e.num_blocks
+        cache_specs = cache.replace(
+            k=P(None, ps.CP_AXIS), v=P(None, ps.CP_AXIS),
+            pos=P(ps.CP_AXIS), block_tables=P(), lengths=P())
+
+        def _cp_worker(prefill: bool):
+            def fn(params, cache, tokens, positions, slot_ids):
+                r = jax.lax.axis_index(ps.CP_AXIS)
+                tbl = cache.block_tables
+                loc = tbl - r * nloc
+                loc = jnp.where(
+                    (tbl >= 0) & (loc >= 0) & (loc < nloc), loc, -1)
+                kw = {"cp_prefill": True} if prefill else {}
+                logits, new_cache = llama_forward_with_cache(
+                    cp_cfg, params, tokens, positions,
+                    cache.replace(block_tables=loc),
+                    slot_ids=slot_ids, **kw)
+                return logits, new_cache.replace(block_tables=tbl)
+
+            row = P(None, ps.CP_AXIS) if prefill else P()
+            return ps.shard_map(
+                fn,
+                in_specs=(P(), cache_specs, row, row,
+                          P(ps.CP_AXIS) if prefill else P(), ),
+                out_specs=(row, cache_specs))
+
+        width = (getattr(e, "cp_prefill_width", None)
+                 or e.max_blocks_per_seq * e.block_size)
+        builder.add("cp_ring_prefill", _cp_worker(True), [_args(width)],
+                    priority_model=True)
+        builder.add("cp_token_decode", _cp_worker(False),
+                    [_args(e.token_budget)])
+        return builder
 
     prefill_width = e.prefill_budget or e.token_budget
     builder.add("chunked_prefill", _worker, [_args(prefill_width)],

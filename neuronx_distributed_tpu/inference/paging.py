@@ -117,6 +117,21 @@ class PagedCacheView(struct.PyTreeNode):
     write_idx: jax.Array
 
 
+class CPPrefillView(struct.PyTreeNode):
+    """One layer's LOCAL pool shard plus this rank's write routing for
+    context-parallel ring prefill: the attention itself is ring attention
+    over the cp axis (no block-table gather — every rank sees the whole
+    prompt via the rotating KV chunks), so only the scatter routing
+    rides: ``write_idx [W_local]`` flat indices into this rank's pool
+    shard (pool capacity = drop, for pad rows and rows another rank
+    owns)."""
+
+    k: jax.Array
+    v: jax.Array
+    pos: jax.Array
+    write_idx: jax.Array
+
+
 # Registered for jax.export bundles like the contiguous caches
 # (model_builder packages the KV state spec in its manifest).
 try:
@@ -150,20 +165,25 @@ def init_paged_kv_cache(num_layers: int, num_blocks: int, block_size: int,
 def pool_accounting(num_layers: int, num_blocks: int, block_size: int,
                     num_kv_heads: int, head_dim: int, *,
                     kv_bytes: int = 2, quantized: bool = False,
-                    tp_size: int = 1) -> float:
+                    tp_size: int = 1, cp_size: int = 1) -> float:
     """Bytes per device for the K+V pool arrays the two init functions
     above allocate (K and V of shape ``[L, num_blocks, block_size, KV,
     D]``; the quantized variant stores int8 plus one fp32 scale per pool
     vector, i.e. per ``shape[:-1]`` entry). The KV-head dimension shards
-    over ``tp_size``. The placement planner's memory model
-    (``plan.cost``) charges serving plans through this function so its
-    numbers track the engine's real allocations."""
+    over ``tp_size``; under context-parallel serving the BLOCK dimension
+    shards over ``cp_size`` (each cp rank is resident for ``num_blocks /
+    cp_size`` blocks — the long-context memory term: total pool blocks ÷
+    cp per device). The placement planner's memory model (``plan.cost``)
+    charges serving plans through this function so its numbers track the
+    engine's real allocations."""
+    if cp_size < 1:
+        raise ValueError(f"cp_size must be >= 1, got {cp_size}")
     elems = num_layers * num_blocks * block_size * num_kv_heads * head_dim
     if quantized:
         per_pool = elems * 1 + (elems // max(1, head_dim)) * 4
     else:
         per_pool = elems * kv_bytes
-    return 2.0 * per_pool / max(1, tp_size)
+    return 2.0 * per_pool / max(1, tp_size) / cp_size
 
 
 def init_quantized_paged_kv_cache(num_layers: int, num_blocks: int,
@@ -197,21 +217,48 @@ class BlockAllocator:
     reference drops, and :meth:`free` reports exactly which blocks did
     (the engine's freed-position hygiene must clear those, and only
     those: wiping a still-shared block's positions would blind every
-    surviving reader)."""
+    surviving reader).
 
-    def __init__(self, num_blocks: int):
+    ``cp_size > 1`` splits the id space into ``cp_size`` contiguous rank
+    slices (rank ``r`` owns ``[r * num_blocks/cp, (r+1) * num_blocks/cp)``
+    — exactly how the engine shards the pool's block dim over the ``cp``
+    mesh axis). ``alloc(rank=r)`` is strict placement (CP ring prefill:
+    a token's K/V rows are computed on the rank holding its sequence
+    slice and must land there); ``alloc(rank=None)`` spills to whichever
+    slice has the most free blocks (decode blocks — the flash-decoding
+    combine is position-masked, so any rank may own any decode block) and
+    raises :class:`CacheExhaustedError` only when *every* rank's slice is
+    exhausted of the remaining demand."""
+
+    def __init__(self, num_blocks: int, cp_size: int = 1):
         if num_blocks <= 0:
             raise ValueError(f"num_blocks must be positive, got {num_blocks}")
+        if cp_size < 1:
+            raise ValueError(f"cp_size must be >= 1, got {cp_size}")
+        if num_blocks % cp_size != 0:
+            raise ValueError(
+                f"num_blocks ({num_blocks}) must divide evenly over "
+                f"cp_size ({cp_size}) rank slices")
         self.num_blocks = num_blocks
+        self.cp_size = cp_size
+        self.blocks_per_rank = num_blocks // cp_size
         self.reset()
+
+    def rank_of(self, block: int) -> int:
+        """cp rank whose pool slice holds ``block``."""
+        return block // self.blocks_per_rank
 
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free)
+
+    def free_per_rank(self) -> List[int]:
+        """Free-block count per cp rank slice (``[num_free]`` at cp=1)."""
+        return [len(f) for f in self._free]
 
     @property
     def num_allocated(self) -> int:
-        return self.num_blocks - len(self._free)
+        return self.num_blocks - self.num_free
 
     @property
     def num_shared(self) -> int:
@@ -221,18 +268,33 @@ class BlockAllocator:
     def refcount(self, block: int) -> int:
         return self._refs.get(block, 0)
 
-    def alloc(self, n: int = 1) -> List[int]:
+    def alloc(self, n: int = 1, rank: Optional[int] = None) -> List[int]:
         """Take ``n`` blocks off the free list (refcount 1 each); raises
         :class:`CacheExhaustedError` (allocating nothing) when fewer than
         ``n`` are free — the caller decides whether to preempt, defer, or
-        reject."""
+        reject. ``rank`` pins the allocation to one cp rank's slice
+        (strict: raises when *that slice* cannot cover ``n``); ``None``
+        balances across slices and fails only when the whole pool can't."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
-        if n > len(self._free):
-            raise CacheExhaustedError(
-                f"requested {n} block(s) but only {len(self._free)} of "
-                f"{self.num_blocks} are free")
-        out = [self._free.pop() for _ in range(n)]
+        if rank is not None:
+            if not 0 <= rank < self.cp_size:
+                raise ValueError(
+                    f"rank {rank} out of range for cp_size {self.cp_size}")
+            pool = self._free[rank]
+            if n > len(pool):
+                raise CacheExhaustedError(
+                    f"requested {n} block(s) on cp rank {rank} but only "
+                    f"{len(pool)} of {self.blocks_per_rank} are free")
+            out = [pool.pop() for _ in range(n)]
+        else:
+            if n > self.num_free:
+                raise CacheExhaustedError(
+                    f"requested {n} block(s) but only {self.num_free} of "
+                    f"{self.num_blocks} are free")
+            out = []
+            for _ in range(n):
+                out.append(max(self._free, key=len).pop())
         self._allocated.update(out)
         for b in out:
             self._refs[b] = 1
@@ -256,13 +318,17 @@ class BlockAllocator:
             if self._refs[b] == 0:
                 del self._refs[b]
                 self._allocated.discard(b)
-                self._free.append(b)
+                self._free[self.rank_of(b)].append(b)
                 freed.append(b)
         return freed
 
     def reset(self) -> None:
-        # lowest block ids pop first — keeps tests/debug dumps readable
-        self._free = list(range(self.num_blocks - 1, -1, -1))
+        # lowest block ids pop first (per rank slice) — keeps tests/debug
+        # dumps readable
+        self._free = [
+            list(range((r + 1) * self.blocks_per_rank - 1,
+                       r * self.blocks_per_rank - 1, -1))
+            for r in range(self.cp_size)]
         self._allocated: set = set()
         self._refs: dict = {}
 

@@ -242,6 +242,22 @@ class RouterConfig:
     # streamed KV handoff over a simulated DCN link (see FabricConfig
     # and inference/transport.py). None = classic single-tier fleet.
     fabric: Optional[FabricConfig] = None
+    # long-context replica class: ``long_context_replicas`` extra
+    # replicas (named ``l0..``) built from ``long_context_engine`` — an
+    # EngineConfig with ``cp > 1``, whose context-parallel pool holds
+    # sequences no plain replica can. Requests route to the class when
+    # their prompt reaches ``long_context_threshold`` tokens OR when no
+    # plain replica can fit them at all (the default when the threshold
+    # is None); short traffic stays off the CP replicas while plain
+    # ones are live, so ring-prefill capacity is not burned on prompts
+    # a single mesh handles. In fabric mode ``long_context_engine``
+    # instead rebuilds the *prefill tier* as CP engines: each CP rank's
+    # pool shard streams separately over the wire (StreamConfig
+    # ``cp_shards``) and the decode tier stays plain — commit is still
+    # all-shards-or-nothing.
+    long_context_replicas: int = 0
+    long_context_engine: Optional[EngineConfig] = None
+    long_context_threshold: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -403,6 +419,7 @@ class _Replica:
     generation: int = 0             # bumped per engine replacement, so
     corrupt_bit: Optional[int] = None  # armed chaos bitflip (SDC drill)
     tier: str = "serve"             # "serve" | fabric: "prefill"/"decode"
+    long_context: bool = False      # CP engine (cp>1): long-context class
     assigned: Dict[str, _RouterRequest] = dataclasses.field(  # obs series
         default_factory=dict)       # from before a revival stay distinct
 
@@ -473,6 +490,15 @@ class ReplicaRouter:
         self._link: Optional[DcnLink] = None
         self._tier_scale = {t: {"cooldown": 0, "up": 0, "down": 0}
                             for t in ("prefill", "decode")}
+        lc_cfg = cfg.long_context_engine
+        if lc_cfg is not None and max(1, getattr(lc_cfg, "cp", 1)) <= 1:
+            raise ValueError(
+                "long_context_engine must set cp > 1 — a cp=1 engine is "
+                "just another plain replica")
+        if cfg.long_context_replicas > 0 and lc_cfg is None:
+            raise ValueError(
+                "long_context_replicas > 0 needs a long_context_engine "
+                "(an EngineConfig with cp > 1)")
         if self._fabric is not None:
             if engines is not None:
                 raise ValueError(
@@ -482,9 +508,14 @@ class ReplicaRouter:
             self._link = DcnLink(bandwidth=fb.stream.bandwidth,
                                  latency_s=fb.stream.latency_s,
                                  chaos=chaos)
+            # a long_context_engine upgrades the whole prefill tier to
+            # CP: long prompts ring-prefill across the cp group, then
+            # stream shard-by-shard to plain decode replicas
             self.replicas = [
-                _Replica(name=f"p{i}", engine=self._new_engine(f"p{i}"),
-                         monitor=ReplicaMonitor(cfg), tier="prefill")
+                _Replica(name=f"p{i}",
+                         engine=self._new_engine(f"p{i}", ecfg=lc_cfg),
+                         monitor=ReplicaMonitor(cfg), tier="prefill",
+                         long_context=lc_cfg is not None)
                 for i in range(fb.prefill_replicas)] + [
                 _Replica(name=f"d{i}", engine=self._new_engine(f"d{i}"),
                          monitor=ReplicaMonitor(cfg), tier="decode")
@@ -501,20 +532,30 @@ class ReplicaRouter:
             else:
                 engines = [self._new_engine(f"r{i}")
                            for i in range(cfg.num_replicas)]
+            # injected engines self-classify through their EngineConfig
             self.replicas = [
                 _Replica(name=f"r{i}", engine=eng,
-                         monitor=ReplicaMonitor(cfg))
+                         monitor=ReplicaMonitor(cfg),
+                         long_context=max(
+                             1, getattr(eng.ecfg, "cp", 1)) > 1)
                 for i, eng in enumerate(engines)]
-            for eng in engines:
-                eng._standalone_obs = False  # router owns retirement
+            self.replicas += [
+                _Replica(name=f"l{i}",
+                         engine=self._new_engine(f"l{i}", ecfg=lc_cfg),
+                         monitor=ReplicaMonitor(cfg), long_context=True)
+                for i in range(cfg.long_context_replicas)]
+            for rep in self.replicas:
+                rep.engine._standalone_obs = False  # router retires
         self._replica_seq = cfg.num_replicas  # next fresh replica name
         # declarative SLO layer (see RouterConfig.slo)
         self.slo = SloMonitor(cfg.slo) if cfg.slo is not None else None
         self._slo_active_prev: set = set()
         self._recompute_budget()
 
-    def _new_engine(self, name: Optional[str] = None) -> ServingEngine:
-        eng = ServingEngine(self.model_cfg, self.params, self.ecfg,
+    def _new_engine(self, name: Optional[str] = None,
+                    ecfg: Optional[EngineConfig] = None) -> ServingEngine:
+        eng = ServingEngine(self.model_cfg, self.params,
+                            ecfg if ecfg is not None else self.ecfg,
                             clock=self._clock, aot_cache=self._aot,
                             name=name, draft_cfg=self._draft_cfg,
                             draft_params=self._draft_params)
@@ -527,9 +568,16 @@ class ReplicaRouter:
         constant."""
         if self.cfg.global_token_budget is not None:
             self._budget = self.cfg.global_token_budget
-        else:
-            pool_tokens = self.ecfg.num_blocks * self.ecfg.block_size
-            self._budget = max(1, len(self.replicas)) * pool_tokens
+            return
+        total = 0
+        for rep in self.replicas:
+            # a CP replica's pool is cp per-rank shards wide
+            e = (rep.engine.ecfg if rep.engine is not None
+                 else (self.cfg.long_context_engine
+                       if rep.long_context else self.ecfg))
+            total += (max(1, getattr(e, "cp", 1)) * e.num_blocks
+                      * e.block_size)
+        self._budget = max(1, total)
 
     # -- time / introspection ---------------------------------------------
 
@@ -618,10 +666,23 @@ class ReplicaRouter:
         return uid
 
     def _fits_any(self, req: _RouterRequest) -> bool:
+        # a heterogeneous fleet (plain + long-context class) must probe
+        # every replica class: a 100k prompt fits only the CP engines
+        return any(r.engine is not None and r.engine.fits(
+            len(req.prompt), req.max_new_tokens) for r in self.replicas)
+
+    def _wants_long_context(self, req: _RouterRequest) -> bool:
+        """Route-by-prompt-length: a request belongs on the long-context
+        (CP) class when its prompt reaches the configured threshold, or
+        — with no threshold set — when no plain replica could hold it
+        anyway (capacity is the implicit threshold)."""
+        thr = self.cfg.long_context_threshold
+        if thr is not None:
+            return len(req.prompt) >= thr
         probe = next((r.engine for r in self.replicas
-                      if r.engine is not None), None)
-        # all replicas share one EngineConfig, so any engine answers
-        return probe is not None and probe.fits(
+                      if not r.long_context and r.engine is not None),
+                     None)
+        return probe is None or not probe.fits(
             len(req.prompt), req.max_new_tokens)
 
     def _prefix_credit(self, req: _RouterRequest) -> int:
@@ -730,6 +791,19 @@ class ReplicaRouter:
             # replicas only ever receive committed streams.
             live = [r for r in live if r.tier == "prefill"]
         if not live:
+            return None
+        longs = [r for r in live if r.long_context]
+        plains = [r for r in live if not r.long_context]
+        if longs and plains:
+            if self._wants_long_context(req):
+                live = longs
+            else:
+                live = plains   # keep short traffic off the CP replicas
+        elif not longs and self._wants_long_context(req) and any(
+                r.long_context for r in self.replicas):
+            # the long-context class exists but is down: wait for
+            # revival instead of bouncing off plain replicas that can
+            # never fit this prompt
             return None
         if req.avoid_replica is not None:
             # shadow probes must land on *different* hardware than the
@@ -901,7 +975,10 @@ class ReplicaRouter:
                 # bumped generation so its obs series don't alias the
                 # dead engine's, and warm-starts its prefix trie from
                 # the hottest survivor instead of coming back cold
-                rep.engine = self._new_engine(rep.name)
+                rep.engine = self._new_engine(
+                    rep.name,
+                    ecfg=(self.cfg.long_context_engine
+                          if rep.long_context else None))
                 rep.generation += 1
                 self._warm_prefix(rep)
             rep.state = "probation"
@@ -1023,9 +1100,14 @@ class ReplicaRouter:
                 tracer.request_import(ticket.trace)
                 tracer.request_phase_begin(uid, "handoff")
             route = f"{rep.name}->{dest.name}/{uid}"
+            scfg = self._fabric.stream
+            cp = max(1, getattr(rep.engine.ecfg, "cp", 1))
+            if cp > 1 and scfg.cp_shards == 1:
+                # CP prefill tier: each rank's pool shard flies as its
+                # own chunk run; commit stays all-shards-or-nothing
+                scfg = dataclasses.replace(scfg, cp_shards=cp)
             tr = KVStreamTransport(
-                ticket, dest.engine, self._link, route,
-                self._fabric.stream,
+                ticket, dest.engine, self._link, route, scfg,
                 on_precommit=self._finish_handoff_trace)
             self._streams[route] = {"tr": tr, "req": req, "dest": dest,
                                     "src": rep.name}

@@ -121,6 +121,13 @@ class StreamConfig:
     max_chunk_attempts: int = 4
     ack_timeout_s: float = 0.05
     backoff_base_s: float = 0.02
+    # CP prefill-tier handoff: >1 splits every per-layer K/V slab (and
+    # the position slab) into this many disjoint block-subset chunks —
+    # each CP rank streams the blocks its pool shard owns, concurrently
+    # on the wire. Commit stays all-shards-or-nothing: the atomic
+    # commit already requires every chunk of every shard acked, so a
+    # torn shard aborts the whole session, never lands part of it.
+    cp_shards: int = 1
 
     def __post_init__(self) -> None:
         if self.wire_dtype not in ("auto", "fp32", "int8", "fp8"):
@@ -129,6 +136,8 @@ class StreamConfig:
                 f"{self.wire_dtype!r}")
         if self.max_chunk_attempts < 1:
             raise ValueError("max_chunk_attempts must be >= 1")
+        if self.cp_shards < 1:
+            raise ValueError("cp_shards must be >= 1")
 
 
 # ---------------------------------------------------------------------------
@@ -155,17 +164,22 @@ def _payload_fp(payload: bytes) -> int:
 def encode_chunk(stream: str, seq: int, kind: str, tensor: str,
                  layer: int, payload_arr: Optional[np.ndarray],
                  raw_payload: Optional[bytes] = None,
-                 codec: Optional[CompressionConfig] = None) -> bytes:
+                 codec: Optional[CompressionConfig] = None,
+                 part: Optional[List[int]] = None) -> bytes:
     """One wire chunk: magic + JSON header line + payload bytes. Data
     chunks carry ``payload_arr`` (raw, or through the blockwise codec
     when ``codec`` quantizes); the meta chunk carries ``raw_payload``
-    (an already-serialized ticket). The header records everything the
-    receiver needs to rebuild the tensor *and* a fingerprint of the
-    payload bytes, so corruption is detected per-chunk, not
-    per-session."""
+    (an already-serialized ticket). ``part`` marks a CP shard chunk:
+    the payload covers only these block indices of the session's block
+    list (one rank's resident slice), not the whole slab. The header
+    records everything the receiver needs to rebuild the tensor *and*
+    a fingerprint of the payload bytes, so corruption is detected
+    per-chunk, not per-session."""
     head: Dict[str, Any] = {"stream": stream, "seq": int(seq),
                             "kind": kind, "tensor": tensor,
                             "layer": int(layer)}
+    if part is not None:
+        head["part"] = [int(b) for b in part]
     if raw_payload is not None:
         payload = raw_payload
         head.update(dtype=None, shape=None, codec=None)
@@ -403,7 +417,8 @@ class KVStreamTransport:
         self.reason: Optional[str] = None
         self.stats = TransportStats()
         self._handle: Optional[Dict[str, Any]] = None
-        self._stash: List[Tuple[str, int, np.ndarray]] = []
+        self._stash: List[Tuple[str, int, np.ndarray,
+                                Optional[List[int]]]] = []
         self._n_acked = 0
         self._tx: List[Dict[str, Any]] = []
         for seq, wire in enumerate(self._encode_stream()):
@@ -455,9 +470,23 @@ class KVStreamTransport:
                     items.append((name, l, np.asarray(kv[name][l]),
                                   codec))
         items.append(("pos", -1, np.asarray(kv["pos"], np.int32), None))
-        for seq0, (name, layer, arr, codec) in enumerate(items):
+        # CP prefill tier: each rank streams the block slice its pool
+        # shard owns — every slab splits into cp_shards disjoint
+        # block-subset chunks (block axis is 0 on every extracted slab)
+        shards = max(1, int(cfg.cp_shards))
+        pieces: List[Tuple[str, int, np.ndarray,
+                           Optional[CompressionConfig],
+                           Optional[List[int]]]] = []
+        for name, layer, arr, codec in items:
+            if shards == 1 or arr.shape[0] < shards:
+                pieces.append((name, layer, arr, codec, None))
+                continue
+            for sel in np.array_split(np.arange(arr.shape[0]), shards):
+                pieces.append((name, layer, arr[sel], codec,
+                               [int(i) for i in sel]))
+        for seq0, (name, layer, arr, codec, part) in enumerate(pieces):
             wire = encode_chunk(t.uid, seq0 + 1, "data", name, layer,
-                                arr, codec=codec)
+                                arr, codec=codec, part=part)
             nl = wire.find(b"\n", len(CHUNK_MAGIC)) + 1
             self.stats.wire_payload_bytes += len(wire) - nl
             if name in ("k", "v", "pos"):
@@ -555,16 +584,18 @@ class KVStreamTransport:
             except (RequestRejected, CacheExhaustedError) as e:
                 self.abort(f"destination refused the stream: {e}")
                 return
-            for name, layer, stashed in self._stash:
+            for name, layer, stashed, part in self._stash:
                 self.dest.stream_inject(self._handle, name, layer,
-                                        stashed)
+                                        stashed, blocks=part)
             self._stash.clear()
         else:
             if self._handle is None:
-                self._stash.append((head["tensor"], head["layer"], arr))
+                self._stash.append((head["tensor"], head["layer"], arr,
+                                    head.get("part")))
             else:
                 self.dest.stream_inject(self._handle, head["tensor"],
-                                        head["layer"], arr)
+                                        head["layer"], arr,
+                                        blocks=head.get("part"))
         self._tx[seq]["acked"] = True
         self._n_acked += 1
         if self._n_acked == len(self._tx):

@@ -96,6 +96,15 @@ class LlamaConfig:
     # "ring_pallas" (ring with the flash kernel fused into each step), or
     # "ulysses" (all-to-all seq<->head resharding; needs heads % cp == 0)
     cp_attn_impl: str = "ring"
+    # wire dtype for the CP ring's KV ppermute hops (serving CP prefill;
+    # ops/ring_attention wire= codec): "fp32" ships full precision and is
+    # BITWISE identical to the pre-codec ring (the fallback knob);
+    # "int8"/"fp8" blockwise-quantize each hop through wire_codec at
+    # ~3.94x/~3.9x wire reduction (EQuARX, PAPERS.md). Serving threads
+    # EngineConfig.cp_wire_dtype here; the training ring ignores it (the
+    # quantizer has zero gradient).
+    cp_wire_dtype: str = "fp32"
+    cp_wire_block_size: int = 256
     # attention-probability dropout (training path only; active iff a
     # "dropout" rng is supplied to apply()). In-kernel on the flash path
     # via counter-based masks (reference seed plumbing:
@@ -136,6 +145,10 @@ class LlamaConfig:
             raise ValueError(
                 f"cp_attn_impl must be 'ring', 'ring_pallas' or "
                 f"'ulysses', got {self.cp_attn_impl!r}")
+        if self.cp_wire_dtype not in ("fp32", "int8", "fp8"):
+            raise ValueError(
+                f"cp_wire_dtype must be 'fp32', 'int8' or 'fp8', got "
+                f"{self.cp_wire_dtype!r}")
         validate_remat_policy(self.remat_policy)
         # raises on unknown wire dtypes / bad block sizes
         cm.wire_config(self.activation_comm_dtype,
@@ -201,6 +214,41 @@ def _is_paged_cache_view(cache) -> bool:
     return isinstance(cache, PagedCacheView)
 
 
+def _is_cp_prefill_view(cache) -> bool:
+    from ..inference.paging import CPPrefillView
+
+    return isinstance(cache, CPPrefillView)
+
+
+def _cp_prefill_attend(cfg: LlamaConfig, q, k, v, positions, view):
+    """Context-parallel ring prefill against the CP-sharded paged pool:
+    scatter this rank's chunk of K/V rows into the LOCAL pool shard at
+    the precomputed flat indices (rows another rank owns carry the drop
+    sentinel), then attend the whole prompt with ring attention — the KV
+    chunks rotate around the cp ring, quantized per
+    ``cfg.cp_wire_dtype``. Called inside shard_map with the cp axis
+    bound; the packed batch is this rank's ``[1, W_local]`` slice of the
+    right-padded prompt, so ring's global arange coordinates equal the
+    true token positions and causality is exact across ranks."""
+    import math as _math
+
+    from ..inference import paging
+    from ..ops.ring_attention import ring_attention
+
+    k_rows, v_rows = k[0], v[0]                      # [W_local, KV, D]
+    new_k = paging.write_pool_rows(view.k, k_rows, view.write_idx)
+    new_v = paging.write_pool_rows(view.v, v_rows, view.write_idx)
+    n_rep = q.shape[2] // k.shape[2]
+    kf = attn_mod.repeat_kv(k, n_rep)
+    vf = attn_mod.repeat_kv(v, n_rep)
+    out = ring_attention(q, kf, vf, causal=True,
+                         scale=1.0 / _math.sqrt(q.shape[-1]),
+                         wire_dtype=cfg.cp_wire_dtype,
+                         wire_block_size=cfg.cp_wire_block_size)
+    new_view = view.replace(k=new_k, v=new_v)
+    return out.astype(cfg.dtype), new_view
+
+
 def _paged_cache_attend(cfg: LlamaConfig, q, k, v, positions, view):
     """Attention against the paged block pool: (optionally quantize and)
     scatter this step's K/V rows into the layer's pool slice at the
@@ -214,7 +262,15 @@ def _paged_cache_attend(cfg: LlamaConfig, q, k, v, positions, view):
     from ..inference import paging
     from ..inference.kv_cache import quantize_kv
     from ..ops.paged_attention import paged_attention
+    from ..parallel import comm
 
+    # inside a cp shard_map the pool's block dim is sharded over the cp
+    # axis: each rank writes only the rows it owns (the engine's wrapper
+    # localises the tables, non-resident rows carry the drop sentinel)
+    # and attends its resident blocks; partials merge with the
+    # flash-decoding combine (paged/flash-decoding hybrid)
+    cp = comm._axis_size(ps.CP_AXIS)
+    combine = ps.CP_AXIS if cp not in (None, 1) else None
     k_rows, v_rows = k[0], v[0]                      # [T, KV_local, D]
     if view.k_scale is not None:
         qk, ks = quantize_kv(k_rows)
@@ -231,7 +287,8 @@ def _paged_cache_attend(cfg: LlamaConfig, q, k, v, positions, view):
         q[0], new_k, new_v, view.pos, view.tables, positions[0],
         k_scale=new_ks, v_scale=new_vs,
         scale=1.0 / _math.sqrt(q.shape[-1]),
-        force_pallas=cfg.attn_force_pallas)[None]
+        force_pallas=cfg.attn_force_pallas,
+        combine_axis=combine)[None]
     new_view = view.replace(k=new_k, v=new_v, k_scale=new_ks,
                             v_scale=new_vs)
     return out.astype(cfg.dtype), new_view
@@ -273,7 +330,13 @@ class LlamaAttention(nn.Module):
         q = attn_mod.apply_rotary(q, cos, sin, positions)
         k = attn_mod.apply_rotary(k, cos, sin, positions)
         new_cache = None
-        if cache is not None and _is_paged_cache_view(cache):
+        if cache is not None and _is_cp_prefill_view(cache):
+            # CP ring prefill (inference/engine.py cp>1): write this
+            # rank's rows into the local pool shard, ring-attend the
+            # whole prompt across the cp axis
+            out, new_cache = _cp_prefill_attend(cfg, q, k, v, positions,
+                                                cache)
+        elif cache is not None and _is_paged_cache_view(cache):
             # paged pool (inference/paging.py): write this step's rows at
             # the precomputed flat indices, gather-attend via block tables
             out, new_cache = _paged_cache_attend(cfg, q, k, v, positions,
@@ -610,6 +673,28 @@ class _PagedScanBody(nn.Module):
         return x, (new_view.k, new_view.v)
 
 
+class _CPPrefillScanBody(nn.Module):
+    """nn.scan body for context-parallel ring prefill: carries hidden
+    states, maps each layer's LOCAL pool shard (leading layer dim)
+    through, broadcasts the rank's write routing. Parameter layout is
+    identical to :class:`_PagedScanBody` (same ``layer`` scope), so the
+    same checkpoint serves the ring-prefill and paged-decode workers."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cache_kv, pool_pos, write_idx, cos, sin,
+                 positions):
+        from ..inference.paging import CPPrefillView
+
+        k_l, v_l = cache_kv
+        view = CPPrefillView(k=k_l, v=v_l, pos=pool_pos,
+                             write_idx=write_idx)
+        x, new_view = LlamaDecoderLayer(self.cfg, name="layer")(
+            x, cos, sin, positions, cache=view, cache_index=None)
+        return x, (new_view.k, new_view.v)
+
+
 class LlamaModel(nn.Module):
     """Transformer body: embedding + decoder stack + final norm."""
 
@@ -778,7 +863,8 @@ class LlamaForCausalLM(nn.Module):
 
 def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
                              positions: jax.Array, kv_cache,
-                             return_hidden: bool = False, slot_ids=None):
+                             return_hidden: bool = False, slot_ids=None,
+                             cp_prefill: bool = False):
     """KV-cached forward for prefill ("context_encoding") and decode
     ("token_generation") — the two compiled graphs of the reference's
     serving path (``trace/model_builder.py:495`` keys).
@@ -794,6 +880,15 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
     token (``input_ids [1, T]``) to its cache slot; K/V land in the slot's
     block-table blocks instead of at a contiguous write index. Contiguous
     callers are untouched.
+
+    ``cp_prefill=True`` (paged caches only, inside shard_map with the cp
+    axis bound): attention per layer is ring attention over the cp axis
+    instead of the block-table gather — ``input_ids``/``positions``/
+    ``slot_ids`` are this rank's ``[1, W_local]`` slice of the
+    right-padded prompt, ``kv_cache`` the LOCAL pool shard with
+    rank-local block tables, and each rank scatters only the K/V rows it
+    computes. One pass prefills the whole prompt with compute split
+    ``1/cp`` per rank (the CP prefill tier's TTFT lever).
     """
     from ..inference.kv_cache import KVCache, QuantizedKVCache
     from ..inference.paging import PagedKVCache, QuantizedPagedKVCache
@@ -842,18 +937,35 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
         cache_kv = ((kv_cache.k, kv_cache.v, kv_cache.k_scale,
                      kv_cache.v_scale) if quantized
                     else (kv_cache.k, kv_cache.v))
-        scanned = nn.scan(
-            _PagedScanBody,
-            variable_axes={"params": 0},
-            split_rngs={"params": True},
-            in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
-                     nn.broadcast, nn.broadcast, nn.broadcast),
-            out_axes=0,
-            length=cfg.num_layers,
-        )(cfg)
-        x, new_kv = scanned.apply(
-            {"params": p["model"]["layers"]}, x, cache_kv, slot_pos,
-            tok_tables, write_idx, cos, sin, rope_pos)
+        if cp_prefill:
+            if quantized:
+                raise ValueError(
+                    "cp_prefill does not support quantized paged caches")
+            scanned = nn.scan(
+                _CPPrefillScanBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
+                         nn.broadcast, nn.broadcast),
+                out_axes=0,
+                length=cfg.num_layers,
+            )(cfg)
+            x, new_kv = scanned.apply(
+                {"params": p["model"]["layers"]}, x, cache_kv, slot_pos,
+                write_idx, cos, sin, rope_pos)
+        else:
+            scanned = nn.scan(
+                _PagedScanBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
+                         nn.broadcast, nn.broadcast, nn.broadcast),
+                out_axes=0,
+                length=cfg.num_layers,
+            )(cfg)
+            x, new_kv = scanned.apply(
+                {"params": p["model"]["layers"]}, x, cache_kv, slot_pos,
+                tok_tables, write_idx, cos, sin, rope_pos)
     else:
         # record this step's true positions in the slot-position table
         # (pads carry the PAD_POSITION sentinel and are thereby never

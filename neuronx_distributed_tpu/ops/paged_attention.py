@@ -46,7 +46,7 @@ from .pallas_utils import compiler_params as _compiler_params
 
 
 def _paged_attention_xla(q, k_pool, v_pool, pool_pos, tables, q_pos,
-                         k_scale, v_scale, scale):
+                         k_scale, v_scale, scale, combine_axis=None):
     t, n, d = q.shape
     nb, bs, kv, _ = k_pool.shape
     n_rep = n // kv
@@ -68,8 +68,23 @@ def _paged_attention_xla(q, k_pool, v_pool, pool_pos, tables, q_pos,
                         k_full.astype(jnp.float32)) * scale
     mask = q_pos[:, None, None, None] >= pg[:, None, None, :]
     scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bnqk,bknd->bqnd", probs, v_full.astype(jnp.float32))
+    if combine_axis is None:
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bnqk,bknd->bqnd", probs,
+                         v_full.astype(jnp.float32))
+        return out[:, 0].astype(q.dtype)
+    # flash-decoding combine over CP-sharded resident blocks: each rank
+    # attends its local gather; one pmax + two psums merge the partials
+    # (reference combine_kv_on_device, trace/spmd.py:74). The global max
+    # makes fully-masked shards (a token with no resident blocks on this
+    # rank) contribute exp(-1e30 - m) == 0 rather than a local uniform.
+    m = jax.lax.pmax(jnp.max(scores, axis=-1), combine_axis)   # [T,N,1]
+    p = jnp.exp(scores - m[..., None])
+    l = jax.lax.psum(jnp.sum(p, axis=-1), combine_axis)        # [T,N,1]
+    o = jax.lax.psum(
+        jnp.einsum("bnqk,bknd->bqnd", p, v_full.astype(jnp.float32)),
+        combine_axis)                                          # [T,1,N,D]
+    out = o / jnp.maximum(l[..., 0], 1e-30)[:, None, :, None]
     return out[:, 0].astype(q.dtype)
 
 
@@ -189,7 +204,8 @@ def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
                     k_scale: Optional[jax.Array] = None,
                     v_scale: Optional[jax.Array] = None,
                     scale: Optional[float] = None,
-                    force_pallas: Optional[bool] = None) -> jax.Array:
+                    force_pallas: Optional[bool] = None,
+                    combine_axis: Optional[str] = None) -> jax.Array:
     """Paged decode attention.
 
     ``q [T, N, D]`` one query row per packed token; ``k_pool``/``v_pool``
@@ -201,6 +217,14 @@ def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
 
     ``force_pallas``: ``True`` forces the TPU kernel (interpret mode off
     TPU), ``False`` forces the XLA reference, ``None`` auto-selects.
+
+    ``combine_axis``: name of a bound mesh axis over which the block pool
+    is sharded (context-parallel serving). Each rank gathers only its
+    resident blocks (``tables`` carry rank-local ids, -1 elsewhere) and
+    the partials merge with the flash-decoding log-sum-exp combine —
+    one pmax and two psums regardless of session length. Must be called
+    inside ``shard_map`` with the axis bound; implies the XLA path (the
+    Pallas kernel computes no cross-rank combine).
     """
     t, n, d = q.shape
     nb, bs, kv, _ = k_pool.shape
@@ -211,6 +235,12 @@ def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
     scale_ = (1.0 / math.sqrt(d)) if scale is None else scale
 
     tileable = d % 128 == 0 and bs % 128 == 0 and n % 8 == 0
+    if combine_axis is not None:
+        # the CP merge lives in XLA-land (collectives between the local
+        # gather and the normalisation); the kernel path has no axis
+        return _paged_attention_xla(q, k_pool, v_pool, pool_pos, tables,
+                                    q_pos, k_scale, v_scale, scale_,
+                                    combine_axis=combine_axis)
     if force_pallas:
         interpret = jax.default_backend() == "cpu"
         if not interpret and not tileable:

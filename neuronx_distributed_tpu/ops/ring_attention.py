@@ -38,7 +38,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    causal: bool = True,
                    scale: Optional[float] = None,
                    dropout_p: float = 0.0,
-                   dropout_seed: Optional[jax.Array] = None) -> jax.Array:
+                   dropout_seed: Optional[jax.Array] = None,
+                   wire=None,
+                   wire_dtype: Optional[str] = None,
+                   wire_block_size: int = 256) -> jax.Array:
     """Ring attention over the cp axis.
 
     ``q/k/v: [B, S_local, N, D]`` — this rank's sequence slice, kv already
@@ -53,8 +56,28 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     changing tp changes the draw, as in the reference's per-rank seed
     plumbing, ``kernels/ring_attention_kernel.py``.)
 
+    ``wire`` / ``wire_dtype``: quantize the KV ring hops through the
+    shared wire codec (EQuARX-style blockwise int8/fp8,
+    :mod:`..parallel.wire_codec`): each ppermute ships the quantized
+    payload plus its fp32 block scales and the receiver dequantizes
+    before accumulating. ``wire`` takes a :class:`CompressionConfig`
+    directly; ``wire_dtype`` (``"int8"``/``"fp8"``) builds one with
+    ``wire_block_size``-element blocks. ``None``/``"fp32"`` keeps the
+    hops at full precision and is BITWISE identical to the pre-wire ring
+    (the fallback knob serving exposes as ``cp_wire_dtype="fp32"``).
+    Each hop requantizes the visiting chunk, so a chunk that travels
+    ``j`` hops has been through ``j`` round-trips — inference-only
+    (rounding has zero gradient; the training path never passes ``wire``).
+
     Returns ``[B, S_local, N, D]``.
     """
+    from ..parallel.wire_codec import CompressionConfig
+
+    if wire is None and wire_dtype is not None and wire_dtype != "fp32":
+        wire = CompressionConfig(dtype=wire_dtype,
+                                 block_size=wire_block_size)
+    if wire is not None and not wire.quantized:
+        wire = None
     cp = comm._axis_size(axis)
     if cp is None or cp == 1:
         from ..modules.attention import sdpa_reference
@@ -111,8 +134,22 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     def step(carry, i):
         m_prev, l_prev, acc, k_cur, v_cur = carry
         m_new, l_new, acc = accumulate((m_prev, l_prev, acc), k_cur, v_cur, i)
-        k_next = comm.ppermute(k_cur, axis, ring_perm)
-        v_next = comm.ppermute(v_cur, axis, ring_perm)
+        if wire is None:
+            k_next = comm.ppermute(k_cur, axis, ring_perm)
+            v_next = comm.ppermute(v_cur, axis, ring_perm)
+        else:
+            # quantized hop: the int8/fp8 payload and its fp32 block
+            # scales ride the same ring permute; dequantize on arrival
+            from ..parallel.wire_codec import decode_payload, encode_payload
+
+            kq, ks = encode_payload(k_cur, wire)
+            vq, vs = encode_payload(v_cur, wire)
+            kq = comm.ppermute(kq, axis, ring_perm)
+            ks = comm.ppermute(ks, axis, ring_perm)
+            vq = comm.ppermute(vq, axis, ring_perm)
+            vs = comm.ppermute(vs, axis, ring_perm)
+            k_next = decode_payload(kq, ks, wire).astype(k_cur.dtype)
+            v_next = decode_payload(vq, vs, wire).astype(v_cur.dtype)
         return (m_new, l_new, acc, k_next, v_next), None
 
     m0 = jnp.full((b, n, s_local), -jnp.inf, jnp.float32)
@@ -355,6 +392,39 @@ def _audit_ring_attention() -> BuiltEntry:
     mesh = ps.initialize_model_parallel(context_parallel_size=4)
     fn = jax.jit(ps.shard_map(
         lambda q, k, v: ring_attention(q, k, v),
+        mesh, in_specs=(P(None, "cp", None, None),) * 3,
+        out_specs=P(None, "cp", None, None)))
+    q = jnp.zeros((2, 32, 4, 8), jnp.float32)
+    return BuiltEntry(fn=fn, args=(q, q, q), mesh=mesh)
+
+
+@register_entry_point(
+    "ring-attention-int8",
+    description="cp ring attention with int8 quantized KV hops: each "
+                "ppermute ships the wire-codec payload + fp32 block "
+                "scales (CP prefill serving tier)",
+    tags=("serve",),
+    wire_dtype="int8",
+    # the fp32 *scales* legitimately ride the ring beside the int8
+    # payload: at the audit shapes they are 64 elements per hop, below
+    # this floor; the KV payloads themselves (4096 elements) would trip
+    # the wire-precision rule if they ever shipped unquantized
+    wire_min_elems=128,
+    in_shardings=((None, "cp", None, None),) * 3,
+    max_replicated_bytes=1 << 20,
+)
+def _audit_ring_attention_int8() -> BuiltEntry:
+    """Builder for ``analysis --jaxpr``/``--mesh-protocol``: the serving
+    ring with quantized hops on a 4-way cp mesh. The wire-precision rule
+    verifies no wide-float KV payload rides a ring primitive — only the
+    int8 values and their (small) scale tensors may appear."""
+    from jax.sharding import PartitionSpec as P
+
+    if ps.model_parallel_is_initialized():
+        ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(context_parallel_size=4)
+    fn = jax.jit(ps.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, wire_dtype="int8"),
         mesh, in_specs=(P(None, "cp", None, None),) * 3,
         out_specs=P(None, "cp", None, None)))
     q = jnp.zeros((2, 32, 4, 8), jnp.float32)

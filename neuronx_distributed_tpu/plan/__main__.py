@@ -225,6 +225,12 @@ def main(argv=None) -> int:
                 branches=args.serving_spec_branches,
                 accept_rate=args.serving_spec_accept,
                 draft_cost_ratio=args.serving_spec_draft_cost)
+        # context-parallel ladder: every cp degree the device count can
+        # host next to the chosen tp — long-context mixes whose pool
+        # cannot fit one device surface a cp>1 engine, short mixes
+        # keep picking cp=1
+        free = max(1, args.devices // best.tp)
+        cps = tuple(c for c in range(1, free + 1) if free % c == 0)
         plans = serving_search(spec, hw, traffic,
                                slo_ttft_p99_s=ttft_tgt,
                                slo_tpot_p99_s=tpot_tgt,
@@ -232,6 +238,7 @@ def main(argv=None) -> int:
                                disaggregated=args.disaggregated,
                                cross_host=args.cross_host,
                                speculation=spec_term,
+                               cps=cps,
                                top_k=args.top_k)
         print(f"serving plan: rate={traffic.request_rate:g} req/s, "
               f"prompt={traffic.prompt_tokens:g}, "
@@ -269,6 +276,11 @@ def main(argv=None) -> int:
               "ms):")
         kw = ", ".join(f"{k}={v!r}" for k, v in chosen.engine.items())
         print(f"EngineConfig({kw})")
+        cp_deg = chosen.engine.get("cp", 1)
+        if cp_deg > 1:
+            print(f"serving mesh: initialize_model_parallel("
+                  f"context_parallel_size={cp_deg}, "
+                  f"tensor_parallel_size={best.tp})")
         if chosen.router:
             print(f"router: {_json.dumps(chosen.router)}")
 

@@ -216,6 +216,8 @@ class Plan:
 
     def describe(self) -> str:
         tags = [f"tp={self.tp}", f"pp={self.pp}", f"dp={self.dp}"]
+        if self.cp > 1:
+            tags.append(f"cp={self.cp}")
         if self.ep > 1:
             tags.append(f"ep={self.ep}")
         if self.dcn_dp > 1:
@@ -438,8 +440,19 @@ def memory_bytes(plan: Plan, m: ModelSpec, hw: HardwareSpec,
                  serving: Optional[ServingSpec] = None) -> dict:
     """Per-device bytes: fp32 masters + bf16 compute copy + fp32 grads +
     Adam moments (ZeRO-1 shards the moments over the dp group), layer
-    activations under remat/SP, and the paged-KV pool for serving."""
+    activations under remat/SP, and the paged-KV pool for serving.
+
+    A serving plan carries *inference* state: one compute-dtype weight
+    copy and the paged pool (÷ cp for the long-context tier) — no
+    grads, no optimizer moments, and no training-length activations
+    (the packed step's activations are token_budget-wide, noise next
+    to the pool)."""
     shard = param_count(m) / (plan.tp * plan.pp)
+    if serving is not None:
+        params = shard * m.act_bytes
+        kv = _kv_pool_bytes(m, serving, plan.tp, cp=plan.cp)
+        return dict(params=params, grads=0.0, opt=0.0, acts=0.0, kv=kv,
+                    total=params + kv)
     params = shard * (m.param_bytes + m.act_bytes)   # master + compute copy
     grads = shard * 4.0
     opt = shard * 8.0 / (plan.dp if plan.zero1 else 1)
@@ -458,16 +471,18 @@ def memory_bytes(plan: Plan, m: ModelSpec, hw: HardwareSpec,
 
     kv = 0.0
     if serving is not None:
-        kv = _kv_pool_bytes(m, serving, plan.tp)
+        kv = _kv_pool_bytes(m, serving, plan.tp, cp=plan.cp)
     total = params + grads + opt + acts + kv
     return dict(params=params, grads=grads, opt=opt, acts=acts, kv=kv,
                 total=total)
 
 
-def _kv_pool_bytes(m: ModelSpec, s: ServingSpec, tp: int) -> float:
+def _kv_pool_bytes(m: ModelSpec, s: ServingSpec, tp: int,
+                   cp: int = 1) -> float:
     """Paged-pool bytes per device; delegates to the pool's own accounting
     (``inference.paging.pool_accounting``) so planner numbers track the
-    arrays the engine actually allocates. Falls back to the closed form
+    arrays the engine actually allocates — including the long-context
+    tier's pool-blocks-over-cp sharding. Falls back to the closed form
     when jax isn't importable (pure-math contexts)."""
     try:
         from ..inference.paging import pool_accounting
@@ -476,11 +491,11 @@ def _kv_pool_bytes(m: ModelSpec, s: ServingSpec, tp: int) -> float:
             num_layers=m.layers, num_blocks=s.num_blocks,
             block_size=s.block_size, num_kv_heads=m.kv_heads,
             head_dim=m.head_dim_, kv_bytes=s.kv_bytes,
-            quantized=s.quantized, tp_size=tp)
+            quantized=s.quantized, tp_size=tp, cp_size=cp)
     except ImportError:  # pragma: no cover - jax-free fallback
         per_elem = (1 + 4.0 / m.head_dim_) if s.quantized else s.kv_bytes
         return (2.0 * m.layers * s.num_blocks * s.block_size
-                * m.kv_heads * m.head_dim_ * per_elem) / tp
+                * m.kv_heads * m.head_dim_ * per_elem) / (tp * max(1, cp))
 
 
 # ---------------------------------------------------------------------------
@@ -747,7 +762,8 @@ def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
                  prefill_budget: Optional[int] = None,
                  quantized: bool = False, tp: int = 1,
                  cross_host: bool = False,
-                 speculation: Optional[SpeculationSpec] = None
+                 speculation: Optional[SpeculationSpec] = None,
+                 cp: int = 1, cp_wire_dtype: str = "int8"
                  ) -> ServingCost:
     """Steady-state TTFT / TPOT / goodput of one continuous-batching
     engine (``inference.engine.ServingEngine``) under Poisson load.
@@ -775,7 +791,16 @@ def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
     and the chained draft forwards stretch the step wall by
     ``draft_cost_ratio`` — the same row-pricing the router's admission
     surcharge applies, so the planner and the admission controller
-    agree on what a speculated token costs."""
+    agree on what a speculated token costs.
+
+    With ``cp > 1`` the engine is the long-context tier: ``cp`` ranks
+    ring-prefill the prompt together (each takes a sequence slice, so
+    the prefill wall divides by ``cp``), and each ring hop ships the
+    slice's KV quantized at ``cp_wire_dtype``
+    (``ops.ring_attention`` wire hops) — the ``cp - 1`` hops' wire
+    time lands in TTFT. Decode cost is unchanged: per-rank paged
+    attention over resident blocks with a flash-decoding combine is
+    one collective the overhead intercept already absorbs."""
     t = traffic
     token_s = serving_token_s(
         m, hw, context=t.prompt_tokens + t.new_tokens / 2.0,
@@ -814,11 +839,23 @@ def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
         prefill_rows = float(max(1, prefill_budget))
     else:
         prefill_rows = max(1.0, token_budget - conc)
-    prefill_steps = (math.ceil(prompt_eff / prefill_rows)
+    # context parallelism slices the prompt over cp ranks: each rank
+    # prefills prompt/cp tokens, so the wall divides by cp
+    cp = max(1, cp)
+    prefill_steps = (math.ceil(prompt_eff / (prefill_rows * cp))
                      if prompt_eff > 0 else 0)
     rho_q = min(rho, 0.99)
     wait = rho_q / (1.0 - rho_q) * step_s
     ttft = wait + (prefill_steps + 1) * step_s
+    if cp > 1 and prompt_eff > 0:
+        # ring-attention KV hops: over a full ring pass each rank ships
+        # its (prompt/cp)-token KV slice to cp-1 neighbors, quantized at
+        # cp_wire_dtype, once per layer (latency per hop per layer)
+        elems = 2.0 * m.layers * m.kv_heads * m.head_dim_ * prompt_eff
+        hop_bytes = (elems * wire_bytes_per_element(cp_wire_dtype)
+                     * (cp - 1) / cp)
+        ttft += (hop_bytes / hw.ici.bandwidth
+                 + (cp - 1) * m.layers * hw.ici.latency)
 
     handoff = exposed = 0.0
     if cross_host:
@@ -871,6 +908,8 @@ class ServingPlan:
         e = self.engine
         tags = [f"budget={e['token_budget']}", f"slots={e['max_slots']}",
                 f"blocks={e['num_blocks']}x{e['block_size']}"]
+        if e.get("cp", 1) > 1:
+            tags.append(f"cp={e['cp']}/{e.get('cp_wire_dtype', 'int8')}")
         if e.get("disaggregated"):
             tags.append(f"disagg/pf={e['prefill_budget']}")
         if self.router.get("fabric"):
@@ -901,10 +940,22 @@ def serving_search(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
                    disaggregated: bool = False,
                    cross_host: bool = False,
                    speculation: Optional[SpeculationSpec] = None,
+                   cps: tuple = (1,),
                    top_k: int = 5) -> list:
     """Enumerate (token_budget, max_slots[, prefill_budget]) engine
     configs for the stated traffic and SLO, score each with
     :func:`serving_cost`, and return the top candidates.
+
+    ``cps`` adds a context-parallel axis: each ``cp > 1`` candidate
+    models the long-context tier — the paged pool is sharded over the
+    cp group (per-rank ``num_blocks`` divides by cp, which is what the
+    per-device memory check sees), prefill wall time divides by cp, and
+    the ring's quantized KV hops land in TTFT. A long-context traffic
+    mix whose pool cannot fit one device therefore surfaces a ``cp>1``
+    plan, while short mixes keep ranking ``cp=1`` first (the ring wire
+    buys them nothing). CP candidates skip the engine features the
+    runtime rejects alongside cp (prefix sharing, speculation,
+    quantized KV, disaggregated prefill).
 
     ``cross_host`` enumerates *both* colocated and two-tier fabric
     candidates; fabric candidates pay the :func:`dcn_handoff_s` term
@@ -921,79 +972,106 @@ def serving_search(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
     seq_cap = m.seq
     need = traffic.prompt_tokens + traffic.new_tokens
     cands = []
-    for budget in budgets:
-        for ms in slots:
-            if ms > budget * 2:
-                continue
-            nblocks = serving_pool_blocks(m, traffic,
-                                          block_size=block_size,
-                                          max_slots=ms)
-            spec = ServingSpec(num_blocks=nblocks, block_size=block_size,
-                               quantized=quantized,
-                               kv_bytes=1 if quantized else 2)
-            if _kv_pool_bytes(m, spec, tp) > hw.memory_budget:
-                continue
-            if cross_host:
-                # both topologies compete in one ranking
-                pf_opts = [None, max(ms, budget // 4)]
-            elif disaggregated:
-                pf_opts = [max(ms, budget // 4)]
-            else:
-                pf_opts = [None]
-            for pf in pf_opts:
-                fabric = cross_host and pf is not None
-                cost = serving_cost(m, hw, traffic, token_budget=budget,
-                                    max_slots=ms, prefill_budget=pf,
-                                    quantized=quantized, tp=tp,
-                                    cross_host=fabric,
-                                    speculation=speculation)
-                meets = (cost.ttft_s * TTFT_P99_OVER_MEAN <= slo_ttft_p99_s
-                         and cost.tpot_s * TPOT_P99_OVER_MEAN
-                         <= slo_tpot_p99_s
-                         and not cost.saturated)
-                mbps = max(1, math.ceil(
-                    min(need * REQUEST_TOKENS_MAX_OVER_MEAN, seq_cap)
-                    / block_size))
-                engine = dict(block_size=block_size, num_blocks=nblocks,
-                              max_slots=ms, max_blocks_per_seq=mbps,
-                              token_budget=budget)
-                if quantized:
-                    engine["quantized"] = True
-                if traffic.shared_prefix_tokens > 0:
-                    engine["prefix_sharing"] = True
-                if pf is not None:
-                    engine["disaggregated"] = True
-                    engine["prefill_budget"] = pf
-                if speculation is not None:
-                    engine["speculation"] = dict(
-                        speculation_length=speculation.length,
-                        num_branches=speculation.branches)
-                slo = dict(ttft_p99_s=slo_ttft_p99_s,
-                           tpot_p99_s=slo_tpot_p99_s)
-                router = {}
-                if math.isfinite(slo_ttft_p99_s) \
-                        or math.isfinite(slo_tpot_p99_s):
-                    router["slo"] = {k: v for k, v in slo.items()
-                                     if math.isfinite(v)}
-                if fabric:
-                    router["fabric"] = {"prefill_replicas": 1,
-                                        "decode_replicas": 1}
-                cands.append(ServingPlan(engine=engine, router=router,
-                                         cost=cost, meets_slo=meets,
-                                         slo=slo))
+    for cp in sorted({max(1, int(c)) for c in cps}):
+        if cp > 1 and (quantized or speculation is not None):
+            continue    # the engine rejects these next to cp > 1
+        # the CP group holds the pool together: each rank carries 1/cp
+        # of the blocks, so memory feasibility is judged per rank
+        t_eff = traffic
+        if cp > 1 and traffic.shared_prefix_tokens > 0:
+            t_eff = dataclasses.replace(traffic, shared_prefix_tokens=0.0)
+        for budget in budgets:
+            for ms in slots:
+                if ms > budget * 2:
+                    continue
+                nb_total = serving_pool_blocks(m, t_eff,
+                                               block_size=block_size,
+                                               max_slots=ms)
+                nblocks = math.ceil(nb_total / cp)
+                spec = ServingSpec(num_blocks=nblocks,
+                                   block_size=block_size,
+                                   quantized=quantized,
+                                   kv_bytes=1 if quantized else 2)
+                if _kv_pool_bytes(m, spec, tp) > hw.memory_budget:
+                    continue
+                if cp > 1:
+                    pf_opts = [None]    # cp+disaggregated is rejected
+                elif cross_host:
+                    # both topologies compete in one ranking
+                    pf_opts = [None, max(ms, budget // 4)]
+                elif disaggregated:
+                    pf_opts = [max(ms, budget // 4)]
+                else:
+                    pf_opts = [None]
+                for pf in pf_opts:
+                    fabric = cross_host and pf is not None
+                    cost = serving_cost(m, hw, t_eff, token_budget=budget,
+                                        max_slots=ms, prefill_budget=pf,
+                                        quantized=quantized, tp=tp,
+                                        cross_host=fabric,
+                                        speculation=speculation, cp=cp)
+                    meets = (cost.ttft_s * TTFT_P99_OVER_MEAN
+                             <= slo_ttft_p99_s
+                             and cost.tpot_s * TPOT_P99_OVER_MEAN
+                             <= slo_tpot_p99_s
+                             and not cost.saturated)
+                    mbps = max(1, math.ceil(
+                        min(need * REQUEST_TOKENS_MAX_OVER_MEAN, seq_cap)
+                        / block_size))
+                    # the CP prefill width must tile over the cp ranks
+                    mbps = cp * math.ceil(mbps / cp)
+                    engine = dict(block_size=block_size,
+                                  num_blocks=nblocks,
+                                  max_slots=ms, max_blocks_per_seq=mbps,
+                                  token_budget=budget)
+                    if cp > 1:
+                        engine["cp"] = cp
+                        engine["cp_wire_dtype"] = "int8"
+                    if quantized:
+                        engine["quantized"] = True
+                    if t_eff.shared_prefix_tokens > 0:
+                        engine["prefix_sharing"] = True
+                    if pf is not None:
+                        engine["disaggregated"] = True
+                        engine["prefill_budget"] = pf
+                    if speculation is not None:
+                        engine["speculation"] = dict(
+                            speculation_length=speculation.length,
+                            num_branches=speculation.branches)
+                    slo = dict(ttft_p99_s=slo_ttft_p99_s,
+                               tpot_p99_s=slo_tpot_p99_s)
+                    router = {}
+                    if math.isfinite(slo_ttft_p99_s) \
+                            or math.isfinite(slo_tpot_p99_s):
+                        router["slo"] = {k: v for k, v in slo.items()
+                                         if math.isfinite(v)}
+                    if fabric:
+                        router["fabric"] = {"prefill_replicas": 1,
+                                            "decode_replicas": 1}
+                    cands.append(ServingPlan(engine=engine, router=router,
+                                             cost=cost, meets_slo=meets,
+                                             slo=slo))
+    # rank on per-mesh goodput: a cp-degree replica occupies cp meshes,
+    # so its goodput must beat cp plain replicas' — CP is for prompts
+    # one mesh cannot hold, not a free TTFT tie-break
+    def _eff(p):
+        return p.cost.tokens_per_s / p.engine.get("cp", 1)
+
     cands.sort(key=lambda p: (not p.meets_slo, p.cost.saturated,
-                              -p.cost.tokens_per_s,
+                              -_eff(p),
                               p.engine["token_budget"],
-                              p.engine["max_slots"]))
+                              p.engine["max_slots"],
+                              p.engine.get("cp", 1)))
     if cands:
         best = cands[0]
         peers = [p for p in cands
                  if p.meets_slo == best.meets_slo
                  and p.cost.saturated == best.cost.saturated
-                 and p.cost.tokens_per_s >= 0.98 * best.cost.tokens_per_s]
+                 and _eff(p) >= 0.98 * _eff(best)]
         peers.sort(key=lambda p: (round(p.cost.ttft_s, 4),
                                   p.engine["token_budget"],
-                                  p.engine["max_slots"]))
+                                  p.engine["max_slots"],
+                                  p.engine.get("cp", 1)))
         rest = [p for p in cands if p not in peers]
         cands = peers + rest
     return cands[:top_k]

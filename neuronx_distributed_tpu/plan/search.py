@@ -161,16 +161,23 @@ def enumerate_plans(m: ModelSpec, devices: int, *,
         if tp > cap:
             continue
         for pp in _divisors(devices // tp):
-            dp = devices // (tp * pp)
             if serving and pp > 1:
                 continue    # serving engine is single-stage
-            for ep in eps:
-                mbs = [1] if pp == 1 else sorted(
-                    {pp, 2 * pp, max(1, m.global_batch // max(1, dp))})
-                for mb in mbs:
-                    plans.extend(_strategies(
-                        Plan(devices=devices, tp=tp, pp=pp, dp=dp, ep=ep,
-                             dcn_dp=dcn_dp, num_microbatches=mb), m))
+            # serving layouts also get a context-parallel axis: a cp
+            # group shards the paged pool (and ring-prefills long
+            # prompts) across cp meshes — the long-context tier. cp
+            # folds out of dp, so short mixes still rank cp=1 first.
+            cps = _divisors(devices // (tp * pp)) if serving else [1]
+            for cp in cps:
+                dp = devices // (tp * pp * cp)
+                for ep in eps:
+                    mbs = [1] if pp == 1 else sorted(
+                        {pp, 2 * pp, max(1, m.global_batch // max(1, dp))})
+                    for mb in mbs:
+                        plans.extend(_strategies(
+                            Plan(devices=devices, tp=tp, pp=pp, dp=dp,
+                                 cp=cp, ep=ep, dcn_dp=dcn_dp,
+                                 num_microbatches=mb), m))
     return plans
 
 
@@ -220,7 +227,9 @@ def search(m: ModelSpec, hw: HardwareSpec, devices: int, *,
 
 
 def _plan_key(p: Plan) -> tuple:
-    return (p.tp, p.pp, p.dp, p.ep, p.num_microbatches,
+    # cp sorts before dp so equal-cost ties prefer plain data
+    # parallelism — a cp group must earn its keep through memory
+    return (p.tp, p.pp, p.cp, p.dp, p.ep, p.num_microbatches,
             p.grad_comm_dtype, p.tp_act_comm_dtype,
             p.grad_comm_hierarchical, p.tp_overlap,
             p.ep_wire_dtype, p.ep_overlap)

@@ -1,0 +1,32 @@
+"""Fixture: context-parallel prefill fed a prompt-length-shaped chunk
+grid.
+
+The CP worker's ring prefill has a fixed ``cp_prefill_width`` precisely
+so every prompt compiles into the same chunk grid. Splitting the prompt
+by ``len(prompt) // cp`` (or reshaping to a len-derived row count)
+hands the jitted worker one operand shape per distinct prompt length —
+a compile per prompt, exactly the hazard the padded width exists to
+avoid.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def ring_prefill(chunks, positions):
+    return chunks + positions
+
+
+cp_step = jax.jit(ring_prefill)
+
+
+def prefill(prompt, cp):
+    n_chunks = len(prompt) // cp
+    chunks = np.array_split(np.asarray(prompt), n_chunks)  # len-shaped grid
+    return cp_step(chunks, jnp.arange(len(prompt)))
+
+
+def prefill_reshape(prompt, cp):
+    rows = jnp.asarray(prompt).reshape(cp, len(prompt) // cp)
+    return cp_step(rows, rows)

@@ -259,6 +259,41 @@ def test_per_request_rule_scoped_to_inference_paths():
     assert [f.rule for f in flagged] == ["recompile-hazard"]
 
 
+def test_recompile_hazard_cp_chunk_grid_fixture():
+    """The reshaper extension: len()-tainted chunk counts through
+    array_split / reshape reaching a jitted CP worker."""
+    fs = _lint(os.path.join("inference", "bad_cp_chunks.py"))
+    assert _rules(fs) == {"recompile-hazard"}
+    flagged_lines = sorted({f.line for f in fs})
+    # one finding per call site: prefill() (split grid + inline arange)
+    # and prefill_reshape() (len-derived row count)
+    assert len(flagged_lines) >= 2
+    msgs = " | ".join(f.message for f in fs)
+    assert "per-request value" in msgs
+    assert "jitted 'cp_step'" in msgs
+
+
+def test_recompile_hazard_reshaper_taint_forms():
+    # inline reshaper operand, no intermediate name
+    src = ("import jax, jax.numpy as jnp\n"
+           "cp_step = jax.jit(lambda x: x)\n"
+           "def prefill(prompt, cp):\n"
+           "    return cp_step(jnp.asarray(prompt)"
+           ".reshape(len(prompt) // cp, cp))\n")
+    flagged = analyze_source(src, "mymodel/inference/cp.py",
+                             axes=DEFAULT_AXES)
+    assert [f.rule for f in flagged] == ["recompile-hazard"]
+    # fixed-width grids stay quiet: operands carry no len() taint
+    ok = ("import jax, jax.numpy as jnp, numpy as np\n"
+          "cp_step = jax.jit(lambda x: x)\n"
+          "def prefill(padded, cp, width):\n"
+          "    rows = padded.reshape(cp, width // cp)\n"
+          "    parts = np.array_split(np.arange(padded.shape[0]), cp)\n"
+          "    return cp_step(rows), parts\n")
+    assert analyze_source(ok, "mymodel/inference/cp.py",
+                          axes=DEFAULT_AXES) == []
+
+
 def test_serving_resilience_fires_on_fixture():
     fs = _lint(os.path.join("inference", "bad_serving_resilience.py"))
     assert _rules(fs) == {"serving-resilience"}

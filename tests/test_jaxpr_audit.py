@@ -41,8 +41,8 @@ def test_default_entry_points_registered():
 
 @pytest.mark.parametrize("name",
                          ["train-step", "engine-step", "ep-dispatch-ring",
-                          "ring-attention", "flash-decoding",
-                          "ulysses-attention"])
+                          "ring-attention", "ring-attention-int8",
+                          "flash-decoding", "ulysses-attention"])
 def test_production_entry_points_audit_clean(name):
     ep = load_default_entry_points()[name]
     fs = jaxpr_audit.audit_entry_point(ep)

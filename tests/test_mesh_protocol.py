@@ -27,7 +27,8 @@ MESH_RULES = {"jaxpr-collective-divergence", "jaxpr-ring-malformed",
               "jaxpr-silent-replication", "jaxpr-implicit-gather"}
 
 PACKAGE_ENTRIES = {"train-step", "engine-step", "ep-dispatch-ring",
-                   "ring-attention", "flash-decoding", "ulysses-attention"}
+                   "ring-attention", "ring-attention-int8",
+                   "flash-decoding", "ulysses-attention"}
 
 
 # ---------------------------------------------------------------------------

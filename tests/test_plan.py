@@ -176,13 +176,26 @@ def test_zero1_shards_optimizer_memory():
 
 
 def test_serving_charges_kv_pool():
+    # serving memory is inference state: one compute-dtype weight copy
+    # plus the paged pool — no grads/opt/training activations
     p = Plan(devices=8, tp=8, dp=1)
     with_kv = memory_bytes(p, TINY, HW, ServingSpec(num_blocks=64,
                                                     block_size=16))
     without = memory_bytes(p, TINY, HW)
     assert with_kv["kv"] > 0
-    assert with_kv["total"] == pytest.approx(without["total"]
+    assert with_kv["grads"] == with_kv["opt"] == with_kv["acts"] == 0.0
+    assert with_kv["params"] < without["params"]  # no fp32 master copy
+    assert with_kv["total"] == pytest.approx(with_kv["params"]
                                              + with_kv["kv"])
+
+
+def test_serving_kv_pool_divides_by_cp():
+    # the long-context tier shards the pool over the cp group: per-rank
+    # bytes divide by cp (same total blocks, cp ranks)
+    s = ServingSpec(num_blocks=64, block_size=16)
+    cp1 = memory_bytes(Plan(devices=8, tp=1, dp=8), TINY, HW, s)
+    cp4 = memory_bytes(Plan(devices=8, tp=1, dp=2, cp=4), TINY, HW, s)
+    assert cp4["kv"] == pytest.approx(cp1["kv"] / 4)
 
 
 # ---------------------------------------------------------------------------
