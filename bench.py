@@ -137,7 +137,8 @@ import jax.numpy as jnp  # noqa: E402
 def main(chaos_spec=None, serving=False, overlap=False, router=False,
          prefix_heavy=False, plan_mode=False, obs_mode=False,
          elastic=False, sdc=False, moe=False, lint_mode=False,
-         disagg_fabric=False, speculative=False, long_context=False):
+         disagg_fabric=False, speculative=False, long_context=False,
+         quantized=False):
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models import llama
     from neuronx_distributed_tpu.trainer import (
@@ -308,6 +309,21 @@ def main(chaos_spec=None, serving=False, overlap=False, router=False,
 
             traceback.print_exc()
             print(f"bench: speculative metric failed: {e!r}",
+                  file=sys.stderr)
+
+    # weight-quantized serving drill (docs/quantization.md): opt-in via
+    # --quantized; each tier serves the ragged Poisson workload at an
+    # equal HBM budget (freed weight bytes -> extra pool blocks) and
+    # records the greedy match-rate / logit divergence the planner's
+    # quality gate consumes
+    if quantized:
+        try:
+            aux.update(quantized_metric(platform))
+        except Exception as e:  # pragma: no cover
+            import traceback
+
+            traceback.print_exc()
+            print(f"bench: quantized metric failed: {e!r}",
                   file=sys.stderr)
 
     # million-token-tier drill (docs/serving.md "Long-context tier"):
@@ -1009,6 +1025,152 @@ def speculative_metric(platform: str) -> dict:
             "value": int(leaked), "unit": "blocks",
             "vs_baseline": 1.0 if leaked == 0 else 0.0},
     }
+
+
+def quantized_metric(platform: str) -> dict:
+    """Weight-quantized serving drill (docs/quantization.md).
+
+    The same ragged Poisson-arrival workload is served by the float
+    engine and by each weight-quant tier **at an equal HBM budget**: the
+    bytes a tier's packed weights free (measured from the actual arrays,
+    not the storage-ratio table) are spent on extra paged-KV blocks, so
+    the comparison is weights+pool against weights+pool, not weights
+    against weights. Reports, per tier:
+
+    * ``capacity`` — pool blocks affordable at the float run's budget
+      (acceptance: >=1.5x for int8, whose weights shrink 4x);
+    * serving tokens/s vs float (dequant overhead vs bandwidth win —
+      on CPU the overhead usually wins; the capacity column is the
+      tier's reason to exist there);
+    * ``greedy_match`` — fraction of requests whose token streams are
+      identical to the float engine's, and ``max_logit_div`` — max
+      |logits_tier - logits_fp32| over a fixed prefill batch. These are
+      the records ``plan --quality-file`` gates tiers on;
+    * ``compile_count()==1`` under the ragged load swings.
+    """
+    import dataclasses as _dc
+
+    import numpy as np
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference.engine import (EngineConfig,
+                                                          EngineStats,
+                                                          ServingEngine)
+    from neuronx_distributed_tpu.inference.kv_cache import init_kv_cache
+    from neuronx_distributed_tpu.models import llama
+    from neuronx_distributed_tpu.parallel import mesh as ps
+    from neuronx_distributed_tpu.quantization.serving import (
+        quantize_params_for_serving)
+
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel()
+    if platform == "cpu":
+        cfg = llama.LlamaConfig(
+            vocab_size=1024, hidden_size=256, intermediate_size=704,
+            num_layers=12, num_heads=8, num_kv_heads=8, max_seq_len=512)
+        n_req, max_slots, budget = 8, 4, 16
+        plen_range, new_range = (8, 33), (4, 17)
+        block_size, num_blocks = 8, 64
+        tiers = ("int8", "mxfp4")
+    else:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=16, num_heads=8, num_kv_heads=8, max_seq_len=4096)
+        n_req, max_slots, budget = 16, 8, 64
+        plen_range, new_range = (32, 129), (16, 65)
+        block_size, num_blocks = 16, 256
+        tiers = ("int8", "mxfp4")
+    params = meta.unbox(llama.LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    rng = np.random.RandomState(0)
+    reqs = [(rng.randint(0, cfg.vocab_size,
+                         (rng.randint(*plen_range),)).tolist(),
+             int(rng.randint(*new_range))) for _ in range(n_req)]
+    arrivals = np.concatenate(
+        [[0.0], rng.exponential(0.005, n_req).cumsum()[:-1]])
+
+    def tree_bytes(tree):
+        return sum(x.nbytes for x in jax.tree_util.tree_leaves(tree))
+
+    # one pool block's bytes: K and V rows for every layer (fp32 pool —
+    # the drill isolates the WEIGHT tier; the int8 pool stacks on top)
+    block_bytes = (cfg.num_layers * 2 * block_size * cfg.num_kv_heads
+                   * cfg.head_dim_ * 4)
+    w_fp32 = tree_bytes(params)
+    hbm_budget = w_fp32 + num_blocks * block_bytes
+
+    def drill(model_cfg, model_params, nb, wq=None):
+        ecfg = EngineConfig(
+            block_size=block_size, num_blocks=nb, max_slots=max_slots,
+            max_blocks_per_seq=-(-cfg.max_seq_len // block_size),
+            token_budget=budget, kv_dtype=cfg.dtype, weight_quant=wq)
+        eng = ServingEngine(model_cfg, model_params, ecfg)
+        eng.submit(reqs[0][0], reqs[0][1], uid="warm")   # compile + warm
+        eng.run()
+        eng.stats, eng.results = EngineStats(), {}
+        eng._t0 = eng._clock()
+        for i, ((p, n), at) in enumerate(zip(reqs, arrivals)):
+            eng.submit(p, n, uid=f"r{i}", arrival_time=float(at))
+        done = {u: r for u, r in eng.run().items()
+                if r.status == "completed"}
+        makespan = max(r.finish_s for r in done.values())
+        tps = sum(len(r.tokens) for r in done.values()) / makespan
+        return eng, done, tps
+
+    eng0, done0, tps0 = drill(cfg, params, num_blocks)
+
+    # fixed prefill batch for logit divergence (the quality record the
+    # planner's --quality-file gate consumes alongside greedy_match)
+    probe = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 32)), jnp.int32)
+    probe_pos = jnp.arange(32, dtype=jnp.int32)[None]
+
+    def probe_logits(model_cfg, model_params):
+        cache = init_kv_cache(cfg.num_layers, 1, 64, cfg.num_kv_heads,
+                              cfg.head_dim_, dtype=cfg.dtype)
+        logits, _ = llama.llama_forward_with_cache(
+            model_cfg, model_params, probe, probe_pos, cache)
+        return np.asarray(logits, np.float32)
+
+    ref_logits = probe_logits(cfg, params)
+
+    tag = f"{platform}1"
+    aux = {}
+    for wq in tiers:
+        cfg_q = _dc.replace(cfg, weight_quant=wq)
+        params_q = quantize_params_for_serving(cfg_q, params)
+        w_q = tree_bytes(params_q)
+        nb_q = int((hbm_budget - w_q) // block_bytes)
+        capacity = nb_q / num_blocks
+        eng_q, done_q, tps_q = drill(cfg_q, params_q, nb_q, wq=wq)
+        match = float(np.mean([done_q[u].tokens == done0[u].tokens
+                               for u in done0 if u in done_q]))
+        div = float(np.max(np.abs(probe_logits(cfg_q, params_q)
+                                  - ref_logits)))
+        compile_ok = eng_q.compile_count() == 1
+        print(f"bench: quantized drill w:{wq} {tps_q:.1f} tok/s vs fp32 "
+              f"{tps0:.1f} ({tps_q / tps0:.2f}x), capacity {nb_q}/"
+              f"{num_blocks} blocks ({capacity:.2f}x) at equal "
+              f"{hbm_budget / 2**20:.1f} MiB, greedy_match {match:.3f}, "
+              f"max_logit_div {div:.3f}, compile_count==1 {compile_ok}",
+              file=sys.stderr)
+        aux.update({
+            f"quantized_{wq}_tokens_per_s_{tag}": {
+                "value": round(tps_q, 2), "unit": "tokens/sec",
+                "vs_baseline": round(tps_q / max(1e-9, tps0), 3)},
+            f"quantized_{wq}_capacity_{tag}": {
+                "value": round(capacity, 3), "unit": "x",
+                "vs_baseline": round(capacity / 1.5, 3)},
+            f"quantized_{wq}_greedy_match_{tag}": {
+                "value": round(match, 4), "unit": "frac",
+                "vs_baseline": round(match, 4)},
+            f"quantized_{wq}_max_logit_div_{tag}": {
+                "value": round(div, 4), "unit": "abs",
+                "vs_baseline": 1.0},
+            f"quantized_{wq}_compile_once_{tag}": {
+                "value": 1 if compile_ok else 0, "unit": "bool",
+                "vs_baseline": 1.0 if compile_ok else 0.0},
+        })
+    return aux
 
 
 def long_context_metric(platform: str) -> dict:
@@ -2524,6 +2686,13 @@ if __name__ == "__main__":
              "reports decode tokens/s speedup, mean accept length, and "
              "greedy match rate; docs/serving.md)")
     _p.add_argument(
+        "--quantized", action="store_true",
+        help="also run the weight-quantized serving drill (int8/mxfp4 "
+             "tiers vs fp32 at an equal HBM budget — freed weight bytes "
+             "buy extra pool blocks; reports tokens/s, concurrent-session "
+             "capacity, per-tier greedy match-rate and max logit "
+             "divergence, compile_count()==1; docs/quantization.md)")
+    _p.add_argument(
         "--long-context", action="store_true",
         help="also run the million-token-tier drill (a prompt that "
              "overflows one mesh's paged pool refused at cp=1, served by "
@@ -2602,4 +2771,5 @@ if __name__ == "__main__":
          obs_mode=_args.obs, elastic=_args.elastic, sdc=_args.sdc,
          moe=_args.moe, lint_mode=_args.lint,
          disagg_fabric=_args.disagg_fabric,
-         speculative=_args.speculative, long_context=_args.long_context)
+         speculative=_args.speculative, long_context=_args.long_context,
+         quantized=_args.quantized)

@@ -145,6 +145,7 @@ def _ensure_rules_loaded() -> None:
                    rules_observability,  # noqa: F401
                    rules_paging,  # noqa: F401
                    rules_plan,  # noqa: F401
+                   rules_quantization,  # noqa: F401
                    rules_recompile,  # noqa: F401
                    rules_resilience,  # noqa: F401
                    rules_serving_resilience,  # noqa: F401

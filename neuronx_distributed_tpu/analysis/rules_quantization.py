@@ -1,0 +1,88 @@
+"""Rule ``quantization``: whole-pool dequantization stays inside ``ops/``.
+
+The int8 paged-KV tier's memory win exists only while the pool is *read*
+quantized: the Pallas kernel DMAs int8 blocks plus per-row scales and
+fuses the dequant into the online-softmax inner loop, and the XLA
+fallback dequantizes only the blocks a sequence's table actually maps
+(``ops/paged_attention.py``). Code outside ``ops/`` that calls
+``dequantize_kv``/``dequantize_blockwise`` on a pool-sized array
+materializes a float copy of the entire pool in HBM — silently spending
+the 2-4x capacity the tier was selected for, on every decode step.
+
+What is NOT this rule's business:
+
+* per-layer contiguous-cache slices (the non-paged serving path in
+  ``models/llama.py`` dequantizes one layer's ``[B, T, KV, D]`` slice —
+  bounded by the batch, not the pool);
+* the wire codec's chunk-at-a-time ``dequantize_blockwise`` in
+  ``inference/transport.py`` (payload chunks, not resident pools);
+* ``ops/`` itself, where the gather-then-dequant order makes the
+  dequantized working set per-sequence.
+
+The heuristic is therefore name-based: the first argument must be
+pool-named (``k_pool``, ``pool.k``, ``cache.k_pool[...]``, …) for the
+rule to fire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from .core import Finding, LintContext, register
+
+_DEQUANT_FNS = ("dequantize_kv", "dequantize_blockwise")
+
+
+def _call_name(func) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _expr_names(node) -> List[str]:
+    """Identifier components of an expression: ``cache.k_pool[idx]`` →
+    ``["cache", "k_pool"]`` (subscripts peel to their value — indexing a
+    pool still reads the pool array)."""
+    names: List[str] = []
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        names.extend(_expr_names(node.value))
+        names.append(node.attr)
+    elif isinstance(node, ast.Name):
+        names.append(node.id)
+    return names
+
+
+def _is_pool_named(node) -> bool:
+    return any("pool" in n.lower() for n in _expr_names(node))
+
+
+@register(
+    "quantization",
+    "whole-pool dequantize_kv/dequantize_blockwise call on a pool-named "
+    "array outside ops/ — materializes a float copy of the entire paged "
+    "pool in HBM, forfeiting the quantized tier's capacity win; the "
+    "fused read in ops/paged_attention.py (or a per-sequence gather "
+    "first) is the supported path",
+    scope=("inference", "models"))
+def check(ctx: LintContext) -> Iterator[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _call_name(node.func)
+        if fn not in _DEQUANT_FNS:
+            continue
+        if not node.args or not _is_pool_named(node.args[0]):
+            continue
+        findings.append(Finding(
+            ctx.path, node.lineno, node.col_offset, "quantization",
+            f"`{fn}(...)` on pool-named array "
+            f"`{'.'.join(_expr_names(node.args[0]))}` dequantizes the "
+            "whole paged pool to float — gather the sequence's blocks "
+            "first or use the fused kernel read in ops/paged_attention.py"))
+    yield from findings

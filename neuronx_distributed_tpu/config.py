@@ -47,6 +47,8 @@ def configure_model(cfg: "NxDConfig", model_cfg: Any) -> Any:
         updates["moe_ep_wire_dtype"] = cfg.parallel.moe_ep_wire_dtype
     if "moe_overlap_dispatch" in fields:
         updates["moe_overlap_dispatch"] = cfg.parallel.moe_overlap_dispatch
+    if "weight_quant" in fields and cfg.parallel.weight_quant is not None:
+        updates["weight_quant"] = cfg.parallel.weight_quant
     model_cfg = dataclasses.replace(model_cfg, **updates)
     if "num_experts" in fields:
         # incoherent MoE knobs fail here with actionable errors instead of
@@ -144,6 +146,11 @@ class ParallelConfig:
     # compute with later hops: None = auto (engage at ep >= 4), True =
     # engage whenever ep > 1, False = monolithic collectives.
     moe_overlap_dispatch: Optional[bool] = None
+    # Serving weight-quantization tier (docs/quantization.md): None (float)
+    # | "int8" | "fp8" (per-out-channel w8a16) | "mxfp4" | "mxfp8" (packed
+    # OCP microscaling). Propagated onto model configs with a
+    # ``weight_quant`` field by configure_model.
+    weight_quant: Optional[str] = None
 
     def __post_init__(self) -> None:
         for f in ("tensor_parallel_size", "pipeline_parallel_size",
@@ -187,6 +194,12 @@ class ParallelConfig:
             raise ValueError(
                 "tp_activation_sync_fraction must be in (0, 1], got "
                 f"{f!r}")
+        wq_formats = ("int8", "fp8", "mxfp4", "mxfp8")
+        if self.weight_quant is not None and \
+                self.weight_quant not in wq_formats:
+            raise ValueError(
+                f"weight_quant must be one of {wq_formats} or None, got "
+                f"{self.weight_quant!r}")
 
     @property
     def model_parallel_size(self) -> int:
@@ -315,6 +328,7 @@ class NxDConfig:
                 self.parallel.tp_activation_sync_fraction),
             moe_ep_wire_dtype=self.parallel.moe_ep_wire_dtype,
             moe_overlap_dispatch=self.parallel.moe_overlap_dispatch,
+            weight_quant=self.parallel.weight_quant,
             optimizer_config=self.optimizer,
             mixed_precision_config=self.mixed_precision,
             activation_checkpoint_config=self.activation_checkpoint,
@@ -345,6 +359,7 @@ def neuronx_distributed_config(
     tp_activation_sync_fraction: float = 1.0,
     moe_ep_wire_dtype: str = "fp32",
     moe_overlap_dispatch: Optional[bool] = None,
+    weight_quant: Optional[str] = None,
 ) -> NxDConfig:
     """Build an :class:`NxDConfig` and (by default) initialise the global mesh.
 
@@ -364,6 +379,7 @@ def neuronx_distributed_config(
             tp_activation_sync_fraction=tp_activation_sync_fraction,
             moe_ep_wire_dtype=moe_ep_wire_dtype,
             moe_overlap_dispatch=moe_overlap_dispatch,
+            weight_quant=weight_quant,
         ),
         optimizer=optimizer_config or OptimizerConfig(),
         mixed_precision=mixed_precision_config or MixedPrecisionConfig(),

@@ -86,6 +86,14 @@ class EngineConfig:
     token_budget: int = 32
     quantized: bool = False
     kv_dtype: Any = None            # None -> model dtype (fp pool only)
+    # weight-quantization serving tier (docs/quantization.md): serve every
+    # projection kernel quantized — "int8" | "fp8" (per-out-channel w8a16)
+    # | "mxfp4" | "mxfp8" (packed OCP microscaling). The engine stamps the
+    # model config's ``weight_quant`` and, when handed a float checkpoint,
+    # converts it at construction (quantize_params_for_serving); with
+    # speculation on the draft serves quantized too. Orthogonal to
+    # ``quantized`` (the KV pool's int8 blocks); incompatible with cp>1.
+    weight_quant: Optional[str] = None
     eos_id: Optional[int] = None
     sampling: SamplingConfig = SamplingConfig(greedy=True)
     # prefix sharing: full prompt blocks are published to a trie so later
@@ -522,6 +530,32 @@ class ServingEngine:
         # sites so a fleet's replicas don't alias one site
         self.name = name
         self._aot = aot_cache
+        # weight-quantization tier: stamp the format onto the model config
+        # (the forward branches on cfg.weight_quant) and convert a float
+        # checkpoint in place — callers hand the same tree either way
+        wq = getattr(engine_cfg, "weight_quant", None)
+        if wq is not None:
+            from ..models.llama import WEIGHT_QUANT_FORMATS
+            from ..quantization.serving import (params_are_quantized,
+                                                quantize_params_for_serving)
+
+            if wq not in WEIGHT_QUANT_FORMATS:
+                raise ValueError(
+                    f"EngineConfig.weight_quant must be one of "
+                    f"{WEIGHT_QUANT_FORMATS} or None, got {wq!r}")
+            if int(getattr(engine_cfg, "cp", 1)) > 1:
+                raise ValueError(
+                    "EngineConfig(cp>1, weight_quant=...): the ring "
+                    "prefill worker runs the float forward, so a "
+                    "weight-quantized step would serve two different "
+                    "models; the long-context tier and the low-precision "
+                    "tier are separate for now — drop one of them")
+            if getattr(model_cfg, "weight_quant", None) != wq:
+                model_cfg = dataclasses.replace(model_cfg, weight_quant=wq)
+            self.model_cfg = model_cfg
+            if not params_are_quantized(params):
+                params = quantize_params_for_serving(model_cfg, params)
+            self.params = params
         # context parallelism: validate the long-context tier's contract
         # up front — every restriction here is a config error, not a
         # runtime surprise three steps into a 512k-token session
@@ -606,6 +640,20 @@ class ServingEngine:
                 raise ValueError(
                     "speculation runs inside the packed worker; "
                     "disaggregated prefill/decode is not supported")
+            if wq is not None and draft_cfg is not None:
+                # an active tier serves the draft quantized by default:
+                # draft forwards dominate step count, so a float draft
+                # would forfeit most of the tier's bandwidth win
+                from ..quantization.serving import (
+                    params_are_quantized, quantize_params_for_serving)
+
+                if getattr(draft_cfg, "weight_quant", None) != wq:
+                    draft_cfg = dataclasses.replace(draft_cfg,
+                                                    weight_quant=wq)
+                if (draft_params is not None
+                        and not params_are_quantized(draft_params)):
+                    draft_params = quantize_params_for_serving(
+                        draft_cfg, draft_params)
             self._draft_cfg = draft_cfg or model_cfg
             self._draft_params = (draft_params if draft_params is not None
                                   else params)

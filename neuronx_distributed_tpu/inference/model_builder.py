@@ -712,6 +712,20 @@ def register_serving_workers(builder: ModelBuilder, model_cfg, engine_cfg,
     from .paging import init_paged_kv_cache, init_quantized_paged_kv_cache
 
     e, m = engine_cfg, model_cfg
+    wq = getattr(e, "weight_quant", None)
+    if wq is not None:
+        # the low-precision tier: AOT workers trace the quantized step
+        # (cfg.weight_quant branches the forward), and a float checkpoint
+        # is converted here so the traced args match the served tree
+        import dataclasses as _dc
+
+        from ..quantization.serving import (params_are_quantized,
+                                            quantize_params_for_serving)
+
+        if getattr(m, "weight_quant", None) != wq:
+            model_cfg = m = _dc.replace(m, weight_quant=wq)
+        if not params_are_quantized(params):
+            params = quantize_params_for_serving(m, params)
     cp = max(1, getattr(e, "cp", 1))
     if e.quantized:
         cache = init_quantized_paged_kv_cache(

@@ -55,6 +55,38 @@ def _act_kw(cfg: "LlamaConfig") -> dict:
             "activation_comm_block_size": cfg.activation_comm_block_size}
 
 
+# serving weight-quantization tiers (docs/quantization.md): int8/fp8 are
+# per-out-channel symmetric w8a16, mxfp4/mxfp8 packed OCP microscaling
+WEIGHT_QUANT_FORMATS = ("int8", "fp8", "mxfp4", "mxfp8")
+
+
+def _weight_quant_dtype(fmt: str):
+    """QuantizedDtype for the int8/fp8 tiers."""
+    from ..quantization.quantization_utils import QuantizedDtype
+
+    return (QuantizedDtype.INT8 if fmt == "int8"
+            else QuantizedDtype.FP8E4M3)
+
+
+def _quant_lm_head(cfg: "LlamaConfig", gather_output: bool, name=None):
+    """The quantized ColumnParallel lm_head for ``cfg.weight_quant``."""
+    kw = {} if name is None else {"name": name}
+    if cfg.weight_quant.startswith("mx"):
+        from ..quantization.mx_layers import MXQuantizedColumnParallel
+
+        return MXQuantizedColumnParallel(
+            features=cfg.vocab_size, mx_format=cfg.weight_quant[2:],
+            gather_output=gather_output, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, **kw)
+    from ..quantization.quantization_layers import QuantizedColumnParallel
+
+    return QuantizedColumnParallel(
+        features=cfg.vocab_size,
+        quantized_dtype=_weight_quant_dtype(cfg.weight_quant),
+        gather_output=gather_output, dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype, **kw)
+
+
 @dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 32000
@@ -133,6 +165,14 @@ class LlamaConfig:
     # schedule varies per layer) and sequence_parallel=False (the
     # reduce-scatter also reshapes, so it cannot be elided).
     activation_sync_fraction: float = 1.0
+    # Serving weight-quantization tier (docs/quantization.md): storage
+    # format for every TP linear in the stack — None (fp weights),
+    # "int8"/"fp8" (per-out-channel symmetric, w8a16 dequant-into-matmul)
+    # or "mxfp4"/"mxfp8" (packed OCP microscaling, 32-element E8M0
+    # blocks). Threaded from EngineConfig.weight_quant /
+    # ParallelConfig.weight_quant; convert float checkpoints with
+    # quantization.serving.quantize_params_for_serving.
+    weight_quant: Optional[str] = None
     # LoRA adapters (see neuronx_distributed_tpu.lora); None = disabled
     lora: Optional["LoraConfig"] = None
     # sequence-chunked LM loss (fused_linear_cross_entropy): the loss path
@@ -168,6 +208,41 @@ class LlamaConfig:
                     "activation_sync_fraction < 1.0 is incompatible with "
                     "sequence_parallel: the reduce-scatter exit reshapes "
                     "the activation and cannot be elided")
+        if self.weight_quant is not None:
+            if self.weight_quant not in WEIGHT_QUANT_FORMATS:
+                raise ValueError(
+                    f"weight_quant must be one of {WEIGHT_QUANT_FORMATS} "
+                    f"or None, got {self.weight_quant!r}")
+            incompatible = (
+                "LoRA (adapters assume float kernels)"
+                if self.lora is not None else
+                "tie_embeddings=True (the embedding table stays float)"
+                if self.tie_embeddings else
+                "loss_chunk (the fused loss streams a float lm_head kernel)"
+                if self.loss_chunk is not None else
+                "sequence_parallel (quantized linears enter via copy_to "
+                "and exit via all-reduce only)"
+                if self.sequence_parallel else
+                "activation_sync_fraction < 1.0"
+                if self.activation_sync_fraction < 1.0 else None)
+            if incompatible:
+                raise ValueError(
+                    f"weight_quant={self.weight_quant!r} is incompatible "
+                    f"with {incompatible}")
+            if self.weight_quant.startswith("mx"):
+                from ..quantization.microscaling import MX_BLOCK
+
+                q_features = self.num_heads * self.head_dim_
+                bad = ("hidden_size" if self.hidden_size % MX_BLOCK else
+                       "intermediate_size"
+                       if self.intermediate_size % MX_BLOCK else
+                       "num_heads * head_dim"
+                       if q_features % MX_BLOCK else None)
+                if bad:
+                    raise ValueError(
+                        f"weight_quant={self.weight_quant!r} needs {bad} "
+                        f"divisible by the MX block ({MX_BLOCK}): all "
+                        "contraction dims are block-scaled")
         if self.loss_chunk is not None:
             if self.loss_chunk <= 0:
                 raise ValueError(
@@ -315,12 +390,34 @@ class LlamaAttention(nn.Module):
                  cache=None, cache_index=None):
         cfg = self.cfg
         head_dim = cfg.head_dim_
-        q, k, v = pl.GQAQKVColumnParallelLinear(
-            num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
-            head_dim=head_dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            sequence_parallel=cfg.sequence_parallel, tp_size=cfg.tp_size,
-            overlap_comm=cfg.overlap_comm, name="qkv",
-            **_act_kw(cfg), **_lora_kw(cfg, "qkv"))(x)
+        if cfg.weight_quant is not None and cfg.weight_quant.startswith(
+                "mx"):
+            from ..quantization.mx_layers import MXGQAQKVColumnParallelLinear
+
+            q, k, v = MXGQAQKVColumnParallelLinear(
+                num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
+                head_dim=head_dim, mx_format=cfg.weight_quant[2:],
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                tp_size=cfg.tp_size, name="qkv")(x)
+        elif cfg.weight_quant is not None:
+            from ..quantization.quantization_layers import \
+                QuantizedGQAQKVColumnParallelLinear
+
+            q, k, v = QuantizedGQAQKVColumnParallelLinear(
+                num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
+                head_dim=head_dim,
+                quantized_dtype=_weight_quant_dtype(cfg.weight_quant),
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                tp_size=cfg.tp_size, name="qkv")(x)
+        else:
+            q, k, v = pl.GQAQKVColumnParallelLinear(
+                num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
+                head_dim=head_dim, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                sequence_parallel=cfg.sequence_parallel,
+                tp_size=cfg.tp_size,
+                overlap_comm=cfg.overlap_comm, name="qkv",
+                **_act_kw(cfg), **_lora_kw(cfg, "qkv"))(x)
         b, s = q.shape[0], q.shape[1]
         n_q_local = q.shape[-1] // head_dim
         n_kv_local = k.shape[-1] // head_dim
@@ -442,13 +539,31 @@ class LlamaAttention(nn.Module):
                                               dropout_p=dropout_p,
                                               dropout_seed=dropout_seed)
         out = out.reshape(b, s, n_q_local * head_dim)
-        out = pl.RowParallelLinear(
-            features=cfg.num_heads * head_dim, use_bias=False,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            sequence_parallel=cfg.sequence_parallel,
-            overlap_comm=cfg.overlap_comm, name="o_proj",
-            tp_sync=self.tp_sync,
-            **_act_kw(cfg), **_lora_kw(cfg, "o_proj"))(out)
+        if cfg.weight_quant is not None and cfg.weight_quant.startswith(
+                "mx"):
+            from ..quantization.mx_layers import MXQuantizedRowParallel
+
+            out = MXQuantizedRowParallel(
+                features=cfg.num_heads * head_dim,
+                mx_format=cfg.weight_quant[2:], dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="o_proj")(out)
+        elif cfg.weight_quant is not None:
+            from ..quantization.quantization_layers import \
+                QuantizedRowParallel
+
+            out = QuantizedRowParallel(
+                features=cfg.num_heads * head_dim,
+                quantized_dtype=_weight_quant_dtype(cfg.weight_quant),
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="o_proj")(out)
+        else:
+            out = pl.RowParallelLinear(
+                features=cfg.num_heads * head_dim, use_bias=False,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                sequence_parallel=cfg.sequence_parallel,
+                overlap_comm=cfg.overlap_comm, name="o_proj",
+                tp_sync=self.tp_sync,
+                **_act_kw(cfg), **_lora_kw(cfg, "o_proj"))(out)
         if cache is not None:
             return out, new_cache
         return out
@@ -462,6 +577,8 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
+        if cfg.weight_quant is not None:
+            return self._quantized_call(x)
         # Fused gate+up in ONE column-parallel matmul (one MXU pass; the
         # reference keeps separate gate/up projections). The kernel is
         # [H, 2, I] with the tp shard on the *last* dim, so the gate/up split
@@ -538,6 +655,63 @@ class LlamaMLP(nn.Module):
             overlap_comm=cfg.overlap_comm, name="down",
             tp_sync=self.tp_sync,
             **_act_kw(cfg), **_lora_kw(cfg, "down"))(h)
+
+    def _quantized_call(self, x: jax.Array) -> jax.Array:
+        """Weight-quantized (w8a16) gate_up + down: the fused [H, 2, I]
+        kernel is stored quantized and dequantized into the einsum; no
+        collective-matmul overlap (the packed kernel cannot ride the
+        decomposed ring)."""
+        cfg = self.cfg
+        i_local = pl._maybe_local(cfg.intermediate_size, ps.TP_AXIS)
+        x = mappings.copy_to_tensor_parallel_region(x)
+        x = x.astype(cfg.dtype)
+        if cfg.weight_quant.startswith("mx"):
+            from ..quantization.microscaling import MX_BLOCK
+            from ..quantization.mx_layers import (MXQuantizedRowParallel,
+                                                  _mx_dequant, _mx_storage)
+
+            fmt = cfg.weight_quant[2:]
+            pack, store_dt = _mx_storage(fmt)
+            packed = self.param(
+                "gate_up_packed",
+                nn.with_partitioning(lambda key, s, d: jnp.zeros(s, d),
+                                     (None, ps.TP_AXIS, None)),
+                (2, i_local, cfg.hidden_size // pack), store_dt)
+            scale = self.param(
+                "gate_up_scale",
+                nn.with_partitioning(nn.initializers.ones_init(),
+                                     (None, ps.TP_AXIS, None)),
+                (2, i_local, cfg.hidden_size // MX_BLOCK), jnp.float32)
+            w = _mx_dequant(packed, scale, fmt, cfg.dtype)   # [2, I, H]
+            h = jnp.einsum("bsh,kih->bski", x, w)
+            down = MXQuantizedRowParallel(
+                features=cfg.hidden_size, mx_format=fmt, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="down")
+        else:
+            from ..quantization.quantization_layers import \
+                QuantizedRowParallel
+            from ..quantization.quantization_utils import dequantize
+
+            qdt = _weight_quant_dtype(cfg.weight_quant)
+            gate_up_q = self.param(
+                "gate_up_q",
+                nn.with_partitioning(lambda key, s, d: jnp.zeros(s, d),
+                                     (None, None, ps.TP_AXIS)),
+                (cfg.hidden_size, 2, i_local), qdt.jnp_dtype)
+            gate_up_scale = self.param(
+                "gate_up_scale",
+                nn.with_partitioning(nn.initializers.ones_init(),
+                                     (None, ps.TP_AXIS)),
+                (2, i_local), jnp.float32)
+            w = dequantize(gate_up_q, gate_up_scale[None], cfg.dtype)
+            h = jnp.einsum("bsh,hki->bski", x, w)
+            down = QuantizedRowParallel(
+                features=cfg.hidden_size, quantized_dtype=qdt,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="down")
+        if pl._bound_size(ps.TP_AXIS) is None:
+            h = ps.with_sharding_constraint(h, None, None, None, ps.TP_AXIS)
+        h = nn.silu(h[..., 0, :]) * h[..., 1, :]
+        return down(h)
 
 
 class LlamaDecoderLayer(nn.Module):
@@ -845,12 +1019,17 @@ class LlamaForCausalLM(nn.Module):
                 x.astype(cfg.dtype), kernel, labels,
                 ignore_index=ignore_index, chunk=cfg.loss_chunk,
                 dtype=cfg.dtype)
-        logits = pl.ColumnParallelLinear(
-            features=cfg.vocab_size, use_bias=False, gather_output=False,
-            sequence_parallel=cfg.sequence_parallel,
-            overlap_comm=cfg.overlap_comm,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
-            **_act_kw(cfg), **_lora_kw(cfg, "lm_head"))(x)
+        if cfg.weight_quant is not None:
+            logits = _quant_lm_head(cfg, False, name="lm_head")(x)
+        else:
+            logits = pl.ColumnParallelLinear(
+                features=cfg.vocab_size, use_bias=False,
+                gather_output=False,
+                sequence_parallel=cfg.sequence_parallel,
+                overlap_comm=cfg.overlap_comm,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="lm_head",
+                **_act_kw(cfg), **_lora_kw(cfg, "lm_head"))(x)
         if labels is not None:
             return lf.causal_lm_loss(logits, labels,
                                      ignore_index=ignore_index)
@@ -1003,6 +1182,9 @@ def llama_forward_with_cache(cfg: LlamaConfig, params, input_ids: jax.Array,
         logits = pl.embedding_attend(
             p["model"]["embed"]["embedding"], x, dtype=cfg.dtype,
             gather_output=True)
+    elif cfg.weight_quant is not None:
+        head = _quant_lm_head(cfg, True)
+        logits = head.apply({"params": p["lm_head"]}, x)
     else:
         head = pl.ColumnParallelLinear(
             features=cfg.vocab_size, use_bias=False, gather_output=True,

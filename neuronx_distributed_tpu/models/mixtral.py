@@ -22,7 +22,7 @@ from ..parallel import layers as pl
 from ..parallel import loss_functions as lf
 from ..parallel import mappings
 from ..parallel import mesh as ps
-from .llama import (LlamaAttention, LlamaConfig, _act_kw,
+from .llama import (LlamaAttention, LlamaConfig, _act_kw, _quant_lm_head,
                     context_parallel_positions)
 
 
@@ -52,6 +52,30 @@ class MixtralConfig(LlamaConfig):
     shared_expert_intermediate: int = 0
     router_aux_coef: float = 0.02
     router_z_coef: float = 0.001
+
+    def __post_init__(self):
+        super().__post_init__()
+        if (self.weight_quant is not None
+                and self.moe_dispatch != "capacity"
+                and self.moe_expert_impl == "float"):
+            raise ValueError(
+                f"weight_quant={self.weight_quant!r} serves experts "
+                "quantized, which requires moe_dispatch='capacity' (got "
+                f"{self.moe_dispatch!r}); set moe_dispatch='capacity' or "
+                "pin moe_expert_impl explicitly")
+
+    @property
+    def moe_expert_impl_(self) -> str:
+        """Effective expert bank impl: an active ``weight_quant`` tier
+        quantizes the experts too unless ``moe_expert_impl`` was pinned."""
+        if self.weight_quant is not None and self.moe_expert_impl == "float":
+            return _WEIGHT_QUANT_EXPERT_IMPL[self.weight_quant]
+        return self.moe_expert_impl
+
+
+# weight_quant tier -> quantized expert bank implementation
+_WEIGHT_QUANT_EXPERT_IMPL = {"int8": "int8", "fp8": "fp8",
+                             "mxfp4": "mx_fp4", "mxfp8": "mx_fp8"}
 
 
 MIXTRAL_8X7B = MixtralConfig(
@@ -118,7 +142,7 @@ class MixtralDecoderLayer(nn.Module):
             sentinel_empty=cfg.moe_sentinel_empty,
             ep_wire_dtype=cfg.moe_ep_wire_dtype,
             ep_overlap=cfg.moe_overlap_dispatch,
-            expert_impl=cfg.moe_expert_impl,
+            expert_impl=cfg.moe_expert_impl_,
             router_type=cfg.router_type,
             shared_expert_intermediate=cfg.shared_expert_intermediate,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="moe")(h)
@@ -276,11 +300,16 @@ class MixtralForCausalLM(nn.Module):
                 "tie_embeddings is not supported for Mixtral (HF Mixtral "
                 "never ties); use an explicit lm_head")
         x, aux = MixtralModel(cfg, name="model")(input_ids, positions)
-        logits = pl.ColumnParallelLinear(
-            features=cfg.vocab_size, use_bias=False, gather_output=False,
-            sequence_parallel=cfg.sequence_parallel,
-            overlap_comm=cfg.overlap_comm, **_act_kw(cfg),
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head")(x)
+        if cfg.weight_quant is not None:
+            logits = _quant_lm_head(cfg, False, name="lm_head")(x)
+        else:
+            logits = pl.ColumnParallelLinear(
+                features=cfg.vocab_size, use_bias=False,
+                gather_output=False,
+                sequence_parallel=cfg.sequence_parallel,
+                overlap_comm=cfg.overlap_comm, **_act_kw(cfg),
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="lm_head")(x)
         return logits, aux
 
     def loss(self, input_ids, labels, ignore_index: int = -100):
@@ -375,9 +404,13 @@ def mixtral_forward_with_cache(cfg: MixtralConfig, params,
             out_axes=0,
             length=cfg.num_layers,
         )(cfg)
-        x, (new_k, new_v) = scanned.apply(
+        pool_quantized = isinstance(kv_cache, QuantizedPagedKVCache)
+        cache_kv = ((kv_cache.k, kv_cache.v, kv_cache.k_scale,
+                     kv_cache.v_scale) if pool_quantized
+                    else (kv_cache.k, kv_cache.v))
+        x, new_kv = scanned.apply(
             {"params": p["model"]["layers"]}, x,
-            (kv_cache.k, kv_cache.v), slot_pos, tok_tables, write_idx,
+            cache_kv, slot_pos, tok_tables, write_idx,
             cos, sin, rope_pos)
     else:
         slot_pos = jax.lax.dynamic_update_slice_in_dim(
@@ -391,20 +424,30 @@ def mixtral_forward_with_cache(cfg: MixtralConfig, params,
             out_axes=0,
             length=cfg.num_layers,
         )(cfg)
-        x, (new_k, new_v) = scanned.apply(
+        x, new_kv = scanned.apply(
             {"params": p["model"]["layers"]}, x, (kv_cache.k, kv_cache.v),
             slot_pos, cos, sin, rope_pos, kv_cache.index)
 
     x = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype).apply(
         {"params": p["model"]["norm"]}, x)
-    head = pl.ColumnParallelLinear(
-        features=cfg.vocab_size, use_bias=False, gather_output=True,
-        overlap_comm=cfg.overlap_comm, **_act_kw(cfg),
-        dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+    if cfg.weight_quant is not None:
+        head = _quant_lm_head(cfg, True)
+    else:
+        head = pl.ColumnParallelLinear(
+            features=cfg.vocab_size, use_bias=False, gather_output=True,
+            overlap_comm=cfg.overlap_comm, **_act_kw(cfg),
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype)
     logits = head.apply({"params": p["lm_head"]}, x)
     if paged:
-        new_cache = kv_cache.replace(k=new_k, v=new_v, pos=slot_pos)
+        if isinstance(kv_cache, QuantizedPagedKVCache):
+            new_k, new_v, nks, nvs = new_kv
+            new_cache = kv_cache.replace(k=new_k, v=new_v, k_scale=nks,
+                                         v_scale=nvs, pos=slot_pos)
+        else:
+            new_k, new_v = new_kv
+            new_cache = kv_cache.replace(k=new_k, v=new_v, pos=slot_pos)
     else:
+        new_k, new_v = new_kv
         new_cache = KVCache(k=new_k, v=new_v, pos=slot_pos,
                             index=kv_cache.index + s)
     return logits, new_cache

@@ -16,7 +16,7 @@ from typing import Any, Optional
 logger = logging.getLogger(__name__)
 
 _DISPATCH_MODES = ("capacity", "blockwise")
-_EXPERT_IMPLS = ("float", "mx_fp4", "mx_fp8")
+_EXPERT_IMPLS = ("float", "int8", "fp8", "mx_fp4", "mx_fp8")
 _ROUTER_TYPES = ("top_k", "sinkhorn", "group_limited")
 
 MX_BLOCK = 32

@@ -107,6 +107,26 @@ class MoE(nn.Module):
                 mx_format=self.expert_impl[len("mx_"):],
                 dtype=self.dtype, param_dtype=self.param_dtype,
                 name="experts")
+        elif self.expert_impl in ("int8", "fp8"):
+            if self.dispatch_mode != "capacity":
+                raise ValueError(
+                    f"expert_impl={self.expert_impl!r} supports only "
+                    f"dispatch_mode='capacity' (got "
+                    f"{self.dispatch_mode!r}); use float experts for "
+                    "blockwise/dropless dispatch")
+            from ...quantization.quantization_layers import \
+                QuantizedExpertMLPs
+            from ...quantization.quantization_utils import QuantizedDtype
+
+            experts = QuantizedExpertMLPs(
+                num_experts=self.num_experts, hidden_size=h,
+                intermediate_size=self.intermediate_size,
+                top_k=gates.shape[-1], capacity_factor=self.capacity_factor,
+                quantized_dtype=(QuantizedDtype.INT8
+                                 if self.expert_impl == "int8"
+                                 else QuantizedDtype.FP8E4M3),
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                name="experts")
         elif self.expert_impl != "float":
             raise ValueError(f"unknown expert_impl {self.expert_impl!r}")
         else:

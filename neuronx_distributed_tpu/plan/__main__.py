@@ -112,6 +112,18 @@ def main(argv=None) -> int:
                     help="rank colocated vs two-tier fabric configs "
                          "(disagg candidates pay the DCN KV-handoff "
                          "term)")
+    ap.add_argument("--weight-quant", default=None, metavar="FMT[,FMT...]",
+                    help="comma-separated weight-quant tiers to rank next "
+                         "to float (int8, fp8, mxfp4, mxfp8); float always "
+                         "competes in the same ranking")
+    ap.add_argument("--quality-bar", type=float, default=None,
+                    metavar="RATE", help="minimum recorded greedy "
+                    "match-rate a quantized tier must clear; tiers with "
+                    "no recorded quality are refused (fail closed)")
+    ap.add_argument("--quality-file", default=None, metavar="JSON",
+                    help="per-tier quality records as bench --quantized "
+                         "emits them (a JSON object mapping tier name to "
+                         "a match-rate or a {'greedy_match': ...} record)")
     ap.add_argument("--slo-ttft-p99-ms", type=float, default=None,
                     help="TTFT p99 target (ms) the serving config must "
                          "meet")
@@ -231,6 +243,14 @@ def main(argv=None) -> int:
         # keep picking cp=1
         free = max(1, args.devices // best.tp)
         cps = tuple(c for c in range(1, free + 1) if free % c == 0)
+        weight_quants = (None,)
+        if args.weight_quant:
+            weight_quants += tuple(
+                w.strip() for w in args.weight_quant.split(",") if w.strip())
+        quality = None
+        if args.quality_file is not None:
+            with open(args.quality_file) as f:
+                quality = _json.load(f)
         plans = serving_search(spec, hw, traffic,
                                slo_ttft_p99_s=ttft_tgt,
                                slo_tpot_p99_s=tpot_tgt,
@@ -239,6 +259,9 @@ def main(argv=None) -> int:
                                cross_host=args.cross_host,
                                speculation=spec_term,
                                cps=cps,
+                               weight_quants=weight_quants,
+                               quality=quality,
+                               quality_bar=args.quality_bar,
                                top_k=args.top_k)
         print(f"serving plan: rate={traffic.request_rate:g} req/s, "
               f"prompt={traffic.prompt_tokens:g}, "

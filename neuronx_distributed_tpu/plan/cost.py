@@ -213,6 +213,10 @@ class Plan:
     sequence_parallel: bool = False
     remat: bool = True
     num_microbatches: int = 1
+    # serving weight-quantization tier (ParallelConfig.weight_quant /
+    # EngineConfig.weight_quant): shrinks the resident param bytes by the
+    # format's storage ratio and taxes compute with the dequant overhead
+    weight_quant: Optional[str] = None
 
     def describe(self) -> str:
         tags = [f"tp={self.tp}", f"pp={self.pp}", f"dp={self.dp}"]
@@ -235,6 +239,8 @@ class Plan:
             tags.append("ep-overlap")
         if self.sequence_parallel:
             tags.append("sp")
+        if self.weight_quant is not None:
+            tags.append(f"w:{self.weight_quant}")
         return " ".join(tags)
 
 
@@ -449,7 +455,8 @@ def memory_bytes(plan: Plan, m: ModelSpec, hw: HardwareSpec,
     to the pool)."""
     shard = param_count(m) / (plan.tp * plan.pp)
     if serving is not None:
-        params = shard * m.act_bytes
+        params = shard * weight_storage_bytes_per_param(
+            plan.weight_quant, m.act_bytes)
         kv = _kv_pool_bytes(m, serving, plan.tp, cp=plan.cp)
         return dict(params=params, grads=0.0, opt=0.0, acts=0.0, kv=kv,
                     total=params + kv)
@@ -687,6 +694,32 @@ class SpeculationSpec:
 #: dequant tax on a quantized KV pool: the packed step spends extra
 #: element-wise work unpacking int8 KV before attention.
 QUANTIZED_COMPUTE_OVERHEAD = 1.1
+#: stored bytes per weight element under each weight_quant tier:
+#: int8/fp8 carry one byte plus a per-out-channel fp32 scale (amortized
+#: to ~0 over the contraction dim); MX packs 2 fp4 codes per byte (0.5)
+#: or 1 fp8 code (1.0) plus one fp32 scale per 32-element block (4/32)
+WEIGHT_QUANT_STORAGE_BYTES = {"int8": 1.0, "fp8": 1.0,
+                              "mxfp4": 0.625, "mxfp8": 1.125}
+#: dequant tax on weight-quantized projections: every matmul first
+#: expands the packed kernel to the compute dtype (element-wise work
+#: proportional to the weight bytes read, mostly hidden under the DMA
+#: it shrinks — the residual tax is what the drills measure)
+WEIGHT_QUANT_COMPUTE_OVERHEAD = 1.15
+
+
+def weight_storage_bytes_per_param(weight_quant: Optional[str],
+                                   act_bytes: float) -> float:
+    """Resident bytes per weight element: the serving copy is stored in
+    the compute dtype (``act_bytes``) unless a ``weight_quant`` tier
+    packs it."""
+    if weight_quant is None:
+        return act_bytes
+    try:
+        return WEIGHT_QUANT_STORAGE_BYTES[weight_quant]
+    except KeyError:
+        raise ValueError(
+            f"unknown weight_quant {weight_quant!r}; expected one of "
+            f"{sorted(WEIGHT_QUANT_STORAGE_BYTES)}")
 #: p99/mean inflation applied when checking a modeled mean against a p99
 #: SLO target. TTFT inherits the arrival process's queueing variance
 #: (M/G/1-ish); TPOT is step-paced and much tighter.
@@ -700,7 +733,8 @@ REQUEST_TOKENS_MAX_OVER_MEAN = 2.0
 
 
 def serving_token_s(m: ModelSpec, hw: HardwareSpec, *, context: float = 0.0,
-                    tp: int = 1, quantized: bool = False) -> float:
+                    tp: int = 1, quantized: bool = False,
+                    weight_quant: Optional[str] = None) -> float:
     """Marginal wall time of one extra row in a packed serving step:
     forward matmul FLOPs for one token plus its attention reads over
     ``context`` cached KV entries, at the hardware's dense efficiency.
@@ -710,6 +744,8 @@ def serving_token_s(m: ModelSpec, hw: HardwareSpec, *, context: float = 0.0,
     flops += 4.0 * context * m.heads * m.head_dim_ * m.layers
     if quantized:
         flops *= QUANTIZED_COMPUTE_OVERHEAD
+    if weight_quant is not None:
+        flops *= WEIGHT_QUANT_COMPUTE_OVERHEAD
     return flops / (max(1, tp) * hw.flops * hw.mfu)
 
 
@@ -763,7 +799,8 @@ def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
                  quantized: bool = False, tp: int = 1,
                  cross_host: bool = False,
                  speculation: Optional[SpeculationSpec] = None,
-                 cp: int = 1, cp_wire_dtype: str = "int8"
+                 cp: int = 1, cp_wire_dtype: str = "int8",
+                 weight_quant: Optional[str] = None
                  ) -> ServingCost:
     """Steady-state TTFT / TPOT / goodput of one continuous-batching
     engine (``inference.engine.ServingEngine``) under Poisson load.
@@ -804,7 +841,7 @@ def serving_cost(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
     t = traffic
     token_s = serving_token_s(
         m, hw, context=t.prompt_tokens + t.new_tokens / 2.0,
-        tp=tp, quantized=quantized)
+        tp=tp, quantized=quantized, weight_quant=weight_quant)
     prompt_eff = t.unique_prompt_tokens
     tokens_per_req = prompt_eff + t.new_tokens
     # speculation: tokens landed per slot-step and verify rows burned
@@ -918,6 +955,8 @@ class ServingPlan:
             tags.append("prefix")
         if e.get("quantized"):
             tags.append("q8kv")
+        if e.get("weight_quant"):
+            tags.append(f"w:{e['weight_quant']}")
         if e.get("speculation"):
             sp = e["speculation"]
             tags.append(f"spec=k{sp['speculation_length']}"
@@ -941,6 +980,9 @@ def serving_search(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
                    cross_host: bool = False,
                    speculation: Optional[SpeculationSpec] = None,
                    cps: tuple = (1,),
+                   weight_quants: tuple = (None,),
+                   quality: Optional[dict] = None,
+                   quality_bar: Optional[float] = None,
                    top_k: int = 5) -> list:
     """Enumerate (token_budget, max_slots[, prefill_budget]) engine
     configs for the stated traffic and SLO, score each with
@@ -963,94 +1005,142 @@ def serving_search(m: ModelSpec, hw: HardwareSpec, traffic: TrafficSpec, *,
     ``router["fabric"]`` hint, so the ranking itself answers
     disagg-vs-colocated for the stated traffic mix.
 
+    ``weight_quants`` adds the low-precision tier axis: each non-None
+    entry ("int8" | "fp8" | "mxfp4" | "mxfp8") models serving with the
+    weights packed at that format — resident param bytes shrink by the
+    format's storage ratio (which is what frees HBM for pool blocks at
+    an equal budget) and the marginal token cost carries the dequant
+    tax. Quantized tiers are **quality-gated**: with ``quality_bar``
+    set, a tier is only proposed when ``quality`` (a mapping from
+    format to its *recorded* greedy match-rate vs fp32 — either the
+    rate itself or a dict with a ``"greedy_match"`` key, the shape
+    ``bench.py --quantized`` emits) attests a match-rate >= the bar.
+    A tier with no recorded quality is refused outright (fail-closed):
+    the planner does not guess what quantization does to a model.
+
     Ranking: SLO-feasible before infeasible, unsaturated before
     saturated, then highest goodput; among configs within 2% of the best
     goodput, the lowest modeled TTFT wins (burst absorption), then the
     smallest ``token_budget`` / ``max_slots`` — headroom you don't need
     is compile width and pool memory you pay for. Candidates whose KV
-    pool would not fit ``hw.memory_budget`` are dropped."""
+    pool plus resident weight bytes would not fit ``hw.memory_budget``
+    are dropped."""
     seq_cap = m.seq
     need = traffic.prompt_tokens + traffic.new_tokens
+    tiers = []
+    for wq in weight_quants:
+        wq = wq or None
+        if wq is not None:
+            if wq not in WEIGHT_QUANT_STORAGE_BYTES:
+                raise ValueError(
+                    f"unknown weight_quant tier {wq!r}; expected one of "
+                    f"{sorted(WEIGHT_QUANT_STORAGE_BYTES)} or None")
+            if quality_bar is not None:
+                rec = (quality or {}).get(wq)
+                if isinstance(rec, dict):
+                    rec = rec.get("greedy_match")
+                if rec is None or rec < quality_bar:
+                    # refused: no recorded quality, or recorded quality
+                    # below the stated bar — the tier never enters the
+                    # ranking, so the emitted config cannot pick it
+                    continue
+        if wq not in tiers:
+            tiers.append(wq)
     cands = []
     for cp in sorted({max(1, int(c)) for c in cps}):
         if cp > 1 and (quantized or speculation is not None):
             continue    # the engine rejects these next to cp > 1
+        cp_tiers = [w for w in tiers if w is None] if cp > 1 else tiers
         # the CP group holds the pool together: each rank carries 1/cp
         # of the blocks, so memory feasibility is judged per rank
         t_eff = traffic
         if cp > 1 and traffic.shared_prefix_tokens > 0:
             t_eff = dataclasses.replace(traffic, shared_prefix_tokens=0.0)
-        for budget in budgets:
-            for ms in slots:
-                if ms > budget * 2:
-                    continue
-                nb_total = serving_pool_blocks(m, t_eff,
-                                               block_size=block_size,
-                                               max_slots=ms)
-                nblocks = math.ceil(nb_total / cp)
-                spec = ServingSpec(num_blocks=nblocks,
-                                   block_size=block_size,
-                                   quantized=quantized,
-                                   kv_bytes=1 if quantized else 2)
-                if _kv_pool_bytes(m, spec, tp) > hw.memory_budget:
-                    continue
-                if cp > 1:
-                    pf_opts = [None]    # cp+disaggregated is rejected
-                elif cross_host:
-                    # both topologies compete in one ranking
-                    pf_opts = [None, max(ms, budget // 4)]
-                elif disaggregated:
-                    pf_opts = [max(ms, budget // 4)]
-                else:
-                    pf_opts = [None]
-                for pf in pf_opts:
-                    fabric = cross_host and pf is not None
-                    cost = serving_cost(m, hw, t_eff, token_budget=budget,
-                                        max_slots=ms, prefill_budget=pf,
-                                        quantized=quantized, tp=tp,
-                                        cross_host=fabric,
-                                        speculation=speculation, cp=cp)
-                    meets = (cost.ttft_s * TTFT_P99_OVER_MEAN
-                             <= slo_ttft_p99_s
-                             and cost.tpot_s * TPOT_P99_OVER_MEAN
-                             <= slo_tpot_p99_s
-                             and not cost.saturated)
-                    mbps = max(1, math.ceil(
-                        min(need * REQUEST_TOKENS_MAX_OVER_MEAN, seq_cap)
-                        / block_size))
-                    # the CP prefill width must tile over the cp ranks
-                    mbps = cp * math.ceil(mbps / cp)
-                    engine = dict(block_size=block_size,
-                                  num_blocks=nblocks,
-                                  max_slots=ms, max_blocks_per_seq=mbps,
-                                  token_budget=budget)
+        for wq in cp_tiers:
+            # resident weights compete with the pool for HBM: a packed
+            # tier frees (act_bytes - storage) per param, which is what
+            # buys it extra blocks at an equal budget
+            w_bytes = (param_count(m) / max(1, tp)
+                       * weight_storage_bytes_per_param(wq, m.act_bytes))
+            for budget in budgets:
+                for ms in slots:
+                    if ms > budget * 2:
+                        continue
+                    nb_total = serving_pool_blocks(m, t_eff,
+                                                   block_size=block_size,
+                                                   max_slots=ms)
+                    nblocks = math.ceil(nb_total / cp)
+                    spec = ServingSpec(num_blocks=nblocks,
+                                       block_size=block_size,
+                                       quantized=quantized,
+                                       kv_bytes=1 if quantized else 2)
+                    if (w_bytes + _kv_pool_bytes(m, spec, tp)
+                            > hw.memory_budget):
+                        continue
                     if cp > 1:
-                        engine["cp"] = cp
-                        engine["cp_wire_dtype"] = "int8"
-                    if quantized:
-                        engine["quantized"] = True
-                    if t_eff.shared_prefix_tokens > 0:
-                        engine["prefix_sharing"] = True
-                    if pf is not None:
-                        engine["disaggregated"] = True
-                        engine["prefill_budget"] = pf
-                    if speculation is not None:
-                        engine["speculation"] = dict(
-                            speculation_length=speculation.length,
-                            num_branches=speculation.branches)
-                    slo = dict(ttft_p99_s=slo_ttft_p99_s,
-                               tpot_p99_s=slo_tpot_p99_s)
-                    router = {}
-                    if math.isfinite(slo_ttft_p99_s) \
-                            or math.isfinite(slo_tpot_p99_s):
-                        router["slo"] = {k: v for k, v in slo.items()
-                                         if math.isfinite(v)}
-                    if fabric:
-                        router["fabric"] = {"prefill_replicas": 1,
-                                            "decode_replicas": 1}
-                    cands.append(ServingPlan(engine=engine, router=router,
-                                             cost=cost, meets_slo=meets,
-                                             slo=slo))
+                        pf_opts = [None]   # cp+disaggregated is rejected
+                    elif cross_host:
+                        # both topologies compete in one ranking
+                        pf_opts = [None, max(ms, budget // 4)]
+                    elif disaggregated:
+                        pf_opts = [max(ms, budget // 4)]
+                    else:
+                        pf_opts = [None]
+                    for pf in pf_opts:
+                        fabric = cross_host and pf is not None
+                        cost = serving_cost(m, hw, t_eff,
+                                            token_budget=budget,
+                                            max_slots=ms,
+                                            prefill_budget=pf,
+                                            quantized=quantized, tp=tp,
+                                            cross_host=fabric,
+                                            speculation=speculation,
+                                            cp=cp, weight_quant=wq)
+                        meets = (cost.ttft_s * TTFT_P99_OVER_MEAN
+                                 <= slo_ttft_p99_s
+                                 and cost.tpot_s * TPOT_P99_OVER_MEAN
+                                 <= slo_tpot_p99_s
+                                 and not cost.saturated)
+                        mbps = max(1, math.ceil(
+                            min(need * REQUEST_TOKENS_MAX_OVER_MEAN,
+                                seq_cap) / block_size))
+                        # the CP prefill width must tile over the cp ranks
+                        mbps = cp * math.ceil(mbps / cp)
+                        engine = dict(block_size=block_size,
+                                      num_blocks=nblocks,
+                                      max_slots=ms,
+                                      max_blocks_per_seq=mbps,
+                                      token_budget=budget)
+                        if cp > 1:
+                            engine["cp"] = cp
+                            engine["cp_wire_dtype"] = "int8"
+                        if quantized:
+                            engine["quantized"] = True
+                        if wq is not None:
+                            engine["weight_quant"] = wq
+                        if t_eff.shared_prefix_tokens > 0:
+                            engine["prefix_sharing"] = True
+                        if pf is not None:
+                            engine["disaggregated"] = True
+                            engine["prefill_budget"] = pf
+                        if speculation is not None:
+                            engine["speculation"] = dict(
+                                speculation_length=speculation.length,
+                                num_branches=speculation.branches)
+                        slo = dict(ttft_p99_s=slo_ttft_p99_s,
+                                   tpot_p99_s=slo_tpot_p99_s)
+                        router = {}
+                        if math.isfinite(slo_ttft_p99_s) \
+                                or math.isfinite(slo_tpot_p99_s):
+                            router["slo"] = {k: v for k, v in slo.items()
+                                             if math.isfinite(v)}
+                        if fabric:
+                            router["fabric"] = {"prefill_replicas": 1,
+                                                "decode_replicas": 1}
+                        cands.append(ServingPlan(engine=engine,
+                                                 router=router,
+                                                 cost=cost, meets_slo=meets,
+                                                 slo=slo))
     # rank on per-mesh goodput: a cp-degree replica occupies cp meshes,
     # so its goodput must beat cp plain replicas' — CP is for prompts
     # one mesh cannot hold, not a free TTFT tie-break

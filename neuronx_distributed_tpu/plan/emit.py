@@ -53,6 +53,8 @@ def plan_to_config_kwargs(plan: Plan) -> Dict[str, Any]:
         kwargs["moe_overlap_dispatch"] = True
     if plan.sequence_parallel:
         kwargs["sequence_parallel"] = True
+    if plan.weight_quant is not None:
+        kwargs["weight_quant"] = plan.weight_quant
     opt = OptimizerConfig(
         zero_one_enabled=plan.zero1,
         grad_comm_dtype=plan.grad_comm_dtype,

@@ -232,4 +232,4 @@ def _plan_key(p: Plan) -> tuple:
     return (p.tp, p.pp, p.cp, p.dp, p.ep, p.num_microbatches,
             p.grad_comm_dtype, p.tp_act_comm_dtype,
             p.grad_comm_hierarchical, p.tp_overlap,
-            p.ep_wire_dtype, p.ep_overlap)
+            p.ep_wire_dtype, p.ep_overlap, p.weight_quant)

@@ -5,13 +5,18 @@ from . import mx_layers
 from . import quantization_layers
 from . import quantization_utils
 from . import quantize as quantize_api
-from .mx_layers import (MXExpertMLPs, MXQuantizedColumnParallel,
-                        MXQuantizedRowParallel, mx_pack_expert_params,
-                        mx_pack_linear)
-from .quantization_layers import QuantizedColumnParallel, QuantizedRowParallel
+from . import serving
+from .mx_layers import (MXExpertMLPs, MXGQAQKVColumnParallelLinear,
+                        MXQuantizedColumnParallel, MXQuantizedRowParallel,
+                        mx_pack_expert_params, mx_pack_linear)
+from .quantization_layers import (QuantizedColumnParallel,
+                                  QuantizedExpertMLPs,
+                                  QuantizedGQAQKVColumnParallelLinear,
+                                  QuantizedRowParallel)
 from .quantization_utils import (QuantizationType, QuantizedDtype,
                                  dequantize, direct_cast_quantize, quantize)
 from .quantize import convert
+from .serving import params_are_quantized, quantize_params_for_serving
 
 __all__ = [
     "microscaling",
@@ -19,12 +24,16 @@ __all__ = [
     "quantization_layers",
     "quantization_utils",
     "quantize_api",
+    "serving",
     "MXExpertMLPs",
+    "MXGQAQKVColumnParallelLinear",
     "MXQuantizedColumnParallel",
     "MXQuantizedRowParallel",
     "mx_pack_expert_params",
     "mx_pack_linear",
     "QuantizedColumnParallel",
+    "QuantizedExpertMLPs",
+    "QuantizedGQAQKVColumnParallelLinear",
     "QuantizedRowParallel",
     "QuantizationType",
     "QuantizedDtype",
@@ -32,4 +41,6 @@ __all__ = [
     "direct_cast_quantize",
     "quantize",
     "convert",
+    "params_are_quantized",
+    "quantize_params_for_serving",
 ]

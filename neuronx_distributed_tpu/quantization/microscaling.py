@@ -38,8 +38,13 @@ def mx_quantize_fp4(w, block_size: int = MX_BLOCK
         raise ValueError(f"last dim {n} must be divisible by {block_size}")
     blocks = w.reshape(*w.shape[:-1], n // block_size, block_size)
     amax = np.abs(blocks).max(axis=-1, keepdims=True)
-    # E8M0: power-of-two scale so the block max lands within the grid
-    exp = np.where(amax > 0, np.ceil(np.log2(amax / _FP4_MAX)), 0.0)
+    # E8M0: power-of-two scale so the block max lands within the grid.
+    # All-zero blocks keep scale 1 (floor amax inside the log so the
+    # discarded branch never evaluates log2(0)); their codes are all 0,
+    # so they dequantize to exact zeros.
+    exp = np.where(amax > 0,
+                   np.ceil(np.log2(np.where(amax > 0, amax, 1.0)
+                                   / _FP4_MAX)), 0.0)
     scale = np.exp2(exp)
     scaled = blocks / scale
     # round magnitudes to the nearest grid point
@@ -82,7 +87,10 @@ def mx_quantize_fp8(w, block_size: int = MX_BLOCK
         raise ValueError(f"last dim {n} must be divisible by {block_size}")
     blocks = w.reshape(*w.shape[:-1], n // block_size, block_size)
     amax = np.abs(blocks).max(axis=-1, keepdims=True)
-    exp = np.where(amax > 0, np.ceil(np.log2(amax / 448.0)), 0.0)
+    # all-zero blocks keep scale 1 and dequantize to exact zeros (see fp4)
+    exp = np.where(amax > 0,
+                   np.ceil(np.log2(np.where(amax > 0, amax, 1.0) / 448.0)),
+                   0.0)
     scale = np.exp2(exp)
     q = (blocks / scale).astype(ml_dtypes.float8_e4m3fn)
     return (q.reshape(*w.shape[:-1], n),

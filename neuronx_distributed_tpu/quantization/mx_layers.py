@@ -163,6 +163,108 @@ class MXQuantizedRowParallel(nn.Module):
         return y
 
 
+class MXGQAQKVColumnParallelLinear(nn.Module):
+    """Fused Q/K/V projection from packed MX weights with GQA support —
+    the MX variant of
+    :class:`...parallel.layers.GQAQKVColumnParallelLinear` (same KV
+    replication contract; see
+    :class:`.quantization_layers.QuantizedGQAQKVColumnParallelLinear`).
+
+    Params (contraction dim last): ``{q,k,v}_kernel_packed
+    [out, in_packed]`` + ``{q,k,v}_kernel_scale [out, in/32]``.
+    """
+
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    mx_format: str = "fp4"
+    sequence_parallel: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    axis: str = ps.TP_AXIS
+    seq_dim: int = 1
+    tp_size: Optional[int] = None
+
+    def _tp(self) -> int:
+        s = pl._bound_size(self.axis)
+        if s is not None:
+            return s
+        if self.tp_size is not None:
+            return self.tp_size
+        if ps.model_parallel_is_initialized():
+            return ps.get_tensor_model_parallel_size()
+        return 1
+
+    def _mx_param(self, name: str, out_dim: int, in_dim: int, out_name):
+        pack, store_dt = _mx_storage(self.mx_format)
+        packed = self.param(
+            f"{name}_packed",
+            nn.with_partitioning(lambda key, s, d: jnp.zeros(s, d),
+                                 (out_name, None)),
+            (out_dim, in_dim // pack), store_dt)
+        scale = self.param(
+            f"{name}_scale",
+            nn.with_partitioning(nn.initializers.ones_init(),
+                                 (out_name, None)),
+            (out_dim, in_dim // MX_BLOCK), jnp.float32)
+        return packed, scale
+
+    @nn.compact
+    def __call__(self, x: jax.Array):
+        tp = self._tp()
+        mult = max(1, tp // self.num_kv_heads)
+        if mult > 1 and tp % self.num_kv_heads != 0:
+            raise ValueError(
+                f"tp size {tp} must be a multiple of num_kv_heads "
+                f"{self.num_kv_heads} when tp > num_kv_heads")
+        if mult == 1 and self.num_kv_heads % tp != 0:
+            raise ValueError(
+                f"num_kv_heads {self.num_kv_heads} not divisible by tp {tp}")
+        in_dim = x.shape[-1]
+        q_features = self.num_heads * self.head_dim
+        kv_features = self.num_kv_heads * self.head_dim
+        q_local = pl._maybe_local(q_features, self.axis)
+
+        qp, qs = self._mx_param("q_kernel", q_local, in_dim, self.axis)
+        if mult == 1:
+            kv_out = pl._maybe_local(kv_features, self.axis)
+            kv_name: Optional[str] = self.axis
+        else:
+            kv_out, kv_name = kv_features, None
+        kp, ks = self._mx_param("k_kernel", kv_out, in_dim, kv_name)
+        vp, vs = self._mx_param("v_kernel", kv_out, in_dim, kv_name)
+
+        wq = _mx_dequant(qp, qs, self.mx_format, self.dtype)  # [out, in]
+        wk = _mx_dequant(kp, ks, self.mx_format, self.dtype)
+        wv = _mx_dequant(vp, vs, self.mx_format, self.dtype)
+        if mult > 1 and pl._bound_size(self.axis) is not None:
+            wk = mappings.copy_to_tensor_parallel_region(wk, self.axis)
+            wv = mappings.copy_to_tensor_parallel_region(wv, self.axis)
+            head = jax.lax.axis_index(self.axis) // mult
+            wk = jax.lax.dynamic_slice_in_dim(
+                wk, head * self.head_dim, self.head_dim, axis=0)
+            wv = jax.lax.dynamic_slice_in_dim(
+                wv, head * self.head_dim, self.head_dim, axis=0)
+
+        if self.sequence_parallel:
+            x = mappings.gather_from_sequence_parallel_region(
+                x, self.axis, self.seq_dim, to_model_parallel=True)
+        else:
+            x = mappings.copy_to_tensor_parallel_region(x, self.axis)
+        x = x.astype(self.dtype)
+        dims = (((x.ndim - 1,), (1,)), ((), ()))
+        q = jax.lax.dot_general(x, wq, dims)
+        k = jax.lax.dot_general(x, wk, dims)
+        v = jax.lax.dot_general(x, wv, dims)
+        if pl._bound_size(self.axis) is None:
+            spec = [None] * (q.ndim - 1) + [self.axis]
+            q = ps.with_sharding_constraint(q, *spec)
+            if mult == 1:
+                k = ps.with_sharding_constraint(k, *spec)
+                v = ps.with_sharding_constraint(v, *spec)
+        return q, k, v
+
+
 class MXExpertMLPs(nn.Module):
     """Stacked expert GLU bank from packed MX weights — the reference's
     flagship MX consumer (``experimental/expert_mlps_mx.py:299``): MoE

@@ -148,6 +148,108 @@ class QuantizedRowParallel(_QuantBase):
         return y
 
 
+class QuantizedGQAQKVColumnParallelLinear(nn.Module):
+    """Weight-quantized (w8a16) fused Q/K/V projection with GQA support —
+    the quantized variant of
+    :class:`...parallel.layers.GQAQKVColumnParallelLinear` the serving
+    forward swaps in under ``weight_quant`` (reference
+    ``modules/qkv_linear.py:371`` + ``quantization_layers.py:465``).
+
+    Params: ``{q,k,v}_kernel_q`` int8/fp8 ``[in, out]`` + per-out-channel
+    f32 ``{q,k,v}_kernel_scale``. Same KV replication contract as the float
+    layer: when ``tp > num_kv_heads`` the KV kernels stay replicated (one
+    stored copy per KV head), are dequantized, copied into the TP region
+    and head-sliced per shard.
+    """
+
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    quantized_dtype: QuantizedDtype = QuantizedDtype.INT8
+    sequence_parallel: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    axis: str = ps.TP_AXIS
+    seq_dim: int = 1
+    tp_size: Optional[int] = None
+
+    def _tp(self) -> int:
+        s = pl._bound_size(self.axis)
+        if s is not None:
+            return s
+        if self.tp_size is not None:
+            return self.tp_size
+        if ps.model_parallel_is_initialized():
+            return ps.get_tensor_model_parallel_size()
+        return 1
+
+    def _qkv_param(self, name: str, shape, names):
+        q = self.param(
+            f"{name}_q",
+            nn.with_partitioning(lambda key, s, d: jnp.zeros(s, d), names),
+            shape, self.quantized_dtype.jnp_dtype)
+        scale = self.param(
+            f"{name}_scale",
+            nn.with_partitioning(nn.initializers.ones_init(), (names[-1],)),
+            (shape[-1],), jnp.float32)
+        return q, scale
+
+    @nn.compact
+    def __call__(self, x: jax.Array):
+        tp = self._tp()
+        mult = max(1, tp // self.num_kv_heads)
+        if mult > 1 and tp % self.num_kv_heads != 0:
+            raise ValueError(
+                f"tp size {tp} must be a multiple of num_kv_heads "
+                f"{self.num_kv_heads} when tp > num_kv_heads")
+        if mult == 1 and self.num_kv_heads % tp != 0:
+            raise ValueError(
+                f"num_kv_heads {self.num_kv_heads} not divisible by tp {tp}")
+        q_features = self.num_heads * self.head_dim
+        kv_features = self.num_kv_heads * self.head_dim
+        q_local = pl._maybe_local(q_features, self.axis)
+
+        qq, qs = self._qkv_param("q_kernel", (x.shape[-1], q_local),
+                                 (None, self.axis))
+        if mult == 1:
+            kv_names = (None, self.axis)
+            kv_shape = (x.shape[-1], pl._maybe_local(kv_features, self.axis))
+        else:
+            kv_names = (None, None)
+            kv_shape = (x.shape[-1], kv_features)
+        kq, ks = self._qkv_param("k_kernel", kv_shape, kv_names)
+        vq, vs = self._qkv_param("v_kernel", kv_shape, kv_names)
+
+        wq = dequantize(qq, qs[None, :], self.dtype)
+        wk = dequantize(kq, ks[None, :], self.dtype)
+        wv = dequantize(vq, vs[None, :], self.dtype)
+        if mult > 1 and pl._bound_size(self.axis) is not None:
+            wk = mappings.copy_to_tensor_parallel_region(wk, self.axis)
+            wv = mappings.copy_to_tensor_parallel_region(wv, self.axis)
+            head = jax.lax.axis_index(self.axis) // mult
+            wk = jax.lax.dynamic_slice_in_dim(
+                wk, head * self.head_dim, self.head_dim, axis=1)
+            wv = jax.lax.dynamic_slice_in_dim(
+                wv, head * self.head_dim, self.head_dim, axis=1)
+
+        if self.sequence_parallel:
+            x = mappings.gather_from_sequence_parallel_region(
+                x, self.axis, self.seq_dim, to_model_parallel=True)
+        else:
+            x = mappings.copy_to_tensor_parallel_region(x, self.axis)
+        x = x.astype(self.dtype)
+        q = jnp.dot(x, wq)
+        k = jnp.dot(x, wk)
+        v = jnp.dot(x, wv)
+        if pl._bound_size(self.axis) is None:
+            spec = [None] * (q.ndim - 1) + [self.axis]
+            q = ps.with_sharding_constraint(q, *spec)
+            if mult == 1:
+                k = ps.with_sharding_constraint(k, *spec)
+                v = ps.with_sharding_constraint(v, *spec)
+        return q, k, v
+
+
 class QuantizedExpertMLPs(nn.Module):
     """Weight-quantized stacked expert GLU bank (w8a16).
 

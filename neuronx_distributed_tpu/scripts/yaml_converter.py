@@ -63,7 +63,7 @@ def dict_to_config_kwargs(doc: Dict[str, Any]) -> Dict[str, Any]:
                      "tp_activation_comm_dtype",
                      "tp_activation_sync_fraction",
                      "moe_ep_wire_dtype", "moe_overlap_dispatch",
-                     "sequence_parallel", "seed"):
+                     "weight_quant", "sequence_parallel", "seed"):
             kwargs[key] = value
         else:
             raise ValueError(f"unknown config key {key!r}")
@@ -85,7 +85,8 @@ def config_to_dict(cfg) -> Dict[str, Any]:
     for key, value in kwargs.items():
         default = None if key in ("dcn_data_parallel_size",
                                   "tp_overlap_comm",
-                                  "moe_overlap_dispatch") else (
+                                  "moe_overlap_dispatch",
+                                  "weight_quant") else (
             False if key == "sequence_parallel" else
             0 if key == "seed" else
             "fp32" if key in ("tp_activation_comm_dtype",

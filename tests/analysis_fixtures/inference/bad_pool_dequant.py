@@ -1,0 +1,32 @@
+"""Fixture: quantization violations (whole-pool dequantize outside
+``ops/``). Lives under ``inference/`` so the scoped rule applies.
+Parsed, never imported."""
+
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.inference.kv_cache import dequantize_kv
+from neuronx_distributed_tpu.parallel.wire_codec import dequantize_blockwise
+
+
+def read_attention_inputs(cache, k_pool, k_scale, v_scale, dtype):
+    kf = dequantize_kv(k_pool, k_scale, dtype)          # BAD: whole pool
+    vf = dequantize_kv(cache.v_pool, v_scale, dtype)    # BAD: attr pool
+    return kf, vf
+
+
+def expand_tables(pool, tables, cfg):
+    # BAD: indexing a pool-named array still reads the resident pool
+    return dequantize_blockwise(pool.k[tables], pool.k_scale,
+                                pool.k.shape, cfg)
+
+
+def fine_per_layer_slice(cache_kv, dtype):
+    qk, qv, ks, vs = cache_kv
+    k_l = dequantize_kv(qk, ks, dtype)      # ok: contiguous layer slice
+    v_l = dequantize_kv(qv, vs, dtype)      # ok: bounded by batch
+    return k_l, v_l
+
+
+def fine_wire_chunk(q, s, shape, cfg):
+    # ok: payload chunk off the wire, not a resident pool
+    return jnp.asarray(dequantize_blockwise(q, s, shape, cfg))

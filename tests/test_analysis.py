@@ -422,6 +422,37 @@ def test_speculation_trace_fires_on_fixture():
     assert not any(f.line > 30 for f in live)
 
 
+def test_quantization_fires_on_fixture():
+    fs = _lint(os.path.join("inference", "bad_pool_dequant.py"))
+    assert _rules(fs) == {"quantization"}
+    live = [f for f in fs if not f.suppressed]
+    # two whole-pool dequantize_kv, one pool-indexed dequantize_blockwise;
+    # none of the ok: per-layer-slice / wire-chunk cases
+    assert len(live) == 3
+    msgs = " | ".join(f.message for f in live)
+    assert "`k_pool`" in msgs and "`cache.v_pool`" in msgs \
+        and "`pool.k`" in msgs
+    assert not any(f.line > 21 for f in live)
+
+
+def test_quantization_scoped_and_ops_exempt():
+    src = ("def read(k_pool, k_scale, dtype):\n"
+           "    return dequantize_kv(k_pool, k_scale, dtype)\n")
+    # inference/ and models/ are in scope
+    for where in ("mymodel/inference/engine.py", "mymodel/models/llama.py"):
+        fs = analyze_source(src, where, axes=DEFAULT_AXES)
+        assert [f.rule for f in fs] == ["quantization"], where
+    # ops/ owns the fused read; other packages are out of scope
+    for where in ("mymodel/ops/paged_attention.py", "mymodel/train/loop.py"):
+        assert analyze_source(src, where, axes=DEFAULT_AXES) == [], where
+    # per-layer slices are not pool-named: quiet even in scope
+    ok = ("def read(cache_kv, dtype):\n"
+          "    qk, qv, ks, vs = cache_kv\n"
+          "    return dequantize_kv(qk, ks, dtype)\n")
+    assert analyze_source(ok, "mymodel/models/llama.py",
+                          axes=DEFAULT_AXES) == []
+
+
 def test_speculation_trace_scoped_and_host_casts_exempt():
     bad = ("def verify_round(accepted, rows):\n"
            "    if accepted > 2:\n"
@@ -643,7 +674,7 @@ def test_cli_nonzero_on_fixture_corpus():
                          "comm-compression", "tp-overlap",
                          "serving-resilience", "paging-refcount", "plan",
                          "observability", "elasticity", "integrity",
-                         "slo", "speculation-trace"}
+                         "slo", "speculation-trace", "quantization"}
 
 
 def test_cli_zero_on_clean_file():

@@ -53,7 +53,7 @@ from neuronx_distributed_tpu.models.llama import (LlamaForCausalLM,
 from neuronx_distributed_tpu.parallel import mesh as ps
 from neuronx_distributed_tpu.plan import (ModelSpec, TrafficSpec,
                                           default_hardware, serving_search)
-from neuronx_distributed_tpu.plan.cost import serving_pool_blocks
+from neuronx_distributed_tpu.plan.cost import param_count, serving_pool_blocks
 from neuronx_distributed_tpu.resilience import FaultPlan
 
 
@@ -429,7 +429,11 @@ def test_serving_search_cp_plan_constructs_and_runs(tiny_model):
     nb1 = serving_pool_blocks(m, mix, block_size=8, max_slots=1)
     rank_bytes = pool_accounting(num_layers=4, num_blocks=nb1,
                                  block_size=8, num_kv_heads=8, head_dim=32)
-    hw = dataclasses.replace(_HW, hbm_bytes=rank_bytes / 2,
+    # resident weights are charged against the budget too, so the
+    # squeeze is weights + half the single-rank pool: cp=1 can't fit
+    # its pool, cp=4's quarter-pool shard fits
+    w_bytes = param_count(m) * m.act_bytes
+    hw = dataclasses.replace(_HW, hbm_bytes=w_bytes + rank_bytes / 2,
                              memory_fraction=1.0)
     plans = serving_search(m, hw, mix, cps=(1, 4))
     assert plans

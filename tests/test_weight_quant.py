@@ -1,0 +1,353 @@
+"""Weight-quantized serving tier tests (docs/quantization.md).
+
+Covers the whole thread: quantizer round-trip guards (all-zero /
+denormal inputs), the float-checkpoint converter vs the float forward
+per tier, engine serving per tier (greedy match vs ``generate()``,
+``compile_count()==1``), the weight_quant x cp/speculation/disagg/
+quantized-pool compatibility matrix, and the planner's weight-quant
+axis with its fail-closed quality gate.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+
+from neuronx_distributed_tpu.inference.engine import (EngineConfig,
+                                                      ServingEngine)
+from neuronx_distributed_tpu.inference.generation import generate
+from neuronx_distributed_tpu.inference.kv_cache import init_kv_cache
+from neuronx_distributed_tpu.models import llama as llama_mod
+from neuronx_distributed_tpu.models.llama import (WEIGHT_QUANT_FORMATS,
+                                                  LlamaForCausalLM,
+                                                  llama_forward_with_cache,
+                                                  tiny_config)
+from neuronx_distributed_tpu.parallel import mesh as ps
+from neuronx_distributed_tpu.quantization.serving import (
+    params_are_quantized, quantize_params_for_serving)
+
+# loose per-tier logit tolerances on a randomly-initialized tiny model;
+# the point is the ORDERING (narrower formats diverge more), not the
+# absolute values
+_TIER_TOL = {"int8": 0.5, "fp8": 1.0, "mxfp8": 1.5, "mxfp4": 8.0}
+
+
+# ---------------------------------------------------------------------------
+# quantizer guards (satellite: zero-amax / denormal round trips)
+# ---------------------------------------------------------------------------
+
+def test_quantize_all_zero_roundtrips_to_exact_zeros():
+    from neuronx_distributed_tpu.quantization.quantization_utils import (
+        QuantizedDtype, dequantize, quantize)
+
+    for qdt in (QuantizedDtype.INT8, QuantizedDtype.FP8E4M3):
+        q, scale = quantize(jnp.zeros((8, 16)), qdt)
+        out = np.asarray(dequantize(q, scale, jnp.float32))
+        assert np.all(out == 0.0), qdt
+        assert np.all(np.isfinite(np.asarray(scale, np.float32)))
+
+
+def test_mx_all_zero_roundtrips_to_exact_zeros():
+    from neuronx_distributed_tpu.quantization.microscaling import (
+        mx_dequantize_fp4, mx_dequantize_fp8, mx_quantize_fp4,
+        mx_quantize_fp8)
+
+    w = np.zeros((4, 64), np.float32)
+    p4, s4 = mx_quantize_fp4(w)
+    assert np.all(np.asarray(mx_dequantize_fp4(p4, s4,
+                                               dtype=jnp.float32)) == 0.0)
+    assert np.all(s4 == 1.0)          # all-zero blocks keep scale 1
+    q8, s8 = mx_quantize_fp8(w)
+    assert np.all(np.asarray(mx_dequantize_fp8(q8, s8,
+                                               dtype=jnp.float32)) == 0.0)
+    assert np.all(s8 == 1.0)
+
+
+def test_quantizers_finite_on_denormals_and_mixed_blocks():
+    from neuronx_distributed_tpu.quantization.microscaling import (
+        mx_dequantize_fp4, mx_dequantize_fp8, mx_quantize_fp4,
+        mx_quantize_fp8)
+    from neuronx_distributed_tpu.quantization.quantization_utils import (
+        QuantizedDtype, dequantize, quantize)
+
+    # denormal-magnitude rows next to ordinary rows and all-zero rows:
+    # every path must stay inf/nan-free
+    w = np.zeros((3, 64), np.float32)
+    w[0] = 1e-42                              # denormal
+    w[1] = np.linspace(-2.0, 2.0, 64)
+    q, scale = quantize(jnp.asarray(w), QuantizedDtype.INT8,
+                        channel_axis=0)
+    out = np.asarray(dequantize(q, scale, jnp.float32))
+    assert np.all(np.isfinite(out))
+    assert np.all(out[2] == 0.0)
+    for quant, dequant in ((mx_quantize_fp4, mx_dequantize_fp4),
+                           (mx_quantize_fp8, mx_dequantize_fp8)):
+        qq, ss = quant(w)
+        oo = np.asarray(dequant(qq, ss, dtype=jnp.float32))
+        assert np.all(np.isfinite(oo)) and np.all(np.isfinite(ss))
+        assert np.all(oo[2] == 0.0)
+
+
+# ---------------------------------------------------------------------------
+# converter + forward per tier
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def tiny_model():
+    ps.initialize_model_parallel()
+    cfg = tiny_config(dtype=jnp.float32, param_dtype=jnp.float32)
+    params = meta.unbox(LlamaForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    return cfg, params
+
+
+@pytest.mark.parametrize("fmt", WEIGHT_QUANT_FORMATS)
+def test_converted_forward_tracks_float(tiny_model, fmt):
+    cfg, params = tiny_model
+    cfg_q = dataclasses.replace(cfg, weight_quant=fmt)
+    params_q = quantize_params_for_serving(cfg_q, params)
+    assert params_are_quantized(params_q)
+    assert not params_are_quantized(params)
+    # converting an already-quantized tree is a no-op pass-through
+    assert quantize_params_for_serving(cfg_q, params_q) is params_q
+
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (1, 16)), jnp.int32)
+    pos = jnp.arange(16, dtype=jnp.int32)[None]
+
+    def run(c, p):
+        cache = init_kv_cache(c.num_layers, 1, 32, c.num_kv_heads,
+                              c.head_dim_, dtype=jnp.float32)
+        logits, _ = llama_forward_with_cache(c, p, ids, pos, cache)
+        return np.asarray(logits, np.float32)
+
+    ref = run(cfg, params)
+    got = run(cfg_q, params_q)
+    assert np.all(np.isfinite(got))
+    div = float(np.max(np.abs(got - ref)))
+    assert div < _TIER_TOL[fmt], f"{fmt}: max logit div {div}"
+    if fmt == "int8":               # widest tier: greedy argmax agrees
+        assert float(np.mean(np.argmax(got, -1)
+                             == np.argmax(ref, -1))) >= 0.8
+
+
+def test_mx_rejects_unaligned_contraction_dims():
+    with pytest.raises(ValueError, match="block-scaled"):
+        tiny_config(hidden_size=48, weight_quant="mxfp4")
+    tiny_config(weight_quant="mxfp4")       # 64/128/64 all % 32: fine
+
+
+# ---------------------------------------------------------------------------
+# engine serving per tier
+# ---------------------------------------------------------------------------
+
+def _ecfg(**kw):
+    base = dict(block_size=4, num_blocks=16, max_slots=2,
+                max_blocks_per_seq=8, token_budget=8,
+                kv_dtype=jnp.float32)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@pytest.mark.parametrize("fmt", ["int8", "mxfp8"])
+def test_engine_serves_quantized_tier(tiny_model, fmt):
+    cfg, params = tiny_model
+    prompt = np.random.RandomState(1).randint(
+        0, cfg.vocab_size, (7,)).tolist()
+    ref = np.asarray(generate(cfg, params, jnp.asarray([prompt]),
+                              jnp.array([7], jnp.int32), 8))[0].tolist()
+    # float params in: the engine converts at construction
+    eng = ServingEngine(cfg, params, _ecfg(weight_quant=fmt))
+    assert params_are_quantized(eng.params)
+    assert eng.model_cfg.weight_quant == fmt
+    eng.submit(prompt, max_new_tokens=8, uid="a")
+    res = eng.run()["a"]
+    assert res.status == "completed" and len(res.tokens) == 8
+    # int8 tracks the float greedy stream on this tiny model
+    if fmt == "int8":
+        match = np.mean([a == b for a, b in zip(res.tokens, ref)])
+        assert match >= 0.5, f"greedy match {match}"
+    assert eng.compile_count() == 1
+
+
+def test_engine_weight_quant_compat_matrix(tiny_model):
+    cfg, params = tiny_model
+
+    # x cp>1: pointed error (the ring prefill worker runs the float
+    # forward — PR 19's quantized-pool x cp error stays too)
+    with pytest.raises(ValueError, match="weight_quant"):
+        ServingEngine(cfg, params, _ecfg(weight_quant="int8", cp=2))
+    with pytest.raises(ValueError, match="quantized pools"):
+        ServingEngine(cfg, params, _ecfg(quantized=True, cp=2))
+
+    # unknown tier: rejected with the valid set in the message
+    with pytest.raises(ValueError, match="int4"):
+        ServingEngine(cfg, params, _ecfg(weight_quant="int4"))
+
+    prompt = list(range(1, 8))
+
+    # x int8 KV pool: weights and pool quantize independently
+    eng = ServingEngine(cfg, params, _ecfg(weight_quant="int8",
+                                           quantized=True,
+                                           kv_dtype=jnp.int8))
+    eng.submit(prompt, max_new_tokens=4, uid="a")
+    assert eng.run()["a"].status == "completed"
+
+    # x disaggregated prefill/decode
+    eng = ServingEngine(cfg, params, _ecfg(weight_quant="int8",
+                                           disaggregated=True))
+    eng.submit(prompt, max_new_tokens=4, uid="a")
+    assert eng.run()["a"].status == "completed"
+
+
+def test_engine_speculation_draft_quantizes_by_default(tiny_model):
+    from neuronx_distributed_tpu.inference.speculative import (
+        SpeculationConfig)
+
+    cfg, params = tiny_model
+    eng = ServingEngine(
+        cfg, params,
+        _ecfg(weight_quant="int8", num_blocks=32,
+              speculation=SpeculationConfig(speculation_length=2)),
+        draft_cfg=cfg, draft_params=params)
+    assert eng._draft_cfg.weight_quant == "int8"
+    assert params_are_quantized(eng._draft_params)
+    eng.submit(list(range(1, 8)), max_new_tokens=4, uid="a")
+    assert eng.run()["a"].status == "completed"
+    assert eng.compile_count() == 1
+
+
+def test_mixtral_engine_serves_quantized(tiny_model):
+    from neuronx_distributed_tpu.models.mixtral import (
+        MixtralForCausalLM, tiny_moe_config)
+
+    del tiny_model
+    ps.initialize_model_parallel()
+    cfg = tiny_moe_config(dtype=jnp.float32, param_dtype=jnp.float32)
+    params = meta.unbox(MixtralForCausalLM(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+    cfg_q = dataclasses.replace(cfg, weight_quant="int8")
+    assert cfg_q.moe_expert_impl_ == "int8"     # experts follow the tier
+    eng = ServingEngine(cfg, params, _ecfg(weight_quant="int8"))
+    eng.submit(list(range(1, 8)), max_new_tokens=4, uid="a")
+    assert eng.run()["a"].status == "completed"
+    assert eng.compile_count() == 1
+
+    # quantized experts need capacity dispatch: blockwise is rejected
+    with pytest.raises(ValueError, match="capacity"):
+        tiny_moe_config(weight_quant="int8", moe_dispatch="blockwise")
+
+
+# ---------------------------------------------------------------------------
+# config surface + planner
+# ---------------------------------------------------------------------------
+
+def test_config_threads_weight_quant():
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.config import configure_model
+
+    cfg = nxd.neuronx_distributed_config(init_mesh=False,
+                                         weight_quant="mxfp8")
+    assert cfg.parallel.weight_quant == "mxfp8"
+    mcfg = configure_model(cfg, tiny_config())
+    assert mcfg.weight_quant == "mxfp8"
+    # explicit model setting survives a None parallel knob
+    plain = nxd.neuronx_distributed_config(init_mesh=False)
+    pinned = configure_model(plain, tiny_config(weight_quant="int8"))
+    assert pinned.weight_quant == "int8"
+    with pytest.raises(ValueError, match="weight_quant"):
+        nxd.neuronx_distributed_config(init_mesh=False,
+                                       weight_quant="int3")
+
+
+def test_plan_emit_yaml_roundtrip_with_weight_quant():
+    from neuronx_distributed_tpu.plan.cost import Plan
+    from neuronx_distributed_tpu.plan.emit import (plan_to_config_kwargs,
+                                                   plan_to_yaml_dict)
+    from neuronx_distributed_tpu.scripts.yaml_converter import (
+        dict_to_config_kwargs)
+
+    plan = Plan(devices=1, tp=1, pp=1, dp=1, weight_quant="mxfp4")
+    assert "w:mxfp4" in plan.describe()
+    kw = plan_to_config_kwargs(plan)
+    assert kw["weight_quant"] == "mxfp4"
+    doc = plan_to_yaml_dict(plan)
+    assert doc["weight_quant"] == "mxfp4"
+    rebuilt = dict_to_config_kwargs(doc)
+    assert rebuilt["weight_quant"] == "mxfp4"
+    # defaults elide: a float plan emits no weight_quant key
+    f = Plan(devices=1, tp=1, pp=1, dp=1)
+    assert "weight_quant" not in plan_to_config_kwargs(f)
+    assert "weight_quant" not in plan_to_yaml_dict(f)
+    assert "w:" not in f.describe()
+
+
+def _serving_fixture():
+    from neuronx_distributed_tpu.plan.cost import (ModelSpec, TrafficSpec,
+                                                   default_hardware)
+
+    m = ModelSpec(name="wq-test", layers=2, hidden=64, intermediate=128,
+                  heads=4, kv_heads=2, vocab=256, seq=128, global_batch=1,
+                  act_bytes=4)
+    return m, default_hardware("cpu"), TrafficSpec(
+        request_rate=4.0, prompt_tokens=16, new_tokens=8)
+
+
+def test_serving_search_quality_gate_fail_closed():
+    from neuronx_distributed_tpu.plan.cost import serving_search
+
+    m, hw, t = _serving_fixture()
+    kw = dict(tp=1, weight_quants=(None, "int8", "mxfp4"), top_k=50)
+
+    # bar set, nothing recorded: every quantized tier refused
+    plans = serving_search(m, hw, t, quality_bar=0.9, **kw)
+    assert plans and all(p.engine.get("weight_quant") is None
+                         for p in plans)
+    # records admit exactly the tiers that clear the bar (float or
+    # {"greedy_match": ...} record shapes both accepted)
+    plans = serving_search(m, hw, t, quality_bar=0.9,
+                           quality={"int8": {"greedy_match": 0.97},
+                                    "mxfp4": 0.12}, **kw)
+    tiers = {p.engine.get("weight_quant") for p in plans}
+    assert "int8" in tiers and "mxfp4" not in tiers
+    # no bar: all requested tiers compete on cost alone
+    plans = serving_search(m, hw, t, **kw)
+    assert {p.engine.get("weight_quant")
+            for p in plans} == {None, "int8", "mxfp4"}
+    # unknown tier name is an error, not a silent skip
+    with pytest.raises(ValueError, match="int3"):
+        serving_search(m, hw, t, tp=1, weight_quants=("int3",))
+
+
+def test_serving_search_weight_bytes_buy_pool_blocks():
+    from neuronx_distributed_tpu.plan.cost import (param_count,
+                                                   serving_search)
+
+    m, hw, t = _serving_fixture()
+    # budget between int8 weights (~1 B/param) and float (4 B/param):
+    # float candidates must all prune oom, quantized tiers must rank
+    frac = hw.memory_budget / hw.hbm_bytes
+    tight = dataclasses.replace(
+        hw, hbm_bytes=int(param_count(m) * m.act_bytes * 0.75 / frac))
+    plans = serving_search(m, tight, t, tp=1,
+                           weight_quants=(None, "int8"), top_k=50)
+    tiers = {p.engine.get("weight_quant") for p in plans}
+    assert tiers == {"int8"}
+    # quantized describe() carries the tier tag
+    assert all("w:int8" in p.describe() for p in plans)
+
+
+def test_serving_search_cp_excludes_quantized_tiers():
+    from neuronx_distributed_tpu.plan.cost import serving_search
+
+    m, hw, t = _serving_fixture()
+    plans = serving_search(m, hw, t, tp=1, cps=(2,),
+                           weight_quants=(None, "int8"), top_k=50)
+    # the engine forbids weight_quant x cp>1, so the search never
+    # proposes the pair
+    assert all(p.engine.get("weight_quant") is None for p in plans
+               if p.engine.get("cp", 1) > 1)
